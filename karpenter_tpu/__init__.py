@@ -1,0 +1,29 @@
+"""karpenter_tpu — a TPU-native cluster-autoscaling framework.
+
+A brand-new framework with the capabilities of Karpenter's AWS provider
+(reference: jonathan-innis/karpenter-provider-aws): it watches unschedulable
+pods, evaluates scheduling constraints, bin-packs pods onto priced
+(instance type x zone x capacity type) offerings, launches and
+lifecycle-manages nodes, and continuously consolidates for cost.
+
+The two algorithmic hot paths — the provisioning scheduler's Solve() and
+consolidation's combinatorial search — run as dense feasibility tensors with
+vmap'd cost-argmin on TPU via JAX/XLA (see `karpenter_tpu.ops`), sharded over
+a `jax.sharding.Mesh` (see `karpenter_tpu.parallel`). The control plane
+(reconcile loops, NodeClaim lifecycle, cloud adapters, caches) is asyncio
+Python in `karpenter_tpu.controllers` / `karpenter_tpu.cloud`.
+
+Package map (vs reference layers, see SURVEY.md §1):
+  models/       L0 declarative API: NodePool, NodeClaim, NodeClass, Pod,
+                Requirements set-algebra, resource quantities
+  catalog/      L3 instance-type/pricing/offering providers + tensor flattener
+  ops/          the TPU solver kernels (feasibility, bin-pack, consolidation)
+  parallel/     mesh + shard_map distribution of the solver
+  cloud/        L2/L5 cloud-provider interface, fake cloud, request batcher
+  controllers/  L1/L4 reconcile loops (provisioning, lifecycle, disruption,
+                termination, interruption, GC)
+  state/        in-memory cluster state mirror
+  utils/        TTL caches, clock, events
+"""
+
+__version__ = "0.1.0"

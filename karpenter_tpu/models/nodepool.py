@@ -1,0 +1,129 @@
+"""NodePool + NodeClass: the user-facing provisioning policy API.
+
+Parity targets:
+ - NodePool CRD (reference ships karpenter.sh_nodepools.yaml): template
+   requirements (with minValues), taints/startupTaints, labels, limits,
+   weight, disruption policy (consolidationPolicy, consolidateAfter,
+   expireAfter, budgets), nodeClassRef.
+ - EC2NodeClass CRD (pkg/apis/v1/ec2nodeclass.go:32-480): zone/subnet
+   selection, image selection, userdata, tags, block devices, kubelet
+   config, metadata options → our TPUNodeClass analog keeps the same roles
+   with cloud-neutral names (zone selectors, image family, bootstrap config).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .pod import Taint
+from .requirements import Requirement, Requirements
+from .resources import Resources
+
+
+@dataclass
+class Budget:
+    """Disruption budget: max simultaneous voluntary disruptions.
+
+    nodes is an int or a percent string ("10%"); reasons limits which
+    disruption methods the budget applies to; schedule/duration give a cron
+    window (reference: karpenter.sh_nodepools.yaml:78-160).
+    """
+
+    nodes: str = "10%"
+    reasons: Optional[List[str]] = None  # Underutilized | Empty | Drifted
+    schedule: Optional[str] = None
+    duration: Optional[float] = None  # seconds
+
+    def allows(self, reason: str) -> bool:
+        return self.reasons is None or reason in self.reasons
+
+    def max_disruptions(self, total_nodes: int) -> int:
+        s = self.nodes.strip()
+        if s.endswith("%"):
+            return int(total_nodes * float(s[:-1]) / 100.0)
+        return int(s)
+
+
+@dataclass
+class DisruptionSpec:
+    consolidation_policy: str = "WhenEmptyOrUnderutilized"  # or WhenEmpty
+    consolidate_after: float = 0.0  # seconds; pods must be stable this long
+    budgets: List[Budget] = field(default_factory=lambda: [Budget()])
+
+    def allowed_disruptions(self, reason: str, total_nodes: int) -> int:
+        vals = [b.max_disruptions(total_nodes) for b in self.budgets if b.allows(reason)]
+        return min(vals) if vals else total_nodes
+
+
+@dataclass
+class NodeClassSpec:
+    """Cloud-launch template (our EC2NodeClass analog)."""
+
+    name: str = "default"
+    zones: List[str] = field(default_factory=list)  # empty = all discovered
+    image_family: str = "standard"  # bootstrap/image strategy selector
+    image_selector: Dict[str, str] = field(default_factory=dict)
+    user_data: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+    block_device_gib: float = 100.0
+    kubelet_max_pods: Optional[int] = None
+    kubelet_system_reserved: Dict[str, str] = field(default_factory=dict)
+    kubelet_kube_reserved: Dict[str, str] = field(default_factory=dict)
+    kubelet_eviction_hard: Dict[str, str] = field(default_factory=dict)
+    metadata_http_tokens: str = "required"
+    detailed_monitoring: bool = False
+
+    def hash(self) -> str:
+        """Static drift hash (reference EC2NodeClass.Hash(),
+        ec2nodeclass.go:482 — drift detection compares this against the
+        hash annotation stamped on launched nodes)."""
+        blob = json.dumps({
+            "zones": sorted(self.zones),
+            "image_family": self.image_family,
+            "image_selector": dict(sorted(self.image_selector.items())),
+            "user_data": self.user_data,
+            "tags": dict(sorted(self.tags.items())),
+            "block_device_gib": self.block_device_gib,
+            "kubelet": [self.kubelet_max_pods, dict(sorted(self.kubelet_system_reserved.items())),
+                        dict(sorted(self.kubelet_kube_reserved.items())),
+                        dict(sorted(self.kubelet_eviction_hard.items()))],
+            "metadata_http_tokens": self.metadata_http_tokens,
+            "detailed_monitoring": self.detailed_monitoring,
+        }, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    # status (populated by the nodeclass controller)
+    ready: bool = True
+    resolved_zones: List[str] = field(default_factory=list)
+    resolved_images: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NodePool:
+    name: str
+    requirements: Requirements = field(default_factory=Requirements)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    limits: Resources = field(default_factory=Resources)  # empty = unlimited
+    weight: int = 0  # higher = preferred (reference nodepools.yaml:427-432)
+    node_class: str = "default"
+    disruption: DisruptionSpec = field(default_factory=DisruptionSpec)
+    expire_after: Optional[float] = None  # seconds; node max lifetime
+    termination_grace_period: Optional[float] = None
+
+    def add_requirement(self, req: Requirement) -> "NodePool":
+        self.requirements.add(req)
+        return self
+
+    def within_limits(self, current_usage: Resources, adding: Resources) -> bool:
+        if not self.limits:
+            return True
+        total = current_usage.add(adding)
+        for k, lim in self.limits.items():
+            if total.get(k, 0.0) > lim + 1e-9:
+                return False
+        return True

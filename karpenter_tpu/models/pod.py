@@ -1,0 +1,140 @@
+"""Pod model: the demand side of scheduling.
+
+Carries exactly the scheduling-relevant surface the reference's core
+scheduler consumes (website/content/en/docs/concepts/scheduling.md):
+resource requests, nodeSelector / requiredDuringScheduling nodeAffinity,
+tolerations, topologySpreadConstraints, pod (anti-)affinity, priority, and
+the do-not-disrupt annotation that gates voluntary disruption.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .requirements import Operator, Requirement, Requirements
+from .resources import Resources
+
+DO_NOT_DISRUPT = "karpenter.tpu/do-not-disrupt"
+
+_uid = itertools.count()
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" matches all effects
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class Taint:
+    key: str
+    effect: str  # NoSchedule | PreferNoSchedule | NoExecute
+    value: str = ""
+
+    def evicts(self) -> bool:
+        return self.effect == "NoExecute"
+
+
+def tolerates_all(tolerations: List[Toleration], taints: List[Taint]) -> bool:
+    """Pod schedulable w.r.t. taints (PreferNoSchedule is non-blocking)."""
+    for t in taints:
+        if t.effect == "PreferNoSchedule":
+            continue
+        if not any(tol.tolerates(t) for tol in tolerations):
+            return False
+    return True
+
+
+@dataclass
+class TopologySpreadConstraint:
+    topology_key: str
+    max_skew: int = 1
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodAffinityTerm:
+    topology_key: str
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    anti: bool = False  # True for podAntiAffinity
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    requests: Resources = field(default_factory=Resources)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # requiredDuringSchedulingIgnoredDuringExecution terms ({key,operator,values})
+    node_affinity: List[dict] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    affinity_terms: List[PodAffinityTerm] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    priority: int = 0
+    deletion_cost: int = 0
+    owner: Optional[str] = None  # replicaset/deployment key, for spread selectors
+    uid: int = field(default_factory=lambda: next(_uid))
+    node_name: Optional[str] = None  # bound node (None = pending)
+    phase: str = "Pending"
+
+    def scheduling_requirements(self) -> Requirements:
+        """nodeSelector + required nodeAffinity as one Requirements conjunction."""
+        r = Requirements.from_labels(self.node_selector)
+        for term in self.node_affinity:
+            r.add(Requirement(term["key"], Operator(term["operator"]),
+                              tuple(term.get("values", ()))))
+        return r
+
+    def do_not_disrupt(self) -> bool:
+        return self.annotations.get(DO_NOT_DISRUPT) == "true"
+
+    def has_self_anti_affinity(self) -> bool:
+        """Hostname anti-affinity against the pod's own labels (max 1/node)."""
+        for t in self.affinity_terms:
+            if t.anti and t.topology_key == "kubernetes.io/hostname":
+                if all(self.labels.get(k) == v for k, v in t.label_selector.items()):
+                    return True
+        return False
+
+    def constraint_signature(self) -> Tuple:
+        """Hashable signature for exact-dedupe grouping in the solver.
+
+        Two pods with equal signatures are interchangeable to the scheduler
+        — same requests, same constraints — so the solver packs them as a
+        (group, count) instead of row-per-pod. This is the key data reduction
+        that lets the TPU kernel scan over O(groups) not O(pods).
+
+        Labels, namespace, and owner are part of the signature because other
+        pods' anti-affinity / topology-spread selectors can distinguish pods
+        by them; deduping across label sets would merge pods that must be
+        spread apart.
+        """
+        return (
+            self.namespace,
+            self.owner,
+            tuple(sorted(self.labels.items())),
+            tuple(sorted(self.requests.items())),
+            tuple(sorted(self.node_selector.items())),
+            tuple(sorted((t["key"], t["operator"], tuple(t.get("values", ())))
+                         for t in self.node_affinity)),
+            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
+            tuple(sorted((c.topology_key, c.max_skew, c.when_unsatisfiable,
+                          tuple(sorted(c.label_selector.items())))
+                         for c in self.topology_spread)),
+            tuple(sorted((t.topology_key, t.anti, tuple(sorted(t.label_selector.items())))
+                         for t in self.affinity_terms)),
+        )

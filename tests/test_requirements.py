@@ -1,0 +1,135 @@
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                               Requirements, ValueSet)
+
+IN, NOT_IN, EXISTS, DNE, GT, LT = (Operator.IN, Operator.NOT_IN,
+                                   Operator.EXISTS, Operator.DOES_NOT_EXIST,
+                                   Operator.GT, Operator.LT)
+
+
+def req(key, op, *values, min_values=None):
+    return Requirement(key, op, tuple(values), min_values=min_values)
+
+
+class TestValueSet:
+    def test_in(self):
+        vs = ValueSet.of(IN, ["a", "b"])
+        assert vs.contains("a") and not vs.contains("c")
+
+    def test_not_in(self):
+        vs = ValueSet.of(NOT_IN, ["a"])
+        assert not vs.contains("a") and vs.contains("z")
+
+    def test_exists_universe(self):
+        vs = ValueSet.of(EXISTS)
+        assert vs.is_universe() and vs.contains("anything")
+
+    def test_does_not_exist(self):
+        vs = ValueSet.of(DNE)
+        assert vs.is_does_not_exist() and not vs.contains("x")
+
+    def test_gt_lt(self):
+        gt = ValueSet.of(GT, ["4"])
+        assert gt.contains("8") and not gt.contains("4") and not gt.contains("2")
+        assert not gt.contains("xlarge")  # non-numeric fails bounds
+        lt = ValueSet.of(LT, ["16"])
+        assert lt.contains("8") and not lt.contains("16")
+
+    def test_intersection_finite(self):
+        a = ValueSet.of(IN, ["a", "b", "c"])
+        b = ValueSet.of(IN, ["b", "c", "d"])
+        i = a.intersection(b)
+        assert i.values == frozenset({"b", "c"})
+        assert a.intersects(b)
+        assert not a.intersects(ValueSet.of(IN, ["z"]))
+
+    def test_intersection_mixed(self):
+        a = ValueSet.of(IN, ["a", "b"])
+        b = ValueSet.of(NOT_IN, ["a"])
+        assert a.intersection(b).values == frozenset({"b"})
+
+    def test_intersection_bounds(self):
+        a = ValueSet.of(IN, ["2", "4", "8", "16"])
+        b = ValueSet.of(GT, ["3"])
+        i = a.intersection(b)
+        assert i.values == frozenset({"4", "8", "16"})
+        c = i.intersection(ValueSet.of(LT, ["10"]))
+        assert c.values == frozenset({"4", "8"})
+
+    def test_complement_intersection(self):
+        a = ValueSet.of(NOT_IN, ["a"])
+        b = ValueSet.of(NOT_IN, ["b"])
+        i = a.intersection(b)
+        assert i.complement and i.values == frozenset({"a", "b"})
+        assert a.intersects(b)
+
+
+class TestRequirements:
+    def test_tightening_add(self):
+        r = Requirements(req("k", IN, "a", "b", "c"))
+        r.add(req("k", NOT_IN, "b"))
+        assert r.get("k").values == frozenset({"a", "c"})
+
+    def test_from_labels(self):
+        r = Requirements.from_labels({L.ARCH: "arm64"})
+        assert r.get(L.ARCH).contains("arm64")
+
+    def test_compatible_basic(self):
+        itype = Requirements.from_labels({L.ARCH: "amd64", L.INSTANCE_FAMILY: "m5"})
+        pod = Requirements(req(L.ARCH, IN, "amd64"))
+        assert pod.compatible(itype)
+        pod2 = Requirements(req(L.ARCH, IN, "arm64"))
+        assert not pod2.compatible(itype)
+
+    def test_compatible_absent_key(self):
+        itype = Requirements.from_labels({L.ARCH: "amd64"})
+        # NotIn on absent key: satisfied (k8s semantics)
+        assert Requirements(req("custom", NOT_IN, "x")).compatible(itype)
+        # Exists on absent key: not satisfied
+        assert not Requirements(req("custom", EXISTS)).compatible(itype)
+        # In on absent key: not satisfied
+        assert not Requirements(req("custom", IN, "x")).compatible(itype)
+        # DoesNotExist on absent key: satisfied
+        assert Requirements(req("custom", DNE)).compatible(itype)
+        # DoesNotExist on present key: not satisfied
+        assert not Requirements(req(L.ARCH, DNE)).compatible(itype)
+
+    def test_compatible_numeric(self):
+        itype = Requirements.from_labels({L.INSTANCE_CPU: "8"})
+        assert Requirements(req(L.INSTANCE_CPU, GT, "4")).compatible(itype)
+        assert not Requirements(req(L.INSTANCE_CPU, GT, "8")).compatible(itype)
+        assert Requirements(req(L.INSTANCE_CPU, LT, "16")).compatible(itype)
+
+    def test_union_with(self):
+        a = Requirements(req("k", IN, "a", "b"))
+        b = Requirements(req("k", IN, "b", "c"), req("j", EXISTS))
+        u = a.union_with(b)
+        assert u.get("k").values == frozenset({"b"})
+        assert u.get("j").is_universe()
+
+    def test_single_values(self):
+        r = Requirements(req(L.ARCH, IN, "amd64"), req(L.INSTANCE_FAMILY, IN, "m5", "c5"))
+        sv = r.single_values()
+        assert sv == {L.ARCH: "amd64"}
+
+    def test_min_values_tracked(self):
+        r = Requirements(req(L.INSTANCE_TYPE, EXISTS, min_values=15))
+        assert r.min_values(L.INSTANCE_TYPE) == 15
+
+    def test_labels_satisfy(self):
+        r = Requirements(req(L.ARCH, IN, "amd64"), req("x", NOT_IN, "bad"))
+        assert r.labels_satisfy({L.ARCH: "amd64"})
+        assert not r.labels_satisfy({L.ARCH: "arm64"})
+        assert not r.labels_satisfy({L.ARCH: "amd64", "x": "bad"})
+
+
+class TestTaints:
+    def test_toleration(self):
+        from karpenter_tpu.models.pod import Taint, Toleration, tolerates_all
+        taint = Taint(key="team", value="ml", effect="NoSchedule")
+        assert tolerates_all([Toleration(key="team", value="ml", effect="NoSchedule")], [taint])
+        assert tolerates_all([Toleration(key="team", operator="Exists")], [taint])
+        assert tolerates_all([Toleration(operator="Exists")], [taint])
+        assert not tolerates_all([], [taint])
+        # PreferNoSchedule never blocks
+        assert tolerates_all([], [Taint(key="t", value="", effect="PreferNoSchedule")])

@@ -1,0 +1,68 @@
+import pytest
+
+from karpenter_tpu.models.resources import (CPU, MEMORY, PODS, Resources,
+                                            format_quantity, parse_quantity,
+                                            pod_requests, resource_axis,
+                                            resource_index)
+
+
+def test_parse_quantities():
+    assert parse_quantity("100m") == pytest.approx(0.1)
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity("1.5Gi") == 1.5 * 2**30
+    assert parse_quantity("512Mi") == 512 * 2**20
+    assert parse_quantity("1k") == 1000.0
+    assert parse_quantity("2.5") == 2.5
+    assert parse_quantity(4) == 4.0
+    assert parse_quantity("3e2") == 300.0
+
+
+def test_parse_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+    with pytest.raises(ValueError):
+        parse_quantity("1Xx")
+
+
+def test_format():
+    assert format_quantity(0.1) == "100m"
+    assert format_quantity(2.0) == "2"
+    assert format_quantity(2**30, binary=True) == "1Gi"
+
+
+def test_resources_algebra():
+    a = Resources.parse({"cpu": "500m", "memory": "1Gi"})
+    b = Resources.parse({"cpu": "250m", "memory": "512Mi", "pods": 1})
+    s = a.add(b)
+    assert s[CPU] == pytest.approx(0.75)
+    assert s[MEMORY] == pytest.approx(1.5 * 2**30)
+    d = s.sub(b)
+    assert d[CPU] == pytest.approx(0.5)
+    assert b.fits(a.add(Resources({PODS: 1})))
+    assert not Resources({CPU: 10}).fits(a)
+
+
+def test_vector_roundtrip():
+    r = Resources.parse({"cpu": "2", "memory": "4Gi", "pods": 1})
+    v = r.to_vector()
+    assert v[resource_index(CPU)] == 2.0
+    assert v[resource_index(MEMORY)] == 4096.0  # MiB device scale
+    back = Resources.from_vector(v)
+    assert back[MEMORY] == pytest.approx(4 * 2**30)
+    assert back[CPU] == 2.0
+
+
+def test_pod_requests_aggregation():
+    req = pod_requests(
+        containers=[Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                    Resources.parse({"cpu": "500m"})],
+        init_containers=[Resources.parse({"cpu": "2"})],
+    )
+    assert req[CPU] == 2.0  # init container max dominates
+    assert req[MEMORY] == 2**30
+    assert req[PODS] == 1.0
+
+
+def test_axis_stable():
+    assert resource_axis()[0] == CPU
+    assert resource_axis()[1] == MEMORY

@@ -1,0 +1,89 @@
+"""Regression tests for code-review findings on the data model."""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.instancetype import InstanceType, Offering, truncate
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                               Requirements)
+from karpenter_tpu.models.resources import Resources, resource_axis
+
+
+def test_contradictory_gt_lt_do_not_intersect():
+    a = Requirements(Requirement("k", Operator.GT, ("16",)))
+    b = Requirements(Requirement("k", Operator.LT, ("8",)))
+    assert not a.intersect_ok(b)
+    # adjacent integer bounds: Gt 7 & Lt 8 leaves no integer
+    c = Requirements(Requirement("k", Operator.GT, ("7",)))
+    d = Requirements(Requirement("k", Operator.LT, ("8",)))
+    assert not c.intersect_ok(d)
+    # Gt 7 & Lt 9 leaves 8
+    e = Requirements(Requirement("k", Operator.LT, ("9",)))
+    assert c.intersect_ok(e)
+
+
+def _mk_types(n_families, sizes=2):
+    types = []
+    for f in range(n_families):
+        fam = f"f{f}"
+        for s in range(sizes):
+            types.append(InstanceType(
+                name=f"{fam}.s{s}",
+                requirements=Requirements.from_labels({L.INSTANCE_FAMILY: fam}),
+                capacity=Resources.parse({"cpu": 4}),
+                offerings=[Offering(zone="z1", capacity_type="on-demand",
+                                    price=1.0 + f + 0.1 * s)]))
+    return types
+
+
+def test_truncate_respects_hard_limit():
+    types = _mk_types(8)
+    reqs = Requirements(Requirement(L.INSTANCE_FAMILY, Operator.EXISTS, min_values=6))
+    kept = truncate(types, reqs, limit=6)
+    assert len(kept) <= 6
+    fams = {t.name.split(".")[0] for t in kept}
+    assert len(fams) >= 6
+
+
+def test_truncate_errors_when_minvalues_exceeds_limit():
+    types = _mk_types(8)
+    reqs = Requirements(Requirement(L.INSTANCE_FAMILY, Operator.EXISTS, min_values=7))
+    with pytest.raises(ValueError, match="truncation limit"):
+        truncate(types, reqs, limit=5)
+
+
+def test_minvalues_counts_only_compatible_values():
+    # requirement allows only f0/f1 but catalog has f0..f3; minValues=2 must
+    # count {f0, f1} only, and minValues=3 must fail despite 4 families
+    types = _mk_types(4)
+    ok = Requirements(Requirement(L.INSTANCE_FAMILY, Operator.IN, ("f0", "f1"),
+                                  min_values=2))
+    kept = truncate(types, ok, limit=10)
+    assert {t.name.split(".")[0] for t in kept} >= {"f0", "f1"}
+    bad = Requirements(Requirement(L.INSTANCE_FAMILY, Operator.IN, ("f0", "f1"),
+                                   min_values=3))
+    with pytest.raises(ValueError, match="unsatisfiable"):
+        truncate(types, bad, limit=10)
+
+
+def test_signature_distinguishes_labels_namespace_owner():
+    a = Pod(name="a", labels={"app": "a"})
+    b = Pod(name="b", labels={"app": "b"})
+    assert a.constraint_signature() != b.constraint_signature()
+    c = Pod(name="c", namespace="ns1")
+    d = Pod(name="d", namespace="ns2")
+    assert c.constraint_signature() != d.constraint_signature()
+    e = Pod(name="e", labels={"app": "x"})
+    f = Pod(name="f", labels={"app": "x"})
+    assert e.constraint_signature() == f.constraint_signature()
+
+
+def test_unknown_resource_auto_registered_in_vector():
+    r = Resources.parse({"amd.com/gpu": 1, "cpu": "500m"})
+    vec = r.to_vector()
+    assert "amd.com/gpu" in resource_axis()
+    idx = resource_axis().index("amd.com/gpu")
+    assert vec[idx] == 1.0
+    # round-trips
+    assert Resources.from_vector(vec)["amd.com/gpu"] == 1.0
