@@ -1,0 +1,9 @@
+from .generator import (DEFAULT_ZONES, GeneratorConfig, generate_catalog,
+                        small_catalog)
+from .pricing import PricingProvider
+from .provider import CatalogProvider
+from .unavailable import UnavailableOfferings
+
+__all__ = ["DEFAULT_ZONES", "GeneratorConfig", "generate_catalog",
+           "small_catalog", "PricingProvider", "CatalogProvider",
+           "UnavailableOfferings"]

@@ -1,0 +1,52 @@
+"""Pricing provider.
+
+Reference: pkg/providers/pricing/pricing.go — on-demand prices from the
+Pricing API (12h refresh), zonal spot prices from DescribeSpotPriceHistory,
+static fallback in isolated mode. Ours reads from the cloud backend's
+price book (the generator's deterministic prices stand in for the static
+table) and supports live spot-price updates pushed by the backend.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+from ..models.instancetype import InstanceType
+
+
+class PricingProvider:
+    def __init__(self) -> None:
+        self._on_demand: Dict[str, float] = {}
+        self._spot: Dict[Tuple[str, str], float] = {}  # (type, zone)
+        self._reserved: Dict[Tuple[str, str], float] = {}
+        self.updates = 0
+
+    def hydrate(self, types: Iterable[InstanceType]) -> None:
+        """Initial sync load (reference hydrates before start,
+        operator.go:151)."""
+        for t in types:
+            for o in t.offerings:
+                if o.capacity_type == "on-demand":
+                    self._on_demand[t.name] = o.price
+                elif o.capacity_type == "spot":
+                    self._spot[(t.name, o.zone)] = o.price
+                else:
+                    self._reserved[(t.name, o.zone)] = o.price
+        self.updates += 1
+
+    def update_spot(self, prices: Dict[Tuple[str, str], float]) -> None:
+        self._spot.update(prices)
+        self.updates += 1
+
+    def on_demand_price(self, instance_type: str) -> Optional[float]:
+        return self._on_demand.get(instance_type)
+
+    def spot_price(self, instance_type: str, zone: str) -> Optional[float]:
+        return self._spot.get((instance_type, zone))
+
+    def price(self, instance_type: str, zone: str, capacity_type: str) -> Optional[float]:
+        if capacity_type == "spot":
+            return self.spot_price(instance_type, zone)
+        if capacity_type == "reserved":
+            return self._reserved.get((instance_type, zone))
+        return self.on_demand_price(instance_type)

@@ -1,0 +1,120 @@
+"""Instance-type catalog provider.
+
+Reference: pkg/providers/instancetype/instancetype.go — the catalog. Pulls
+raw types from a backend (fake cloud / generator), applies NodeClass zone
+filtering, injects offering availability (pricing + ICE cache + reservation
+bookkeeping; reference offering/offering.go:103-196), and caches the result
+keyed on (nodeclass hash, ICE seqnum) so any launch failure invalidates
+exactly like the reference's seqnum-keyed offering cache.
+
+The provider is also the host→device boundary: `tensors()` returns the
+flattened CatalogTensors for the solver, rebuilt only when the catalog or
+availability changes (epoch counter).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..models.instancetype import InstanceType, Offering
+from ..models.nodepool import NodeClassSpec
+from ..utils.cache import INSTANCE_TYPES_TTL, TTLCache
+from ..utils.clock import Clock, RealClock
+from .pricing import PricingProvider
+from .unavailable import UnavailableOfferings
+
+
+class CatalogProvider:
+    def __init__(self,
+                 list_types: Callable[[], List[InstanceType]],
+                 pricing: Optional[PricingProvider] = None,
+                 unavailable: Optional[UnavailableOfferings] = None,
+                 clock: Optional[Clock] = None):
+        self.clock = clock or RealClock()
+        self._list_types = list_types
+        self.pricing = pricing or PricingProvider()
+        self.unavailable = unavailable or UnavailableOfferings()
+        self._raw_cache = TTLCache(INSTANCE_TYPES_TTL, self.clock)
+        self._resolved_cache = TTLCache(INSTANCE_TYPES_TTL, self.clock)
+        self._epoch = 0  # bumps when the raw catalog changes
+        self._reservation_remaining: dict = {}
+        self._reservation_version = 0
+
+    # --- raw catalog (UpdateInstanceTypes analog, 5m TTL) ---
+    def raw_types(self) -> List[InstanceType]:
+        cached = self._raw_cache.get("raw")
+        if cached is None:
+            cached = self._list_types()
+            self._raw_cache.set("raw", cached)
+            self.pricing.hydrate(cached)
+            self._epoch += 1
+        return cached
+
+    def refresh(self) -> None:
+        """Forced refresh (the polling controller calls this; reference
+        pkg/controllers/providers/instancetype/controller.go:43)."""
+        self._raw_cache.flush()
+        self._resolved_cache.flush()
+        self.raw_types()
+
+    # --- resolved, availability-injected catalog (List analog) ---
+    def list(self, node_class: Optional[NodeClassSpec] = None) -> List[InstanceType]:
+        nc = node_class or NodeClassSpec()
+        self.raw_types()  # ensure hydrated so the key sees current versions
+        key = (nc.hash(),) + self._availability_version()
+        cached = self._resolved_cache.get(key)
+        if cached is not None:
+            return cached
+        resolved = []
+        for t in self.raw_types():
+            offerings = self._inject_offerings(t, nc)
+            if not offerings:
+                continue
+            resolved.append(InstanceType(
+                name=t.name, requirements=t.requirements, capacity=t.capacity,
+                overhead=t.overhead, offerings=offerings))
+        self._resolved_cache.set(key, resolved)
+        return resolved
+
+    def _availability_version(self) -> tuple:
+        """Everything that can change a resolved offering: raw catalog epoch,
+        ICE marks, price updates, reservation bookkeeping. (The review found
+        the original (hash, seqnum) key served stale prices/reservations.)"""
+        return (self._epoch, self.unavailable.seqnum, self.pricing.updates,
+                self._reservation_version)
+
+    def _inject_offerings(self, t: InstanceType, nc: NodeClassSpec) -> List[Offering]:
+        out = []
+        for o in t.offerings:
+            if nc.zones and o.zone not in nc.zones:
+                continue
+            price = self.pricing.price(t.name, o.zone, o.capacity_type)
+            if price is None:
+                price = o.price
+            available = not self.unavailable.is_unavailable(t.name, o.zone, o.capacity_type)
+            rem = o.reservation_capacity
+            if o.reservation_id is not None:
+                rem = self._reservation_remaining.get(o.reservation_id, o.reservation_capacity)
+                available = available and rem > 0
+            out.append(Offering(zone=o.zone, capacity_type=o.capacity_type,
+                                price=price, available=available,
+                                reservation_id=o.reservation_id,
+                                reservation_capacity=rem))
+        return out
+
+    @property
+    def epoch(self) -> tuple:
+        """Changes whenever list() results may differ — cache key for the
+        device-resident tensors."""
+        return self._availability_version()
+
+    # --- capacity-reservation bookkeeping (reference provider.go:34-67) ---
+    def mark_reservation_launched(self, reservation_id: str, initial: int) -> None:
+        rem = self._reservation_remaining.get(reservation_id, initial)
+        self._reservation_remaining[reservation_id] = max(0, rem - 1)
+        self._reservation_version += 1
+
+    def mark_reservation_terminated(self, reservation_id: str, initial: int) -> None:
+        rem = self._reservation_remaining.get(reservation_id, initial)
+        self._reservation_remaining[reservation_id] = rem + 1
+        self._reservation_version += 1

@@ -1,0 +1,51 @@
+"""UnavailableOfferings — the ICE (insufficient-capacity) feedback cache.
+
+Reference: pkg/cache/unavailableofferings.go:35-136. Launch failures mark
+(instanceType, zone, capacityType) unavailable for 3 minutes so the next
+Solve() avoids them; capacity-type-wide and zone-wide marks are supported;
+an atomic sequence number invalidates downstream offering caches and — in
+our build — triggers re-upload of the availability tensor to device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..utils.cache import UNAVAILABLE_OFFERINGS_TTL, TTLCache
+from ..utils.clock import Clock
+
+
+class UnavailableOfferings:
+    def __init__(self, clock: Optional[Clock] = None, ttl: float = UNAVAILABLE_OFFERINGS_TTL):
+        self._cache = TTLCache(ttl, clock)
+        self._seqnum = 0
+
+    @property
+    def seqnum(self) -> int:
+        """Monotonic change counter; embed in downstream cache keys
+        (reference offering.go:113-121 keys its cache on this)."""
+        return self._seqnum
+
+    def mark_unavailable(self, instance_type: str, zone: str,
+                         capacity_type: str, reason: str = "") -> None:
+        self._cache.set(("o", instance_type, zone, capacity_type), reason or True)
+        self._seqnum += 1
+
+    def mark_capacity_type_unavailable(self, capacity_type: str) -> None:
+        """E.g. a fleet-wide spot UnfulfillableCapacity error."""
+        self._cache.set(("c", capacity_type), True)
+        self._seqnum += 1
+
+    def mark_zone_unavailable(self, zone: str) -> None:
+        """E.g. InsufficientFreeAddresses in a subnet (errors.go:180)."""
+        self._cache.set(("z", zone), True)
+        self._seqnum += 1
+
+    def is_unavailable(self, instance_type: str, zone: str, capacity_type: str) -> bool:
+        return (self._cache.get(("o", instance_type, zone, capacity_type)) is not None
+                or self._cache.get(("c", capacity_type)) is not None
+                or self._cache.get(("z", zone)) is not None)
+
+    def flush(self) -> None:
+        self._cache.flush()
+        self._seqnum += 1

@@ -46,14 +46,18 @@ class ValueSet:
     complement=False: allowed = values (filtered by bounds)
     complement=True:  allowed = universe - values (filtered by bounds)
     gt/lt are exclusive numeric bounds (reference Gt/Lt take integers).
-    An empty non-complemented set with no bounds means `DoesNotExist`:
-    the key must be absent.
+
+    dne=True marks `DoesNotExist` — satisfied only by key absence. This is
+    distinct from an empty non-complemented set WITHOUT dne, which marks an
+    unsatisfiable conflict (e.g. In{a} ∩ In{b}): a conflict matches nothing,
+    not even absence.
     """
 
     values: frozenset = frozenset()
     complement: bool = False
     gt: Optional[float] = None
     lt: Optional[float] = None
+    dne: bool = False
 
     # --- constructors from operators ---
     @staticmethod
@@ -66,7 +70,7 @@ class ValueSet:
         if op == Operator.EXISTS:
             return ValueSet(complement=True)
         if op == Operator.DOES_NOT_EXIST:
-            return ValueSet()
+            return ValueSet(dne=True)
         if op == Operator.GT:
             (v,) = vals
             return ValueSet(complement=True, gt=float(v))
@@ -108,10 +112,23 @@ class ValueSet:
         return not any(self._passes_bounds(v) for v in self.values)
 
     def is_does_not_exist(self) -> bool:
-        return not self.complement and not self.values and self.gt is None and self.lt is None
+        return self.dne
+
+    def is_conflict(self) -> bool:
+        """Unsatisfiable: matches no value and does not accept absence."""
+        return (not self.dne and not self.complement and not self.values
+                and self.gt is None and self.lt is None)
 
     # --- algebra ---
     def intersection(self, other: "ValueSet") -> "ValueSet":
+        if self.dne or other.dne:
+            # DoesNotExist ∩ X: stays DoesNotExist if X tolerates absence
+            # (NotIn / DoesNotExist), else it's an unsatisfiable conflict.
+            a, b = (self, other) if self.dne else (other, self)
+            if b.dne or (b.complement and not b.is_universe()
+                         and b.gt is None and b.lt is None):
+                return ValueSet(dne=True)
+            return ValueSet()  # conflict
         gt = max((b for b in (self.gt, other.gt) if b is not None), default=None)
         lt = min((b for b in (self.lt, other.lt) if b is not None), default=None)
         if self.complement and other.complement:

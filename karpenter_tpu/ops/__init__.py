@@ -1,0 +1,7 @@
+"""TPU solver kernels: tensor encoding, feasibility, bin-pack, consolidation."""
+
+from .encode import (CatalogTensors, EncodedPods, PodGroup, compat_mask,
+                     encode_catalog, encode_pods, group_pods)
+
+__all__ = ["CatalogTensors", "EncodedPods", "PodGroup", "compat_mask",
+           "encode_catalog", "encode_pods", "group_pods"]

@@ -1,0 +1,317 @@
+"""Tensorization: flatten catalog + pods into dense arrays for the solver.
+
+This is the host→device boundary of the build plan (SURVEY.md §7):
+
+  catalog  →  allocatable[T,R], price[T,Z,C], available[T,Z,C],
+              label_val[T,L] (int-coded categorical), label_num[T,Ln]
+  pods     →  exact-dedupe groups (constraint_signature) →
+              requests[G,R], counts[G], compat[G,T], allow_zone[G,Z],
+              allow_cap[G,C], max_per_node[G]
+
+The Requirements set-algebra (In/NotIn/Exists/DoesNotExist/Gt/Lt) lowers to
+vocabulary-interned integer comparisons: each categorical label key gets a
+vocab (value→id), each instance type a single value id per key (types are
+built from single-valued labels), and each pod constraint becomes a boolean
+allowed-vector over the vocab gathered through the type's value ids. Numeric
+keys additionally keep float values so Gt/Lt stay exact. Zone and
+capacity-type constraints map onto the offering axes (Z, C) instead of the
+label mask — they vary per offering, not per type (reference models this the
+same way: Offering carries its own zone/capacity-type requirements,
+offering/offering.go:140-149).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models import labels as L
+from ..models.instancetype import InstanceType
+from ..models.pod import Pod, Taint, tolerates_all
+from ..models.requirements import Requirements, ValueSet, _tolerates_absence
+from ..models.resources import Resources, num_resources, resource_axis
+
+ABSENT = -1
+CAPACITY_TYPES = (L.CAPACITY_ON_DEMAND, L.CAPACITY_SPOT, L.CAPACITY_RESERVED)
+
+
+@dataclass
+class CatalogTensors:
+    names: List[str]                      # [T]
+    zones: List[str]                      # [Z]
+    captypes: Tuple[str, ...]             # [C]
+    resources: Tuple[str, ...]            # [R] axis snapshot
+    allocatable: np.ndarray               # f32 [T, R]
+    price: np.ndarray                     # f32 [T, Z, C], +inf = no offering
+    available: np.ndarray                 # bool [T, Z, C]
+    reservation_cap: np.ndarray           # i32 [T, Z, C]
+    label_keys: List[str]                 # [Lc] categorical keys
+    vocab: Dict[str, Dict[str, int]]      # key -> value -> id
+    label_val: np.ndarray                 # i32 [T, Lc], ABSENT where missing
+    numeric_keys: List[str]               # [Ln]
+    label_num: np.ndarray                 # f32 [T, Ln], nan where missing
+    name_to_idx: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def T(self) -> int:
+        return len(self.names)
+
+    @property
+    def Z(self) -> int:
+        return len(self.zones)
+
+    @property
+    def C(self) -> int:
+        return len(self.captypes)
+
+
+def encode_catalog(types: Sequence[InstanceType],
+                   zones: Optional[Sequence[str]] = None) -> CatalogTensors:
+    if zones is None:
+        zs: List[str] = sorted({o.zone for t in types for o in t.offerings})
+    else:
+        zs = list(zones)
+    zidx = {z: i for i, z in enumerate(zs)}
+    cidx = {c: i for i, c in enumerate(CAPACITY_TYPES)}
+
+    # collect label keys and vocabularies across the whole catalog
+    label_keys: List[str] = []
+    numeric_keys: List[str] = []
+    seen_keys = set()
+    for t in types:
+        for k in t.requirements.keys():
+            if k in L.OFFERING_LABELS or k in seen_keys:
+                continue
+            seen_keys.add(k)
+            label_keys.append(k)
+            if k in L.NUMERIC_LABELS:
+                numeric_keys.append(k)
+    vocab: Dict[str, Dict[str, int]] = {k: {} for k in label_keys}
+    for t in types:
+        for k in label_keys:
+            vs = t.requirements.get(k)
+            if vs is not None and not vs.complement:
+                for v in vs.values:
+                    vocab[k].setdefault(v, len(vocab[k]))
+
+    # allocatable vectors first (to_vector may auto-register resources);
+    # read the axis length only after all vectors are built
+    alloc_vecs = [t.allocatable().to_vector() for t in types]
+    R = num_resources()
+    T = len(types)
+    allocatable = np.zeros((T, R), np.float32)
+    for i, v in enumerate(alloc_vecs):
+        allocatable[i, : len(v)] = v
+
+    kidx = {k: j for j, k in enumerate(label_keys)}
+    nidx = {k: j for j, k in enumerate(numeric_keys)}
+    label_val = np.full((T, len(label_keys)), ABSENT, np.int32)
+    label_num = np.full((T, len(numeric_keys)), np.nan, np.float32)
+    price = np.full((T, len(zs), len(CAPACITY_TYPES)), np.inf, np.float32)
+    available = np.zeros((T, len(zs), len(CAPACITY_TYPES)), bool)
+    reservation_cap = np.zeros((T, len(zs), len(CAPACITY_TYPES)), np.int32)
+
+    for i, t in enumerate(types):
+        for k in label_keys:
+            vs = t.requirements.get(k)
+            if vs is None or vs.complement or len(vs.values) != 1:
+                continue  # multi-valued/complement type labels stay ABSENT
+            (v,) = vs.values
+            label_val[i, kidx[k]] = vocab[k][v]
+            if k in nidx:
+                try:
+                    label_num[i, nidx[k]] = float(v)
+                except ValueError:
+                    pass
+        for o in t.offerings:
+            zi = zidx.get(o.zone)
+            ci = cidx.get(o.capacity_type)
+            if zi is None or ci is None:
+                continue
+            price[i, zi, ci] = o.price
+            available[i, zi, ci] = o.available
+            reservation_cap[i, zi, ci] = o.reservation_capacity
+
+    return CatalogTensors(
+        names=[t.name for t in types], zones=zs, captypes=CAPACITY_TYPES,
+        resources=tuple(resource_axis()), allocatable=allocatable, price=price,
+        available=available, reservation_cap=reservation_cap,
+        label_keys=label_keys, vocab=vocab, label_val=label_val,
+        numeric_keys=numeric_keys, label_num=label_num,
+        name_to_idx={t.name: i for i, t in enumerate(types)},
+    )
+
+
+# --- pod grouping -----------------------------------------------------------
+
+
+@dataclass
+class PodGroup:
+    pods: List[Pod]
+    representative: Pod
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
+    """Exact-dedupe pods into interchangeable groups (see
+    Pod.constraint_signature). Order is deterministic: groups sorted by
+    descending cpu-then-memory of the representative — the FFD 'decreasing'
+    ordering (reference designs/bin-packing.md sorts pods by size desc)."""
+    by_sig: Dict[tuple, List[Pod]] = {}
+    for p in pods:
+        by_sig.setdefault(p.constraint_signature(), []).append(p)
+    groups = [PodGroup(pods=v, representative=v[0]) for v in by_sig.values()]
+    groups.sort(key=lambda g: (-g.representative.requests.get("cpu"),
+                               -g.representative.requests.get("memory"),
+                               g.representative.name))
+    return groups
+
+
+@dataclass
+class EncodedPods:
+    groups: List[PodGroup]
+    requests: np.ndarray      # f32 [G, R]
+    counts: np.ndarray        # i32 [G]
+    compat: np.ndarray        # bool [G, T]
+    allow_zone: np.ndarray    # bool [G, Z]
+    allow_cap: np.ndarray     # bool [G, C]
+    max_per_node: np.ndarray  # i32 [G], 0 = unlimited
+    spread_zone: np.ndarray   # bool [G] — zone topology-spread requested
+
+    @property
+    def G(self) -> int:
+        return len(self.groups)
+
+
+def _allowed_vector(vs: ValueSet, vocab: Dict[str, int]) -> np.ndarray:
+    out = np.zeros(len(vocab), bool)
+    for v, i in vocab.items():
+        out[i] = vs.contains(v)
+    return out
+
+
+def _key_mask(vs: ValueSet, key: str, cat: CatalogTensors) -> np.ndarray:
+    """bool [T]: which instance types satisfy one requirement key."""
+    T = cat.T
+    absent_ok = _tolerates_absence(vs)
+    has_bounds = vs.gt is not None or vs.lt is not None
+    if has_bounds and key in cat.numeric_keys:
+        col = cat.label_num[:, cat.numeric_keys.index(key)]
+        mask = np.ones(T, bool)
+        if vs.gt is not None:
+            mask &= col > vs.gt
+        if vs.lt is not None:
+            mask &= col < vs.lt
+        # NaN comparisons are False already; absent handled below
+        if vs.values and key in cat.vocab:  # bounds + In/NotIn combination
+            mask &= _categorical_mask(vs, key, cat, handle_absent=False)
+        absent = np.isnan(col)
+        return np.where(absent, absent_ok, mask)
+    if key not in cat.vocab or not cat.vocab[key]:
+        # key no instance type carries: satisfied only if absence tolerated
+        return np.full(T, absent_ok, bool)
+    return _categorical_mask(vs, key, cat)
+
+
+def _categorical_mask(vs: ValueSet, key: str, cat: CatalogTensors,
+                      handle_absent: bool = True) -> np.ndarray:
+    ids = cat.label_val[:, cat.label_keys.index(key)]
+    allowed = _allowed_vector(vs, cat.vocab[key])
+    mask = np.where(ids >= 0, allowed[np.clip(ids, 0, None)], False)
+    if handle_absent:
+        mask = np.where(ids == ABSENT, _tolerates_absence(vs), mask)
+    return mask
+
+
+def compat_mask(reqs: Requirements, cat: CatalogTensors) -> np.ndarray:
+    """bool [T]: types compatible with a Requirements conjunction
+    (zone/capacity-type keys excluded — they map to the offering axes)."""
+    mask = np.ones(cat.T, bool)
+    for key in reqs.keys():
+        if key in L.OFFERING_LABELS:
+            continue
+        mask &= _key_mask(reqs.get(key), key, cat)
+    return mask
+
+
+def _axis_allow(reqs: Requirements, key: str, axis_values: Sequence[str]) -> np.ndarray:
+    vs = reqs.get(key)
+    if vs is None:
+        return np.ones(len(axis_values), bool)
+    return np.array([vs.contains(v) for v in axis_values], bool)
+
+
+def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
+                extra_requirements: Optional[Requirements] = None,
+                taints: Optional[List[Taint]] = None) -> EncodedPods:
+    """Group + tensorize pods against a catalog.
+
+    extra_requirements: the NodePool template requirements, conjoined into
+    every group (the reference scheduler layers NodePool requirements onto
+    pod requirements the same way, scheduling.md:17-31). Pods that don't
+    tolerate `taints` are dropped from the encoding (caller routes them to
+    another NodePool).
+    """
+    if taints:
+        pods = [p for p in pods if tolerates_all(p.tolerations, taints)]
+    groups = group_pods(pods)
+
+    req_vecs = [g.representative.requests.to_vector() for g in groups]
+    R = num_resources()
+    G = len(groups)
+    requests = np.zeros((G, R), np.float32)
+    for i, v in enumerate(req_vecs):
+        requests[i, : len(v)] = v
+
+    counts = np.array([g.count for g in groups], np.int32) if G else np.zeros(0, np.int32)
+    compat = np.ones((G, cat.T), bool)
+    allow_zone = np.ones((G, cat.Z), bool)
+    allow_cap = np.ones((G, cat.C), bool)
+    max_per_node = np.zeros(G, np.int32)
+    spread_zone = np.zeros(G, bool)
+
+    for i, g in enumerate(groups):
+        reqs = g.representative.scheduling_requirements()
+        if extra_requirements is not None:
+            reqs = reqs.union_with(extra_requirements)
+        compat[i] = compat_mask(reqs, cat)
+        allow_zone[i] = _axis_allow(reqs, L.ZONE, cat.zones)
+        allow_cap[i] = _axis_allow(reqs, L.CAPACITY_TYPE, cat.captypes)
+        if g.representative.has_self_anti_affinity():
+            max_per_node[i] = 1
+        for tsc in g.representative.topology_spread:
+            if tsc.topology_key == L.ZONE and tsc.when_unsatisfiable == "DoNotSchedule":
+                spread_zone[i] = True
+            if tsc.topology_key == L.HOSTNAME and tsc.when_unsatisfiable == "DoNotSchedule":
+                # Conservative encoding of hostname maxSkew as a per-node
+                # cap: while any eligible node has zero matching pods (always
+                # true the moment the provisioner opens a fresh node), skew =
+                # max-count − 0, so count per node may not exceed maxSkew.
+                # This can over-spread relative to a cluster with no empty
+                # eligible nodes (where k8s would allow denser layouts) but
+                # never violates the constraint.
+                cap = max(1, tsc.max_skew)
+                max_per_node[i] = cap if max_per_node[i] == 0 else min(max_per_node[i], cap)
+
+    return EncodedPods(groups=groups, requests=requests, counts=counts,
+                       compat=compat, allow_zone=allow_zone, allow_cap=allow_cap,
+                       max_per_node=max_per_node, spread_zone=spread_zone)
+
+
+def align_resources(alloc: np.ndarray, R: int) -> np.ndarray:
+    """Zero-pad the catalog's [T, R_cat] allocatable to R columns.
+
+    The resource axis can grow between catalog encoding (cached on device)
+    and pod encoding (auto-registers custom resources). Zero capacity for the
+    new columns is the correct semantics: a type whose catalog entry predates
+    the resource offers none of it, so pods requesting it can't fit there.
+    """
+    if alloc.shape[1] >= R:
+        return alloc
+    pad = np.zeros((alloc.shape[0], R - alloc.shape[1]), alloc.dtype)
+    return np.concatenate([alloc, pad], axis=1)

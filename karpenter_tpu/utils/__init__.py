@@ -1,0 +1,4 @@
+from .cache import TTLCache
+from .clock import Clock, FakeClock, RealClock
+
+__all__ = ["TTLCache", "Clock", "FakeClock", "RealClock"]

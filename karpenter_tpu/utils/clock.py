@@ -1,0 +1,30 @@
+"""Injectable clock (reference uses k8s.io/utils/clock the same way; the
+fake clock drives TTL/expiry behavior in tests deterministically)."""
+
+from __future__ import annotations
+
+import time
+from typing import Protocol
+
+
+class Clock(Protocol):
+    def now(self) -> float: ...
+
+
+class RealClock:
+    def now(self) -> float:
+        return time.time()
+
+
+class FakeClock:
+    def __init__(self, start: float = 1_000_000.0):
+        self._t = start
+
+    def now(self) -> float:
+        return self._t
+
+    def step(self, seconds: float) -> None:
+        self._t += seconds
+
+    def set(self, t: float) -> None:
+        self._t = t

@@ -1,0 +1,102 @@
+import numpy as np
+
+from karpenter_tpu.catalog import (CatalogProvider, GeneratorConfig,
+                                   UnavailableOfferings, generate_catalog,
+                                   small_catalog)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodeClassSpec
+from karpenter_tpu.models.resources import CPU, MEMORY, PODS
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def test_catalog_scale():
+    cat = generate_catalog()
+    # EC2-scale: the reference paginates ~850 types (instancetype.go:239-252)
+    assert 700 <= len(cat) <= 1000
+    names = {t.name for t in cat}
+    assert len(names) == len(cat)  # unique names
+
+
+def test_catalog_shapes():
+    cat = generate_catalog()
+    by_name = {t.name: t for t in cat}
+    m = by_name["m5.xlarge"]
+    assert m.capacity[CPU] == 4.0
+    # memory: 16 GiB minus 7.5% VM overhead
+    assert abs(m.capacity[MEMORY] - 16 * 2**30 * 0.925) < 1e6
+    assert m.capacity[PODS] == 58
+    alloc = m.allocatable()
+    assert alloc[CPU] < 4.0  # kube-reserved subtracted
+    assert alloc[MEMORY] < m.capacity[MEMORY]
+    # requirements carry the label surface
+    assert m.requirements.get(L.INSTANCE_FAMILY).contains("m5")
+    assert m.requirements.get(L.INSTANCE_CPU).contains("4")
+    # offerings exist with spot cheaper than on-demand per zone
+    for z in m.zones():
+        od = [o for o in m.offerings if o.zone == z and o.capacity_type == "on-demand"]
+        sp = [o for o in m.offerings if o.zone == z and o.capacity_type == "spot"]
+        if od and sp:
+            assert sp[0].price < od[0].price
+
+
+def test_catalog_deterministic():
+    a = generate_catalog()
+    b = generate_catalog()
+    assert [t.name for t in a] == [t.name for t in b]
+    assert all(ta.offerings[0].price == tb.offerings[0].price for ta, tb in zip(a, b))
+
+
+def test_gpu_and_accelerator_families():
+    cat = generate_catalog()
+    gpus = [t for t in cat if t.requirements.has(L.INSTANCE_GPU_COUNT)]
+    accels = [t for t in cat if t.requirements.has(L.INSTANCE_ACCELERATOR_COUNT)]
+    assert gpus and accels
+    reserved = [o for t in cat for o in t.offerings if o.capacity_type == "reserved"]
+    assert reserved  # ODCR-style offerings exist
+    assert all(o.reservation_capacity > 0 for o in reserved)
+
+
+def test_small_catalog():
+    cat = small_catalog()
+    assert 10 <= len(cat) <= 40
+
+
+def test_provider_ice_invalidation():
+    clock = FakeClock()
+    ice = UnavailableOfferings(clock=clock)
+    provider = CatalogProvider(lambda: small_catalog(), unavailable=ice, clock=clock)
+    types = provider.list()
+    t0 = types[0]
+    zone = t0.offerings[0].zone
+    ct = t0.offerings[0].capacity_type
+    assert t0.offerings[0].available
+    epoch0 = provider.epoch
+
+    ice.mark_unavailable(t0.name, zone, ct, reason="ICE")
+    types2 = provider.list()
+    assert provider.epoch != epoch0
+    o2 = [o for o in types2[provider_idx(types2, t0.name)].offerings
+          if o.zone == zone and o.capacity_type == ct]
+    assert o2 and not o2[0].available
+
+    # TTL expiry restores availability. Staleness bound: the ICE entry
+    # expires at 3m but the resolved view refreshes on its own 5m TTL
+    # (matching the reference's cache.go SLOs), so step past both.
+    clock.step(400)
+    types3 = provider.list()
+    o3 = [o for o in types3[provider_idx(types3, t0.name)].offerings
+          if o.zone == zone and o.capacity_type == ct]
+    assert o3 and o3[0].available
+
+
+def provider_idx(types, name):
+    return next(i for i, t in enumerate(types) if t.name == name)
+
+
+def test_nodeclass_zone_filter():
+    provider = CatalogProvider(lambda: small_catalog())
+    nc = NodeClassSpec(name="one-zone", zones=["zone-a"])
+    types = provider.list(nc)
+    assert types
+    for t in types:
+        assert all(o.zone == "zone-a" for o in t.offerings)

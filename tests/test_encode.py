@@ -1,0 +1,131 @@
+import numpy as np
+
+from karpenter_tpu.catalog import generate_catalog, small_catalog
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import (Pod, PodAffinityTerm, Toleration,
+                                      TopologySpreadConstraint)
+from karpenter_tpu.models.pod import Taint
+from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                               Requirements)
+from karpenter_tpu.models.resources import CPU, Resources, resource_index
+from karpenter_tpu.ops.encode import (compat_mask, encode_catalog, encode_pods,
+                                      group_pods)
+
+
+def mk_pod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(name=name, requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+class TestEncodeCatalog:
+    def setup_method(self):
+        self.types = generate_catalog()
+        self.cat = encode_catalog(self.types)
+
+    def test_shapes(self):
+        T, Z, C = self.cat.T, self.cat.Z, self.cat.C
+        assert T == len(self.types) and Z == 3 and C == 3
+        assert self.cat.allocatable.shape[0] == T
+        assert self.cat.price.shape == (T, Z, C)
+        assert self.cat.available.shape == (T, Z, C)
+
+    def test_price_matches_offerings(self):
+        t5 = self.types[5]
+        i = self.cat.name_to_idx[t5.name]
+        for o in t5.offerings:
+            zi = self.cat.zones.index(o.zone)
+            ci = self.cat.captypes.index(o.capacity_type)
+            assert self.cat.price[i, zi, ci] == np.float32(o.price)
+            assert self.cat.available[i, zi, ci] == o.available
+        # non-offered combos are +inf / unavailable
+        assert np.isinf(self.cat.price[i][~self.cat.available[i]]).all()
+
+    def test_allocatable_matches_model(self):
+        t0 = self.types[0]
+        i = self.cat.name_to_idx[t0.name]
+        cpu = self.cat.allocatable[i, resource_index(CPU)]
+        assert abs(cpu - t0.allocatable()[CPU]) < 1e-3
+
+    def test_compat_mask_oracle_agreement(self):
+        """Vectorized compat must agree with the exact set-algebra on a
+        spread of requirement shapes (this pins the encoder to the
+        Requirements oracle)."""
+        cases = [
+            Requirements(Requirement(L.INSTANCE_FAMILY, Operator.IN, ("m5", "c5"))),
+            Requirements(Requirement(L.ARCH, Operator.IN, ("arm64",))),
+            Requirements(Requirement(L.INSTANCE_CPU, Operator.GT, ("8",))),
+            Requirements(Requirement(L.INSTANCE_CPU, Operator.GT, ("4",)),
+                         Requirement(L.INSTANCE_CPU, Operator.LT, ("64",))),
+            Requirements(Requirement(L.INSTANCE_GPU_COUNT, Operator.EXISTS)),
+            Requirements(Requirement(L.INSTANCE_GPU_COUNT, Operator.DOES_NOT_EXIST)),
+            Requirements(Requirement(L.INSTANCE_LOCAL_NVME, Operator.NOT_IN, ("0",))),
+            Requirements(Requirement(L.INSTANCE_SIZE, Operator.NOT_IN, ("metal",)),
+                         Requirement(L.INSTANCE_CATEGORY, Operator.IN, ("c", "m", "r"))),
+            Requirements(Requirement("nonexistent-key", Operator.IN, ("x",))),
+            Requirements(Requirement("nonexistent-key", Operator.NOT_IN, ("x",))),
+            Requirements(Requirement(L.INSTANCE_MEMORY, Operator.GT, ("100000",))),
+        ]
+        for reqs in cases:
+            mask = compat_mask(reqs, self.cat)
+            for i in range(0, self.cat.T, 37):  # sample types
+                expected = reqs.compatible(self.types[i].requirements)
+                assert mask[i] == expected, (
+                    f"{reqs} vs {self.types[i].name}: mask={mask[i]} exact={expected}")
+
+
+class TestEncodePods:
+    def setup_method(self):
+        self.types = small_catalog()
+        self.cat = encode_catalog(self.types)
+
+    def test_grouping_dedupes(self):
+        pods = [mk_pod(f"a-{i}") for i in range(50)] + \
+               [mk_pod(f"b-{i}", cpu="2") for i in range(30)]
+        groups = group_pods(pods)
+        assert len(groups) == 2
+        # FFD order: bigger cpu first
+        assert groups[0].count == 30 and groups[1].count == 50
+
+    def test_encoded_fields(self):
+        pods = ([mk_pod(f"a-{i}") for i in range(10)] +
+                [mk_pod(f"z-{i}", node_selector={L.ZONE: "zone-b"}) for i in range(5)] +
+                [mk_pod(f"s-{i}", node_affinity=[
+                    {"key": L.CAPACITY_TYPE, "operator": "In", "values": ["spot"]}])
+                 for i in range(3)])
+        enc = encode_pods(pods, self.cat)
+        assert enc.G == 3
+        assert enc.counts.sum() == 18
+        for i, g in enumerate(enc.groups):
+            rep = g.representative
+            if rep.name.startswith("z"):
+                assert enc.allow_zone[i].tolist() == [z == "zone-b" for z in self.cat.zones]
+            if rep.name.startswith("s"):
+                assert enc.allow_cap[i].tolist() == [c == "spot" for c in self.cat.captypes]
+
+    def test_taints_filter(self):
+        taints = [Taint(key="dedicated", value="ml", effect="NoSchedule")]
+        pods = [mk_pod("plain"),
+                mk_pod("tolerant", tolerations=[Toleration(key="dedicated", operator="Exists")])]
+        enc = encode_pods(pods, self.cat, taints=taints)
+        assert enc.G == 1
+        assert enc.groups[0].representative.name == "tolerant"
+
+    def test_nodepool_requirements_layered(self):
+        extra = Requirements(Requirement(L.INSTANCE_FAMILY, Operator.IN, ("m5",)))
+        enc = encode_pods([mk_pod("p")], self.cat, extra_requirements=extra)
+        m5 = [i for i, n in enumerate(self.cat.names) if n.startswith("m5.")]
+        not_m5 = [i for i, n in enumerate(self.cat.names) if not n.startswith("m5.")]
+        assert enc.compat[0, m5].all()
+        assert not enc.compat[0, not_m5].any()
+
+    def test_anti_affinity_and_spread(self):
+        anti = mk_pod("anti", labels={"app": "x"},
+                      affinity_terms=[PodAffinityTerm(
+                          topology_key="kubernetes.io/hostname",
+                          label_selector={"app": "x"}, anti=True)])
+        spread = mk_pod("spread", topology_spread=[TopologySpreadConstraint(
+            topology_key=L.ZONE, max_skew=1)])
+        enc = encode_pods([anti, spread], self.cat)
+        by_name = {g.representative.name: i for i, g in enumerate(enc.groups)}
+        assert enc.max_per_node[by_name["anti"]] == 1
+        assert enc.spread_zone[by_name["spread"]]
+        assert enc.max_per_node[by_name["spread"]] == 0

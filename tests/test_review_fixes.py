@@ -87,3 +87,83 @@ def test_unknown_resource_auto_registered_in_vector():
     assert vec[idx] == 1.0
     # round-trips
     assert Resources.from_vector(vec)["amd.com/gpu"] == 1.0
+
+
+# --- round 2 review findings (catalog/encoder) ---
+
+def test_conflict_distinct_from_does_not_exist():
+    """In{a} ∩ In{b} is an unsatisfiable conflict, not DoesNotExist."""
+    conflict = Requirements(Requirement("k", Operator.IN, ("a",)))
+    conflict.add(Requirement("k", Operator.IN, ("b",)))
+    vs = conflict.get("k")
+    assert vs.is_conflict() and not vs.is_does_not_exist()
+    # conflict matches nothing — not even absence
+    assert not conflict.compatible(Requirements())
+    assert not conflict.compatible(Requirements.from_labels({"k": "a"}))
+    assert not conflict.labels_satisfy({})
+    # a real DoesNotExist still accepts absence
+    dne = Requirements(Requirement("k", Operator.DOES_NOT_EXIST))
+    assert dne.compatible(Requirements())
+    assert dne.labels_satisfy({})
+    # DoesNotExist ∩ NotIn stays DoesNotExist; ∩ In becomes conflict
+    d = dne.copy()
+    d.add(Requirement("k", Operator.NOT_IN, ("x",)))
+    assert d.get("k").is_does_not_exist()
+    d2 = dne.copy()
+    d2.add(Requirement("k", Operator.IN, ("x",)))
+    assert d2.get("k").is_conflict()
+
+
+def test_provider_epoch_tracks_pricing_and_reservations():
+    from karpenter_tpu.catalog import CatalogProvider, small_catalog
+    prov = CatalogProvider(lambda: small_catalog())
+    types = prov.list()
+    e0 = prov.epoch
+    # reservation bookkeeping bumps epoch and is reflected in list()
+    reserved = [(t, o) for t in types for o in t.offerings if o.reservation_id]
+    if reserved:
+        t, o = reserved[0]
+        for _ in range(o.reservation_capacity):
+            prov.mark_reservation_launched(o.reservation_id, o.reservation_capacity)
+        assert prov.epoch != e0
+        types2 = prov.list()
+        o2 = [x for tt in types2 for x in tt.offerings
+              if x.reservation_id == o.reservation_id][0]
+        assert not o2.available and o2.reservation_capacity == 0
+    # spot price update bumps epoch and changes prices
+    e1 = prov.epoch
+    name = types[0].name
+    zone = types[0].offerings[0].zone
+    prov.pricing.update_spot({(name, zone): 0.0123})
+    assert prov.epoch != e1
+    types3 = prov.list()
+    spot = [o for o in types3[0].offerings
+            if o.zone == zone and o.capacity_type == "spot"]
+    if spot:
+        assert spot[0].price == 0.0123
+
+
+def test_multi_nodeclass_caching():
+    from karpenter_tpu.catalog import CatalogProvider, small_catalog
+    from karpenter_tpu.models.nodepool import NodeClassSpec
+    calls = {"n": 0}
+    def lister():
+        calls["n"] += 1
+        return small_catalog()
+    prov = CatalogProvider(lister)
+    a = NodeClassSpec(name="a", zones=["zone-a"])
+    b = NodeClassSpec(name="b", zones=["zone-b"])
+    ra1, rb1 = prov.list(a), prov.list(b)
+    ra2, rb2 = prov.list(a), prov.list(b)
+    assert ra1 is ra2 and rb1 is rb2  # both views cached simultaneously
+    assert calls["n"] == 1  # raw catalog fetched once
+
+
+def test_align_resources():
+    import numpy as np
+    from karpenter_tpu.ops.encode import align_resources
+    alloc = np.ones((4, 3), np.float32)
+    out = align_resources(alloc, 5)
+    assert out.shape == (4, 5)
+    assert (out[:, 3:] == 0).all()
+    assert align_resources(alloc, 3) is alloc
