@@ -1,0 +1,270 @@
+"""Host bin-packing oracle — the correctness reference for the TPU kernel.
+
+Semantics (the `Solve()` policy both backends implement; reference behavior:
+designs/bin-packing.md:18-42 — sort pods by size desc, first-fit into
+in-flight nodes, else open a new virtual node; launch picks the cheapest
+offering):
+
+ 1. Pods are exact-dedupe grouped and FFD-ordered (encode.group_pods).
+ 2. Each pod first-fits into open nodes in creation order. A node accepts a
+    pod iff the node's committed instance type is compatible with the pod's
+    requirements, remaining allocatable covers the request, the node's
+    deferred (zone, capacity-type) masks still intersect the pod's, at least
+    one available offering survives the intersection, and the group's
+    per-node cap (anti-affinity / hostname spread) is not exceeded.
+ 3. If no node fits, a new node opens committed to the instance type
+    minimizing price-per-pod-slot over all available (type, zone, captype)
+    offerings compatible with the pod — the cost-argmin. Zone and capacity
+    type remain deferred rectangular masks; the launch step later picks the
+    cheapest surviving offering (reserved offerings are priced ~0 by the
+    catalog, so price-argmin reproduces the reference's reserved→spot→od
+    preference, instance.go:530-546).
+ 4. Zone topology-spread groups are pre-split into zone-pinned subgroups by
+    `split_spread_groups` before either backend runs.
+
+Design note (TPU-first): committing the node's type at open (instead of the
+reference's deferred multi-type nodes) keeps the device state rectangular —
+type id + cum requests + zone/captype masks — which is what makes the group
+scan a fixed-shape `lax.scan` with O(N·T) work per step and no ragged
+structures. The cost is occasionally one extra node vs deferred-type FFD;
+the benchmark grid tracks node-count parity.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .encode import CatalogTensors, EncodedPods, align_resources
+
+BIG = 10**9
+
+
+@dataclass
+class VirtualNode:
+    type_idx: int
+    zone_mask: np.ndarray      # bool [Z] — deferred zone choice
+    cap_mask: np.ndarray       # bool [C]
+    cum: np.ndarray            # f32 [R]
+    pods_by_group: Dict[int, int] = field(default_factory=dict)
+    existing_name: Optional[str] = None  # set for in-flight/live nodes
+
+    def pod_count(self) -> int:
+        return sum(self.pods_by_group.values())
+
+
+@dataclass
+class SolveResult:
+    nodes: List[VirtualNode]
+    unschedulable: Dict[int, int]  # group idx -> count
+    # resolved launch decisions (filled by finalize_offerings)
+    launches: List[Tuple[int, int, int, float]] = field(default_factory=list)
+    # (type_idx, zone_idx, cap_idx, price) per *new* node
+
+    def new_nodes(self) -> List[VirtualNode]:
+        return [n for n in self.nodes if n.existing_name is None]
+
+
+def split_spread_groups(enc: EncodedPods, cat: CatalogTensors) -> EncodedPods:
+    """Expand zone-topology-spread groups into per-zone pinned subgroups with
+    balanced counts (skew ≤ 1 ≤ maxSkew). Host-side transformation so the
+    kernels never see spread constraints — only zone-pinned groups.
+
+    v1 scope: balances each group against itself (greenfield provisioning;
+    existing domain counts are handled by the provisioner passing current
+    zone occupancy as `zone_offset` in a later round).
+    """
+    idx_keep = [i for i in range(enc.G) if not enc.spread_zone[i]]
+    if len(idx_keep) == enc.G:
+        return enc
+    rows = {"requests": [], "counts": [], "compat": [], "allow_zone": [],
+            "allow_cap": [], "max_per_node": [], "spread_zone": []}
+    groups = []
+
+    def push(i, count, zone_row):
+        groups.append(enc.groups[i])
+        rows["requests"].append(enc.requests[i])
+        rows["counts"].append(count)
+        rows["compat"].append(enc.compat[i])
+        rows["allow_zone"].append(zone_row)
+        rows["allow_cap"].append(enc.allow_cap[i])
+        rows["max_per_node"].append(enc.max_per_node[i])
+        rows["spread_zone"].append(False)
+
+    for i in range(enc.G):
+        if not enc.spread_zone[i]:
+            push(i, int(enc.counts[i]), enc.allow_zone[i])
+            continue
+        zones = np.flatnonzero(enc.allow_zone[i])
+        if len(zones) == 0:
+            push(i, int(enc.counts[i]), enc.allow_zone[i])
+            continue
+        total = int(enc.counts[i])
+        base, extra = divmod(total, len(zones))
+        for j, z in enumerate(zones):
+            cnt = base + (1 if j < extra else 0)
+            if cnt == 0:
+                continue
+            row = np.zeros(cat.Z, bool)
+            row[z] = True
+            push(i, cnt, row)
+
+    from .encode import EncodedPods as EP
+    return EP(groups=groups,
+              requests=np.array(rows["requests"], np.float32).reshape(len(groups), -1),
+              counts=np.array(rows["counts"], np.int32),
+              compat=np.array(rows["compat"], bool).reshape(len(groups), -1),
+              allow_zone=np.array(rows["allow_zone"], bool).reshape(len(groups), -1),
+              allow_cap=np.array(rows["allow_cap"], bool).reshape(len(groups), -1),
+              max_per_node=np.array(rows["max_per_node"], np.int32),
+              spread_zone=np.array(rows["spread_zone"], bool))
+
+
+EPS = np.float32(1e-4)  # f32 division slack; shared with the device kernel
+
+
+def _fit_count(alloc_t: np.ndarray, cum: np.ndarray, req: np.ndarray) -> int:
+    """Additional pods of `req` fitting in `alloc_t - cum` (f32 math, same
+    expression as the kernel's k_cap so the two backends agree bitwise)."""
+    with_req = np.where(req > 0, req, np.float32(1.0))
+    k = np.where(req > 0,
+                 np.floor((alloc_t - cum) / with_req + EPS),
+                 np.float32(BIG)).min()
+    return int(max(k, 0.0))
+
+
+def solve_host(cat: CatalogTensors, enc: EncodedPods,
+               existing: Optional[List[VirtualNode]] = None) -> SolveResult:
+    """Group-level first-fit-decreasing with the policy above — equivalent
+    to per-pod FFD since pods within a group are interchangeable. Sequential
+    and deliberately simple: this is the oracle the TPU kernel must agree
+    with exactly (same f32 expressions, same argmin tie-breaks).
+
+    `enc` must already be spread-free (callers run split_spread_groups
+    first, so result group indices match the enc they hold). Existing nodes
+    are copied, not mutated.
+    """
+    assert not enc.spread_zone.any(), "run split_spread_groups before solve"
+    R = enc.requests.shape[1]
+    alloc = align_resources(cat.allocatable, R)
+    avail = cat.available  # [T, Z, C]
+    price = cat.price
+
+    nodes: List[VirtualNode] = [
+        VirtualNode(type_idx=n.type_idx, zone_mask=n.zone_mask.copy(),
+                    cap_mask=n.cap_mask.copy(),
+                    cum=np.pad(n.cum, (0, max(0, R - len(n.cum)))).astype(np.float32),
+                    pods_by_group=dict(n.pods_by_group),
+                    existing_name=n.existing_name)
+        for n in (existing or [])]
+    unschedulable: Dict[int, int] = {}
+
+    for g in range(enc.G):
+        req = enc.requests[g].astype(np.float32)
+        cap_per_node = int(enc.max_per_node[g]) or BIG
+        rem = int(enc.counts[g])
+        # 1. fill open nodes in index order (first-fit)
+        for n in nodes:
+            if rem == 0:
+                break
+            t = n.type_idx
+            if not enc.compat[g, t]:
+                continue
+            zmask = n.zone_mask & enc.allow_zone[g]
+            cmask = n.cap_mask & enc.allow_cap[g]
+            if not (avail[t] & zmask[:, None] & cmask[None, :]).any():
+                continue
+            take = min(_fit_count(alloc[t], n.cum, req), cap_per_node, rem)
+            if take < 1:
+                continue
+            n.cum = n.cum + np.float32(take) * req
+            n.zone_mask = zmask
+            n.cap_mask = cmask
+            n.pods_by_group[g] = n.pods_by_group.get(g, 0) + take
+            rem -= take
+        if rem == 0:
+            continue
+        # 2. open new nodes at the cost-per-slot argmin offering, identical
+        #    f32 arithmetic + flat-argmin tie-break as the kernel
+        adm = (avail & enc.compat[g][:, None, None]
+               & enc.allow_zone[g][None, :, None]
+               & enc.allow_cap[g][None, None, :])
+        with_req = np.where(req > 0, req, np.float32(1.0))
+        slots_t = np.where(req[None, :] > 0,
+                           np.floor(alloc / with_req[None, :] + EPS),
+                           np.float32(BIG)).min(axis=1)
+        slots_t = np.minimum(np.maximum(slots_t, 0.0).astype(np.int64), cap_per_node)
+        feasible = adm & (slots_t[:, None, None] >= 1)
+        cps = np.where(feasible,
+                       price / np.maximum(slots_t, 1)[:, None, None].astype(np.float32),
+                       np.float32(np.finfo(np.float32).max))
+        flat = int(np.argmin(cps.reshape(-1)))
+        if cps.reshape(-1)[flat] >= np.finfo(np.float32).max:
+            unschedulable[g] = unschedulable.get(g, 0) + rem
+            continue
+        t_star = flat // (cat.Z * cat.C)
+        s = max(int(slots_t[t_star]), 1)
+        zmask_new = enc.allow_zone[g] & avail[t_star].any(axis=1)
+        cmask_new = enc.allow_cap[g] & avail[t_star].any(axis=0)
+        while rem > 0:
+            take = min(s, rem)
+            nodes.append(VirtualNode(
+                type_idx=t_star, zone_mask=zmask_new.copy(),
+                cap_mask=cmask_new.copy(),
+                cum=np.float32(take) * req,
+                pods_by_group={g: take}))
+            rem -= take
+
+    result = SolveResult(nodes=nodes, unschedulable=unschedulable)
+    finalize_offerings(result, cat)
+    return result
+
+
+def finalize_offerings(result: SolveResult, cat: CatalogTensors) -> None:
+    """Pick the cheapest surviving (zone, captype) for each new node —
+    the launch decision (reference launch path picks cheapest via
+    CreateFleet's lowest-price strategy over the override list)."""
+    result.launches = []
+    for n in result.new_nodes():
+        t = n.type_idx
+        masked = np.where(n.zone_mask[:, None] & n.cap_mask[None, :] & cat.available[t],
+                          cat.price[t], np.inf)
+        zi, ci = np.unravel_index(np.argmin(masked), masked.shape)
+        result.launches.append((t, int(zi), int(ci), float(masked[zi, ci])))
+
+
+def validate_solution(cat: CatalogTensors, enc: EncodedPods,
+                      result: SolveResult) -> List[str]:
+    """Independent feasibility audit of a solve result (used by tests and
+    the race-free double-check in the provisioner): every placement must be
+    compatible, within capacity, and launchable on an available offering."""
+    errors = []
+    R = enc.requests.shape[1]
+    alloc = align_resources(cat.allocatable, R)
+    placed_per_group: Dict[int, int] = {}
+    for idx, n in enumerate(result.nodes):
+        t = n.type_idx
+        for g, cnt in n.pods_by_group.items():
+            placed_per_group[g] = placed_per_group.get(g, 0) + cnt
+            if not enc.compat[g, t]:
+                errors.append(f"node {idx}: group {g} incompatible with type {cat.names[t]}")
+            if enc.max_per_node[g] and cnt > enc.max_per_node[g]:
+                errors.append(f"node {idx}: group {g} count {cnt} > cap {enc.max_per_node[g]}")
+            if not (n.zone_mask & enc.allow_zone[g]).any():
+                errors.append(f"node {idx}: group {g} zone constraint violated")
+            if not (n.cap_mask & enc.allow_cap[g]).any():
+                errors.append(f"node {idx}: group {g} capacity-type constraint violated")
+        # final cum (prior occupancy + this solve) must fit the committed type
+        if np.any(n.cum[: alloc.shape[1]] > alloc[t] + 2e-3):
+            errors.append(f"node {idx}: over capacity on {cat.names[t]}")
+        if not (cat.available[t] & n.zone_mask[:, None] & n.cap_mask[None, :]).any():
+            errors.append(f"node {idx}: no available offering survives masks")
+    for g in range(enc.G):
+        want = int(enc.counts[g])
+        got = placed_per_group.get(g, 0) + result.unschedulable.get(g, 0)
+        if got != want:
+            errors.append(f"group {g}: {got} accounted != {want} pods")
+    return errors

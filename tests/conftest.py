@@ -1,12 +1,19 @@
 """Test harness: run JAX on a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware isn't available in CI; sharding correctness is
-validated on host devices (same XLA partitioner). Must run before jax import.
+validated on host devices (same XLA partitioner). The environment's
+sitecustomize imports jax at interpreter start with JAX_PLATFORMS=axon
+(a tunneled remote TPU with ~70ms/transfer RTT — far too slow for a test
+suite), so plain env vars are too late; jax.config.update still works
+because no backend has been initialized yet when conftest runs.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
