@@ -1,0 +1,87 @@
+"""Benchmark: the north-star metric on real hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline (BASELINE.json): p50 Solve() latency for 100k pending pods against
+the full synthetic catalog (~850 types x 3 zones x 3 capacity types) on the
+attached TPU. vs_baseline = speedup over the in-process host FFD solver
+(the reference implements Solve as in-process first-fit-decreasing; our
+host oracle is the same algorithm, numpy-vectorized — a *strong* baseline).
+
+Sub-benchmarks for the BASELINE.md grid are included in the "detail" field.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+
+def timeit(fn, repeats=5):
+    vals = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        vals.append(time.perf_counter() - t0)
+    return statistics.median(vals)
+
+
+def main() -> None:
+    from karpenter_tpu.catalog import generate_catalog, small_catalog
+    from karpenter_tpu.models.pod import Pod
+    from karpenter_tpu.models.resources import Resources
+    from karpenter_tpu.ops.binpack import solve_host
+    from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+    from karpenter_tpu.ops.solver import solve_device
+
+    detail = {}
+
+    shapes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"),
+              ("2", "4Gi"), ("4", "16Gi"), ("500m", "4Gi"),
+              ("1", "8Gi"), ("250m", "1Gi")]
+
+    def mk_pods(n):
+        return [Pod(name=f"p{i}",
+                    requests=Resources.parse({"cpu": shapes[i % len(shapes)][0],
+                                              "memory": shapes[i % len(shapes)][1]}))
+                for i in range(n)]
+
+    # --- config 1: kwok-scale, 500 pods, small catalog ---
+    cat_small = encode_catalog(small_catalog())
+    enc500 = encode_pods(mk_pods(500), cat_small)
+    solve_device(cat_small, enc500)  # compile
+    detail["c1_500pod_small_ms"] = round(timeit(lambda: solve_device(cat_small, enc500)) * 1e3, 1)
+
+    # --- config 2 + headline: 10k / 100k pods, full catalog ---
+    cat = encode_catalog(generate_catalog())
+    enc10k = encode_pods(mk_pods(10_000), cat)
+    solve_device(cat, enc10k)
+    detail["c2_10k_full_ms"] = round(timeit(lambda: solve_device(cat, enc10k)) * 1e3, 1)
+
+    pods100k = mk_pods(100_000)
+    t0 = time.perf_counter()
+    enc100k = encode_pods(pods100k, cat)
+    detail["c5_encode_100k_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    solve_device(cat, enc100k)
+    tpu_s = timeit(lambda: solve_device(cat, enc100k))
+    detail["c5_100k_full_ms"] = round(tpu_s * 1e3, 1)
+
+    host_s = timeit(lambda: solve_host(cat, enc100k), repeats=3)
+    detail["host_ffd_100k_ms"] = round(host_s * 1e3, 1)
+    detail["pods_per_sec"] = round(100_000 / tpu_s)
+
+    result = {
+        "metric": "p50 Solve() latency, 100k pods x full catalog",
+        "value": round(tpu_s * 1e3, 1),
+        "unit": "ms",
+        "vs_baseline": round(host_s / tpu_s, 2),
+        "detail": detail,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

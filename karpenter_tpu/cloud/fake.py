@@ -1,0 +1,218 @@
+"""Fake cloud — the kwok-equivalent simulation backend.
+
+Runs the REAL provider/controller code against an in-memory cloud, like the
+reference's kwok stack (kwok/ec2/ec2.go): stateful instances, CreateFleet
+that picks the lowest-price override (kwok/strategy/strategy.go:28-45),
+simulated Node materialization after a boot delay, finite capacity pools
+for ICE injection (pkg/fake/ec2api.go CapacityPool:41), per-API token-bucket
+rate limits (kwok/ec2/ratelimiting.go:86-135), a kill-instance chaos hook
+(kwok/ec2/ec2.go:253-282), and snapshot/restore state persistence
+(ec2.go:118-236).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..models import labels as L
+from ..models.instancetype import InstanceType
+from ..models.nodeclaim import Node
+from ..models.resources import Resources
+from ..utils.clock import Clock, RealClock
+from .provider import (CloudError, Instance, InsufficientCapacityError,
+                       LaunchRequest, NotFoundError, RateLimitedError)
+
+_ids = itertools.count(1)
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: int, clock: Clock):
+        self.rate, self.burst, self.clock = rate, burst, clock
+        self.tokens = float(burst)
+        self.last = clock.now()
+
+    def allow(self, n: int = 1) -> bool:
+        now = self.clock.now()
+        self.tokens = min(self.burst, self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+@dataclass
+class FakeCloudConfig:
+    node_ready_delay: float = 2.0     # seconds from launch to Ready node
+    register_delay: float = 1.0       # launch -> node object exists
+    create_fleet_rate: float = 50.0   # calls/sec token refill
+    create_fleet_burst: int = 100
+    unlimited_capacity: bool = True   # pools default to infinite
+
+
+class FakeCloud:
+    """In-memory cloud + node simulator."""
+
+    def __init__(self, types: List[InstanceType],
+                 clock: Optional[Clock] = None,
+                 config: Optional[FakeCloudConfig] = None):
+        self.clock = clock or RealClock()
+        self.config = config or FakeCloudConfig()
+        self.types: Dict[str, InstanceType] = {t.name: t for t in types}
+        self.instances: Dict[str, Instance] = {}
+        # finite capacity per (type, zone, captype); absent = unlimited when
+        # config.unlimited_capacity else 0
+        self.capacity_pools: Dict[Tuple[str, str, str], int] = {}
+        self._bucket = TokenBucket(self.config.create_fleet_rate,
+                                   self.config.create_fleet_burst, self.clock)
+        self.on_node_ready: List[Callable[[Node], None]] = []
+        self.on_node_created: List[Callable[[Node], None]] = []
+        self._nodes_created: Dict[str, Node] = {}
+        self.api_calls: Dict[str, int] = {"create_fleet": 0, "terminate": 0,
+                                          "describe": 0}
+        self.interruptions: List[dict] = []  # queued interruption events
+
+    # --- capacity pool control (tests / chaos) ---
+    def set_capacity(self, instance_type: str, zone: str, capacity_type: str,
+                     count: int) -> None:
+        self.capacity_pools[(instance_type, zone, capacity_type)] = count
+
+    def _take_capacity(self, key: Tuple[str, str, str]) -> bool:
+        if key not in self.capacity_pools:
+            return self.config.unlimited_capacity
+        if self.capacity_pools[key] > 0:
+            self.capacity_pools[key] -= 1
+            return True
+        return False
+
+    def _return_capacity(self, key: Tuple[str, str, str]) -> None:
+        if key in self.capacity_pools:
+            self.capacity_pools[key] += 1
+
+    # --- CloudProvider API ---
+    def create_fleet(self, requests: List[LaunchRequest]) -> List["Instance | CloudError"]:
+        self.api_calls["create_fleet"] += 1
+        if not self._bucket.allow():
+            raise RateLimitedError("CreateFleet throttled")
+        out: List["Instance | CloudError"] = []
+        for req in requests:
+            out.append(self._launch_one(req))
+        return out
+
+    def _launch_one(self, req: LaunchRequest) -> "Instance | CloudError":
+        exhausted = []
+        # lowest-price strategy over the override list
+        for ov in sorted(req.overrides, key=lambda o: o.price):
+            key = (ov.instance_type, ov.zone, ov.capacity_type)
+            if ov.instance_type not in self.types:
+                continue
+            if not self._take_capacity(key):
+                exhausted.append(key)
+                continue
+            inst = Instance(
+                id=f"i-{next(_ids):08d}", instance_type=ov.instance_type,
+                zone=ov.zone, capacity_type=ov.capacity_type,
+                image_id=req.image_id, state="pending",
+                launch_time=self.clock.now(), tags=dict(req.tags),
+                price=ov.price, nodeclaim=req.nodeclaim_name)
+            self.instances[inst.id] = inst
+            return inst
+        return InsufficientCapacityError(exhausted or
+                                         [(o.instance_type, o.zone, o.capacity_type)
+                                          for o in req.overrides])
+
+    def terminate(self, instance_ids: List[str]) -> None:
+        self.api_calls["terminate"] += 1
+        for iid in instance_ids:
+            inst = self.instances.get(iid)
+            if inst and inst.state != "terminated":
+                inst.state = "terminated"
+                self._return_capacity((inst.instance_type, inst.zone,
+                                       inst.capacity_type))
+
+    def describe_types(self) -> List[InstanceType]:
+        """DescribeInstanceTypes analog — the catalog provider's backend."""
+        return list(self.types.values())
+
+    def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]:
+        self.api_calls["describe"] += 1
+        if instance_ids is None:
+            return [i for i in self.instances.values() if i.state != "terminated"]
+        return [self.instances[i] for i in instance_ids if i in self.instances]
+
+    # --- simulation: node materialization (kwok toNode, ec2.go:884) ---
+    def tick(self) -> List[Node]:
+        """Advance the simulated kubelet side; returns newly created nodes."""
+        now = self.clock.now()
+        created = []
+        for inst in self.instances.values():
+            if inst.state != "pending":
+                continue
+            if now - inst.launch_time >= self.config.register_delay:
+                inst.state = "running"
+                node = self._to_node(inst)
+                self._nodes_created[inst.id] = node
+                created.append(node)
+                for fn in self.on_node_created:
+                    fn(node)
+        for iid, node in list(self._nodes_created.items()):
+            inst = self.instances.get(iid)
+            if inst is None or inst.state == "terminated":
+                continue
+            if not node.ready and now - inst.launch_time >= self.config.node_ready_delay:
+                node.ready = True
+                for fn in self.on_node_ready:
+                    fn(node)
+        return created
+
+    def _to_node(self, inst: Instance) -> Node:
+        it = self.types[inst.instance_type]
+        labels = it.node_labels(inst.zone, inst.capacity_type)
+        return Node(
+            name=f"node-{inst.id}", provider_id=inst.provider_id,
+            labels=labels, capacity=Resources(it.capacity),
+            allocatable=it.allocatable(), ready=False,
+            created_at=self.clock.now())
+
+    # --- chaos (kwok StartKillNodeThread analog) ---
+    def kill_instance(self, instance_id: str, reason: str = "chaos") -> None:
+        inst = self.instances.get(instance_id)
+        if not inst:
+            raise NotFoundError(instance_id)
+        inst.state = "terminated"
+        self.interruptions.append({
+            "kind": "state-change", "instance_id": instance_id,
+            "provider_id": inst.provider_id, "reason": reason,
+            "time": self.clock.now()})
+
+    def send_spot_interruption(self, instance_id: str) -> None:
+        """Queue a 2-minute spot reclaim warning (EventBridge analog)."""
+        inst = self.instances.get(instance_id)
+        if not inst:
+            raise NotFoundError(instance_id)
+        self.interruptions.append({
+            "kind": "spot-interruption", "instance_id": instance_id,
+            "provider_id": inst.provider_id,
+            "instance_type": inst.instance_type, "zone": inst.zone,
+            "capacity_type": inst.capacity_type, "time": self.clock.now()})
+
+    def poll_interruptions(self, max_messages: int = 10) -> List[dict]:
+        """SQS-style receive (messages must be acked with delete_message)."""
+        return self.interruptions[:max_messages]
+
+    def delete_message(self, msg: dict) -> None:
+        if msg in self.interruptions:
+            self.interruptions.remove(msg)
+
+    # --- snapshot / restore (kwok ConfigMap backup analog) ---
+    def snapshot(self) -> dict:
+        return {
+            "instances": {k: vars(v).copy() for k, v in self.instances.items()},
+            "capacity_pools": dict(self.capacity_pools),
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.instances = {k: Instance(**v) for k, v in snap["instances"].items()}
+        self.capacity_pools = dict(snap["capacity_pools"])

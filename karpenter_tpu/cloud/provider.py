@@ -1,0 +1,109 @@
+"""CloudProvider interface + error taxonomy.
+
+The L2 seam (reference: pkg/cloudprovider/cloudprovider.go implements the
+core CloudProvider interface — Create/Delete/Get/List; pkg/errors/errors.go
+classifies AWS errors into the taxonomy the controllers branch on).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Sequence, Tuple
+
+
+@dataclass
+class LaunchOverride:
+    """One (instanceType, zone, capacityType) candidate for a launch —
+    the CreateFleet override row (reference instance.go:420-467)."""
+
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: float
+    reservation_id: Optional[str] = None
+
+
+@dataclass
+class LaunchRequest:
+    nodeclaim_name: str
+    overrides: List[LaunchOverride]
+    image_id: str = "img-default"
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Instance:
+    id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    image_id: str
+    state: str = "pending"  # pending | running | terminated
+    launch_time: float = 0.0
+    tags: Dict[str, str] = field(default_factory=dict)
+    price: float = 0.0
+    nodeclaim: str = ""
+
+    @property
+    def provider_id(self) -> str:
+        return f"tpu:///{self.zone}/{self.id}"
+
+
+# --- error taxonomy (reference pkg/errors/errors.go:68-227) ---
+
+
+class CloudError(Exception):
+    retryable = False
+
+
+class NotFoundError(CloudError):
+    pass
+
+
+class AlreadyExistsError(CloudError):
+    pass
+
+
+class RateLimitedError(CloudError):
+    retryable = True
+
+
+class ServerError(CloudError):
+    retryable = True
+
+
+class UnauthorizedError(CloudError):
+    pass
+
+
+class InsufficientCapacityError(CloudError):
+    """ICE: specific (type, zone, captype) pools had no capacity
+    (reference UnfulfillableCapacity, errors.go:172)."""
+
+    retryable = True
+
+    def __init__(self, offerings: Sequence[Tuple[str, str, str]], msg: str = ""):
+        super().__init__(msg or f"insufficient capacity: {offerings}")
+        self.offerings = list(offerings)
+
+
+class ReservationExceededError(CloudError):
+    retryable = True
+
+    def __init__(self, reservation_id: str):
+        super().__init__(f"reservation {reservation_id} capacity exceeded")
+        self.reservation_id = reservation_id
+
+
+class CloudProvider(Protocol):
+    """The launch/terminate seam controllers speak to."""
+
+    def create_fleet(self, requests: List[LaunchRequest]) -> List["Instance | CloudError"]:
+        """One instance (or error) per request; the cloud picks among each
+        request's overrides (lowest-price strategy, like EC2 Fleet's
+        price-capacity-optimized and kwok's LowestPrice stand-in)."""
+        ...
+
+    def terminate(self, instance_ids: List[str]) -> None: ...
+
+    def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]: ...

@@ -1,0 +1,63 @@
+"""Deterministic reconcile engine.
+
+Controllers implement `reconcile(now) -> requeue_after_seconds`, mirroring
+controller-runtime's Reconcile contract (the reference's 14+ controllers,
+pkg/controllers/controllers.go:67). The engine runs them round-robin on an
+injectable clock, so tests step simulated time; the async runtime
+(controllers/runtime.py) drives the same controllers on wall-clock time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol
+
+
+class Controller(Protocol):
+    name: str
+
+    def reconcile(self, now: float) -> float:
+        """Do one pass; return seconds until the next desired pass."""
+        ...
+
+
+@dataclass
+class Engine:
+    clock: object
+    controllers: List[Controller] = field(default_factory=list)
+    hooks: List[Callable[[float], None]] = field(default_factory=list)
+    _next_run: Dict[str, float] = field(default_factory=dict)
+
+    def add(self, *controllers: Controller) -> "Engine":
+        self.controllers.extend(controllers)
+        return self
+
+    def add_hook(self, fn: Callable[[float], None]) -> "Engine":
+        """Per-tick hook (e.g. FakeCloud.tick)."""
+        self.hooks.append(fn)
+        return self
+
+    def tick(self) -> None:
+        now = self.clock.now()
+        for fn in self.hooks:
+            fn(now)
+        for c in self.controllers:
+            if now >= self._next_run.get(c.name, 0.0):
+                requeue = c.reconcile(now)
+                self._next_run[c.name] = now + max(0.0, requeue)
+
+    def run_for(self, seconds: float, step: float = 0.5) -> None:
+        end = self.clock.now() + seconds
+        while self.clock.now() < end:
+            self.tick()
+            self.clock.step(step)
+
+    def run_until(self, cond: Callable[[], bool], timeout: float = 600.0,
+                  step: float = 0.5) -> bool:
+        end = self.clock.now() + timeout
+        while self.clock.now() < end:
+            self.tick()
+            if cond():
+                return True
+            self.clock.step(step)
+        return cond()

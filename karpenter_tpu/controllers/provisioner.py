@@ -1,0 +1,222 @@
+"""Provisioning controller: pending pods → Solve → NodeClaims → launches.
+
+The core loop (reference: the core provisioner controller batches
+unschedulable pods, runs the scheduling simulation over the instance-type
+catalog, creates NodeClaims, and calls CloudProvider.Create — SURVEY.md
+§2.3/§3.2). TPU-native difference: Solve() is the tensor kernel behind the
+Solver facade; everything else here is lifecycle bookkeeping.
+
+Multi-NodePool: pools are tried in descending weight; pods a pool cannot
+schedule (taints, requirements, limits) fall through to the next pool.
+ICE feedback: launch failures mark (type, zone, captype) unavailable for
+3m (reference instance.go:469-512) and the pods return to pending —
+the next solve avoids the marked offerings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..catalog.provider import CatalogProvider
+from ..cloud.provider import (CloudError, Instance,
+                              InsufficientCapacityError, LaunchOverride,
+                              LaunchRequest)
+from ..models import labels as L
+from ..models.nodeclaim import NodeClaim, Phase, new_nodeclaim_name
+from ..models.nodepool import NodeClassSpec, NodePool
+from ..models.pod import Pod
+from ..models.resources import Resources
+from ..ops.facade import NodeLaunch, Solver, virtual_node_from_claim
+from ..state.store import Store
+
+NOMINATED = "karpenter.tpu/nominated-nodeclaim"
+
+
+@dataclass
+class Provisioner:
+    store: Store
+    solver: Solver
+    cloud: object  # CloudProvider
+    catalog: CatalogProvider
+    name: str = "provisioner"
+    batch_idle: float = 1.0
+    requeue: float = 1.0
+    stats: Dict[str, int] = field(default_factory=lambda: {
+        "solves": 0, "launches": 0, "ice_errors": 0, "unschedulable": 0})
+
+    def reconcile(self, now: float) -> float:
+        pending = [p for p in self.store.pending_pods()
+                   if NOMINATED not in p.annotations]
+        if not pending:
+            return self.requeue
+        remaining: List[Pod] = pending
+        for pool in self.store.nodepools_by_weight():
+            if not remaining:
+                break
+            remaining = self._provision_pool(pool, remaining, now)
+        self.stats["unschedulable"] = len(remaining)
+        for p in remaining:
+            self.store.record_event("pod", f"{p.namespace}/{p.name}",
+                                    "FailedScheduling", "no nodepool could schedule")
+        return self.requeue
+
+    # --- per-pool pass ---
+    def _provision_pool(self, pool: NodePool, pods: List[Pod],
+                        now: float) -> List[Pod]:
+        node_class = self.store.nodeclasses.get(pool.node_class) or NodeClassSpec()
+        if not node_class.ready:
+            return pods  # NodeClass readiness gate (cloudprovider.go:102-111)
+        cat = self.solver.tensors(node_class)
+        # in-flight claims of this pool absorb pods first; their current
+        # pods ride along so anti-affinity caps hold across reconciles
+        existing, existing_pods = [], {}
+        for claim in self.store.nodeclaims_for_pool(pool.name):
+            if claim.is_deleting() or claim.phase == Phase.FAILED:
+                continue
+            vn = virtual_node_from_claim(claim, cat, claim.resource_requests)
+            if vn is not None:
+                existing.append(vn)
+                existing_pods[claim.name] = self._pods_of_claim(claim)
+        out = self.solver.solve(pods, pool, node_class, existing,
+                                existing_pods=existing_pods)
+        self.stats["solves"] += 1
+
+        by_key = {f"{p.namespace}/{p.name}": p for p in pods}
+        # nominate pods placed on in-flight claims
+        for claim_name, keys in out.existing_placements.items():
+            claim = self.store.nodeclaims.get(claim_name)
+            if claim is None:
+                continue
+            for k in keys:
+                self._nominate(by_key[k], claim)
+                claim.resource_requests = claim.resource_requests.add(by_key[k].requests)
+
+        # enforce NodePool limits on new launches
+        usage = self._pool_usage(pool)
+        launches, over_limit_pods, usage = self._filter_by_limits(
+            pool, node_class, out.launches, usage, by_key)
+
+        # limit-aware retry: re-solve rejected pods allowing only types whose
+        # capacity fits the remaining headroom (the reference's scheduler
+        # stops opening over-limit virtual nodes during the simulation)
+        if over_limit_pods and pool.limits:
+            headroom = Resources({k: v - usage.get(k, 0.0)
+                                  for k, v in pool.limits.items()})
+            if all(v > 0 for v in headroom.values()):
+                out2 = self.solver.solve(over_limit_pods, pool, node_class,
+                                         capacity_cap=headroom)
+                by_key2 = {f"{p.namespace}/{p.name}": p for p in over_limit_pods}
+                by_key.update(by_key2)
+                l2, over_limit_pods, usage = self._filter_by_limits(
+                    pool, node_class, out2.launches, usage, by_key2)
+                launches += l2
+                over_limit_pods += [by_key2[k] for k in out2.unschedulable]
+            for p in over_limit_pods:
+                self.store.record_event("nodepool", pool.name, "LimitExceeded",
+                                        f"cannot schedule {p.name}")
+
+        failed_pods = self._launch(pool, node_class, launches, now)
+        leftover = [by_key[k] for k in out.unschedulable] + over_limit_pods + failed_pods
+        return leftover
+
+    def _filter_by_limits(self, pool, node_class, launches_in, usage, by_key):
+        launches: List[NodeLaunch] = []
+        over_limit_pods: List[Pod] = []
+        types = {t.name: t for t in self.catalog.list(node_class)}
+        for launch in launches_in:
+            cap = types[launch.instance_type].capacity if launch.instance_type in types else Resources()
+            if not pool.within_limits(usage, cap):
+                over_limit_pods.extend(by_key[k] for k in launch.pod_keys)
+                continue
+            usage = usage.add(cap)
+            launches.append(launch)
+        return launches, over_limit_pods, usage
+
+    def _pods_of_claim(self, claim: NodeClaim) -> List[Pod]:
+        seen: Dict[int, Pod] = {}
+        for p in self.store.pods.values():
+            if p.annotations.get(NOMINATED) == claim.name:
+                seen[p.uid] = p
+        if claim.node_name:
+            for p in self.store.pods_on_node(claim.node_name):
+                seen[p.uid] = p
+        return list(seen.values())
+
+    def _pool_usage(self, pool: NodePool) -> Resources:
+        usage = Resources()
+        for claim in self.store.nodeclaims_for_pool(pool.name):
+            if not claim.is_deleting() and claim.phase != Phase.FAILED:
+                usage = usage.add(claim.capacity)
+        return usage
+
+    # --- launch ---
+    def _launch(self, pool: NodePool, node_class: NodeClassSpec,
+                launches: List[NodeLaunch], now: float) -> List[Pod]:
+        if not launches:
+            return []
+        requests, claims = [], []
+        for launch in launches:
+            claim = NodeClaim(
+                name=new_nodeclaim_name(pool.name), nodepool=pool.name,
+                requirements=pool.requirements.copy(),
+                resource_requests=launch.requests,
+                taints=list(pool.taints), startup_taints=list(pool.startup_taints),
+                labels=dict(launch.labels), node_class=node_class.name,
+                expire_after=pool.expire_after,
+                termination_grace_period=pool.termination_grace_period,
+                created_at=now)
+            claim.annotations["karpenter.tpu/nodeclass-hash"] = node_class.hash()
+            claim.instance_type = launch.instance_type
+            self.store.add_nodeclaim(claim)
+            claims.append((claim, launch))
+            requests.append(LaunchRequest(
+                nodeclaim_name=claim.name,
+                overrides=[LaunchOverride(*o) for o in launch.overrides],
+                image_id=(node_class.resolved_images[0]
+                          if node_class.resolved_images else "img-default"),
+                tags={**node_class.tags, "karpenter.tpu/nodepool": pool.name}))
+        results = self.cloud.create_fleet(requests)
+
+        failed_pods: List[Pod] = []
+        for (claim, launch), res in zip(claims, results):
+            if isinstance(res, Instance):
+                claim.phase = Phase.LAUNCHED
+                claim.provider_id = res.provider_id
+                claim.instance_type = res.instance_type
+                claim.zone = res.zone
+                claim.capacity_type = res.capacity_type
+                claim.price = res.price
+                claim.launched_at = now
+                claim.image_id = res.image_id
+                itype = next((t for t in self.catalog.list(node_class)
+                              if t.name == res.instance_type), None)
+                if itype is not None:
+                    claim.capacity = Resources(itype.capacity)
+                    claim.allocatable = itype.allocatable()
+                claim.labels[L.ZONE] = res.zone
+                claim.labels[L.CAPACITY_TYPE] = res.capacity_type
+                claim.labels[L.INSTANCE_TYPE] = res.instance_type
+                for k in launch.pod_keys:
+                    pod = self.store.pods.get(k)
+                    if pod is not None:
+                        self._nominate(pod, claim)
+                self.stats["launches"] += 1
+            else:
+                self._handle_launch_error(claim, res)
+                failed_pods.extend(self.store.pods[k] for k in launch.pod_keys
+                                   if k in self.store.pods)
+        return failed_pods
+
+    def _handle_launch_error(self, claim: NodeClaim, err: CloudError) -> None:
+        claim.phase = Phase.FAILED
+        claim.set_condition("Launched", False, type(err).__name__, str(err))
+        self.store.record_event("nodeclaim", claim.name, "LaunchFailed", str(err))
+        self.store.delete_nodeclaim(claim.name)
+        if isinstance(err, InsufficientCapacityError):
+            self.stats["ice_errors"] += 1
+            for (t, z, c) in err.offerings:
+                self.catalog.unavailable.mark_unavailable(t, z, c, reason="ICE")
+
+    def _nominate(self, pod: Pod, claim: NodeClaim) -> None:
+        pod.annotations[NOMINATED] = claim.name

@@ -49,8 +49,15 @@ class VirtualNode:
     zone_mask: np.ndarray      # bool [Z] — deferred zone choice
     cap_mask: np.ndarray       # bool [C]
     cum: np.ndarray            # f32 [R]
+    # placements from THIS solve, keyed by the current enc's group indices
     pods_by_group: Dict[int, int] = field(default_factory=dict)
     existing_name: Optional[str] = None  # set for in-flight/live nodes
+    # prior occupancy of an existing node, keyed by the CURRENT enc's group
+    # indices (facade maps prior pods by constraint signature). Consumed by
+    # the per-node caps (anti-affinity / hostname spread) so a node that
+    # already hosts a matching pod can't take another across reconciles;
+    # resources are accounted separately via cum.
+    prior_by_group: Dict[int, int] = field(default_factory=dict)
 
     def pod_count(self) -> int:
         return sum(self.pods_by_group.values())
@@ -112,8 +119,7 @@ def split_spread_groups(enc: EncodedPods, cat: CatalogTensors) -> EncodedPods:
             row[z] = True
             push(i, cnt, row)
 
-    from .encode import EncodedPods as EP
-    return EP(groups=groups,
+    return EncodedPods(groups=groups,
               requests=np.array(rows["requests"], np.float32).reshape(len(groups), -1),
               counts=np.array(rows["counts"], np.int32),
               compat=np.array(rows["compat"], bool).reshape(len(groups), -1),
@@ -153,11 +159,19 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
     avail = cat.available  # [T, Z, C]
     price = cat.price
 
+    for n in (existing or []):
+        assert len(n.cum) <= R, (
+            f"existing node cum has {len(n.cum)} resources but the current "
+            f"axis is {R} — the resource axis only grows within a process")
+    # result nodes report only THIS solve's placements (pods_by_group starts
+    # empty even for existing nodes); prior occupancy enters via cum and
+    # prior_by_group
     nodes: List[VirtualNode] = [
         VirtualNode(type_idx=n.type_idx, zone_mask=n.zone_mask.copy(),
                     cap_mask=n.cap_mask.copy(),
                     cum=np.pad(n.cum, (0, max(0, R - len(n.cum)))).astype(np.float32),
-                    pods_by_group=dict(n.pods_by_group),
+                    pods_by_group={},
+                    prior_by_group=dict(n.prior_by_group),
                     existing_name=n.existing_name)
         for n in (existing or [])]
     unschedulable: Dict[int, int] = {}
@@ -177,7 +191,9 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
             cmask = n.cap_mask & enc.allow_cap[g]
             if not (avail[t] & zmask[:, None] & cmask[None, :]).any():
                 continue
-            take = min(_fit_count(alloc[t], n.cum, req), cap_per_node, rem)
+            take = min(_fit_count(alloc[t], n.cum, req),
+                       cap_per_node - n.prior_by_group.get(g, 0)
+                       - n.pods_by_group.get(g, 0), rem)
             if take < 1:
                 continue
             n.cum = n.cum + np.float32(take) * req

@@ -1,0 +1,226 @@
+"""Solver facade: pods + NodePool + catalog → launch decisions.
+
+The `Solver` interface of the north star: the control plane owns all
+mutable state and calls solve() statelessly with (pods, catalog-epoch);
+this module hides encoding, spread-splitting, device-tensor caching, and
+backend selection (TPU kernel vs host oracle — identical semantics).
+
+Output maps tensor results back to the object world: one NodeLaunch per
+new virtual node, carrying the committed instance type, the cheapest
+surviving offering, a price-sorted override list for launch resilience
+(reference sends ≤60 override rows per CreateFleet, instance.go:58-63),
+and the concrete pods nominated to it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..catalog.provider import CatalogProvider
+from ..models import labels as L
+from ..models.instancetype import InstanceType
+from ..models.nodeclaim import NodeClaim
+from ..models.nodepool import NodeClassSpec, NodePool
+from ..models.pod import Pod
+from ..models.requirements import Requirements
+from ..models.resources import Resources
+from .binpack import (SolveResult, VirtualNode, solve_host,
+                      split_spread_groups, validate_solution)
+from .encode import (CatalogTensors, EncodedPods, align_resources,
+                     encode_catalog, encode_pods)
+
+MAX_OVERRIDES = 60  # reference MaxInstanceTypes (instance.go:62)
+
+
+@dataclass
+class NodeLaunch:
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: float
+    overrides: List[Tuple[str, str, str, float]]  # (type, zone, captype, price)
+    pod_keys: List[str]
+    requests: Resources
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SolveOutput:
+    launches: List[NodeLaunch]
+    existing_placements: Dict[str, List[str]]  # existing node name -> pod keys
+    unschedulable: List[str]                   # pod keys
+    stats: Dict[str, float] = field(default_factory=dict)
+
+
+def _pod_key(p: Pod) -> str:
+    return f"{p.namespace}/{p.name}"
+
+
+class Solver:
+    def __init__(self, catalog: CatalogProvider, backend: str = "device"):
+        self.catalog = catalog
+        self.backend = backend
+        self._cat_cache: Dict[tuple, CatalogTensors] = {}
+
+    def tensors(self, node_class: Optional[NodeClassSpec] = None) -> CatalogTensors:
+        nc = node_class or NodeClassSpec()
+        key = (nc.hash(),) + tuple(self.catalog.epoch)
+        hit = self._cat_cache.get(key)
+        if hit is None:
+            types = self.catalog.list(nc)
+            hit = encode_catalog(types)
+            self._cat_cache.clear()  # one epoch's views at a time
+            self._cat_cache[key] = hit
+        return hit
+
+    def solve(self, pods: Sequence[Pod], nodepool: NodePool,
+              node_class: Optional[NodeClassSpec] = None,
+              existing: Optional[List[VirtualNode]] = None,
+              capacity_cap: Optional[Resources] = None,
+              existing_pods: Optional[Dict[str, List[Pod]]] = None) -> SolveOutput:
+        """capacity_cap: only open nodes whose total capacity fits within it
+        (the NodePool-limits headroom; the reference scheduler stops opening
+        virtual nodes that would breach spec.limits the same way).
+
+        existing_pods: pods already on each existing node (by existing_name)
+        — matched by constraint signature into the current groups so
+        per-node caps (anti-affinity/hostname-spread) hold across
+        reconciles, not just within one solve."""
+        cat = self.tensors(node_class)
+        if cat.T == 0 or not pods:
+            return SolveOutput([], {}, [_pod_key(p) for p in pods])
+        enc = encode_pods(pods, cat,
+                          extra_requirements=nodepool.requirements,
+                          taints=nodepool.taints + nodepool.startup_taints)
+        if capacity_cap is not None:
+            types = self.catalog.list(node_class or NodeClassSpec())
+            fits_cap = np.array(
+                [all(t.capacity.get(k, 0.0) <= v + 1e-9
+                     for k, v in capacity_cap.items())
+                 for t in types], bool)
+            enc.compat &= fits_cap[None, :]
+        # pods dropped by the taint filter are unschedulable for this pool
+        enc_keys = {_pod_key(p) for g in enc.groups for p in g.pods}
+        dropped = [_pod_key(p) for p in pods if _pod_key(p) not in enc_keys]
+        enc = split_spread_groups(enc, cat)
+        if enc.G == 0:
+            return SolveOutput([], {}, dropped)
+
+        if existing and existing_pods:
+            sig_to_groups: Dict[tuple, List[int]] = {}
+            for gi, grp in enumerate(enc.groups):
+                sig_to_groups.setdefault(
+                    grp.representative.constraint_signature(), []).append(gi)
+            for vn in existing:
+                counts: Dict[int, int] = {}
+                for p in existing_pods.get(vn.existing_name or "", []):
+                    for gi in sig_to_groups.get(p.constraint_signature(), []):
+                        counts[gi] = counts.get(gi, 0) + 1
+                vn.prior_by_group = counts
+
+        if self.backend == "host":
+            result = solve_host(cat, enc, existing)
+        else:
+            from .solver import solve_device
+            result = solve_device(cat, enc, existing)
+
+        return self._decode(cat, enc, result, nodepool, dropped)
+
+    # --- result mapping ---
+    def _decode(self, cat: CatalogTensors, enc: EncodedPods,
+                result: SolveResult, nodepool: NodePool,
+                dropped: List[str]) -> SolveOutput:
+        # per-group pod cursors for deterministic nomination
+        cursors = [0] * enc.G
+        launches: List[NodeLaunch] = []
+        existing_placements: Dict[str, List[str]] = {}
+        li = 0
+        for node in result.nodes:
+            keys = []
+            reqs = Resources()
+            for g, cnt in sorted(node.pods_by_group.items()):
+                grp = enc.groups[g]
+                take = grp.pods[cursors[g]: cursors[g] + cnt]
+                cursors[g] += cnt
+                keys.extend(_pod_key(p) for p in take)
+                for p in take:
+                    reqs = reqs.add(p.requests)
+            if node.existing_name is not None:
+                if keys:
+                    existing_placements[node.existing_name] = keys
+                continue
+            t, zi, ci, price = result.launches[li]
+            li += 1
+            it_name = cat.names[node.type_idx]
+            labels = self._node_labels(cat, node, nodepool)
+            # alternates must satisfy every pod on the node, not just fit its
+            # resource sum — AND the groups' compat masks
+            group_compat = np.ones(cat.T, bool)
+            for g in node.pods_by_group:
+                group_compat &= enc.compat[g]
+            launches.append(NodeLaunch(
+                instance_type=it_name, zone=cat.zones[zi],
+                capacity_type=cat.captypes[ci], price=price,
+                overrides=self._overrides(cat, node, group_compat),
+                pod_keys=keys, requests=reqs, labels=labels))
+        unschedulable = list(dropped)
+        for g, cnt in result.unschedulable.items():
+            grp = enc.groups[g]
+            take = grp.pods[cursors[g]: cursors[g] + cnt]
+            cursors[g] += cnt
+            unschedulable.extend(_pod_key(p) for p in take)
+        return SolveOutput(launches=launches,
+                           existing_placements=existing_placements,
+                           unschedulable=unschedulable)
+
+    def _overrides(self, cat: CatalogTensors, node: VirtualNode,
+                   group_compat: np.ndarray) -> List[Tuple[str, str, str, float]]:
+        """Price-sorted alternate offerings for this node's pod set: any
+        type compatible with every pod on the node that holds node.cum, and
+        any surviving (zone, captype). Gives the launch path ICE resilience
+        without a re-solve."""
+        alloc = align_resources(cat.allocatable, len(node.cum))
+        fits = (alloc >= node.cum[None, :] - 1e-4).all(axis=1)  # [T]
+        ok = fits & group_compat
+        mask = (cat.available & ok[:, None, None]
+                & node.zone_mask[None, :, None] & node.cap_mask[None, None, :])
+        t_idx, z_idx, c_idx = np.nonzero(mask)
+        prices = cat.price[t_idx, z_idx, c_idx]
+        order = np.argsort(prices, kind="stable")[:MAX_OVERRIDES]
+        out = []
+        primary = node.type_idx
+        # ensure the committed type's cheapest offering is first
+        rows = [(cat.names[t_idx[j]], cat.zones[z_idx[j]],
+                 cat.captypes[c_idx[j]], float(prices[j])) for j in order]
+        rows.sort(key=lambda r: (r[0] != cat.names[primary], r[3]))
+        return rows[:MAX_OVERRIDES]
+
+    def _node_labels(self, cat: CatalogTensors, node: VirtualNode,
+                     nodepool: NodePool) -> Dict[str, str]:
+        labels = dict(nodepool.labels)
+        labels.update(nodepool.requirements.single_values())
+        labels[L.NODEPOOL] = nodepool.name
+        labels[L.INSTANCE_TYPE] = cat.names[node.type_idx]
+        return labels
+
+
+def virtual_node_from_claim(claim: NodeClaim, cat: CatalogTensors,
+                            used: Resources) -> Optional[VirtualNode]:
+    """Reconstruct an in-flight NodeClaim as solver input so repeated
+    reconciles keep filling it instead of over-provisioning (the reference
+    scheduler simulates against in-flight nodes the same way)."""
+    idx = cat.name_to_idx.get(claim.instance_type or "")
+    if idx is None:
+        return None
+    zone_mask = np.array([z == claim.zone for z in cat.zones], bool) \
+        if claim.zone else np.ones(cat.Z, bool)
+    cap_mask = np.array([c == claim.capacity_type for c in cat.captypes], bool) \
+        if claim.capacity_type else np.ones(cat.C, bool)
+    vec = used.to_vector()
+    cum = np.zeros(len(cat.resources), np.float32)
+    cum[: len(vec)] = vec[: len(cum)]
+    return VirtualNode(type_idx=idx, zone_mask=zone_mask, cap_mask=cap_mask,
+                       cum=cum, existing_name=claim.name)

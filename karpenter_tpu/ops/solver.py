@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .binpack import BIG, SolveResult, VirtualNode, finalize_offerings
+from .binpack import BIG, EPS, SolveResult, VirtualNode, finalize_offerings
 from .encode import CatalogTensors, EncodedPods, align_resources
 
 _F32_MAX = jnp.finfo(jnp.float32).max
@@ -61,8 +61,8 @@ def device_catalog(cat: CatalogTensors, R: int) -> DeviceCatalog:
 
 @partial(jax.jit, static_argnames=("n_max",))
 def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
-                  allow_cap, max_per_node, node_type, node_cum, node_zmask,
-                  node_cmask, node_open, n_used, n_max: int):
+                  allow_cap, max_per_node, prior_counts, node_type, node_cum,
+                  node_zmask, node_cmask, node_open, n_used, n_max: int):
     """scan over G groups; returns final node state + per-(g,n) take matrix
     + per-group unschedulable counts."""
 
@@ -72,7 +72,7 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
 
     def step(state, ginput):
         ntype, cum, zmask, cmask, nopen, nused = state
-        req, count, gcompat, gzone, gcap, cap_per = ginput
+        req, count, gcompat, gzone, gcap, cap_per, prior_n = ginput
         count = count.astype(jnp.int32)
         cap_per = jnp.where(cap_per == 0, BIG, cap_per).astype(jnp.int32)
 
@@ -82,7 +82,7 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
         # max pods of this group per node by capacity
         with_req = jnp.where(req > 0, req, 1.0)
         k_cap = jnp.where(req > 0,
-                          jnp.floor(headroom / with_req + 1e-4),
+                          jnp.floor(headroom / with_req + EPS),
                           jnp.asarray(BIG, jnp.float32)).min(axis=1)
         k_cap = jnp.maximum(k_cap, 0.0).astype(jnp.int32)   # [N]
         # eligibility: open, type-compatible, masks intersect an available offering
@@ -91,10 +91,18 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
         off_ok = jnp.einsum("nz,nc,nzc->n", zmask2, cmask2,
                             avail[ntype], preferred_element_type=jnp.float32) > 0
         eligible = nopen & gcompat[ntype] & off_ok
-        k = jnp.where(eligible, jnp.minimum(k_cap, cap_per), 0)  # [N]
-        # prefix allocation: node i takes min(k_i, count - sum_{j<i} take_j)
-        prefix = jnp.cumsum(k) - k
-        take = jnp.clip(jnp.minimum(k, count - prefix), 0)       # [N]
+        # per-node cap accounts prior occupancy of this group (anti-affinity
+        # across reconciles). k is clamped to count BEFORE the prefix sum:
+        # k_cap can be BIG (zero-request pods) and an int32 cumsum over the
+        # node axis would wrap. The prefix runs in f32 (x64 is disabled):
+        # exact while below 2^24 ≥ any real pod count, and once the prefix
+        # passes `count` the take clamps to zero so precision is moot.
+        cap_eff = jnp.maximum(cap_per - prior_n, 0)
+        k = jnp.where(eligible, jnp.minimum(k_cap, cap_eff), 0)  # [N]
+        kf = jnp.minimum(k, count).astype(jnp.float32)
+        prefix = jnp.cumsum(kf) - kf
+        take = jnp.clip(jnp.minimum(kf, count.astype(jnp.float32) - prefix),
+                        0).astype(jnp.int32)                     # [N]
         placed = jnp.minimum(jnp.sum(take), count)
         rem = count - placed
 
@@ -107,7 +115,7 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
         adm = (avail & gcompat[:, None, None] & gzone[None, :, None]
                & gcap[None, None, :])                   # [T, Z, C]
         slots_t = jnp.where(req > 0,
-                            jnp.floor(alloc / with_req[None, :] + 1e-4),
+                            jnp.floor(alloc / with_req[None, :] + EPS),
                             jnp.asarray(BIG, jnp.float32)).min(axis=1)
         slots_t = jnp.minimum(jnp.maximum(slots_t, 0.0).astype(jnp.int32), cap_per)  # [T]
         feasible = adm & (slots_t[:, None, None] >= 1)
@@ -147,15 +155,16 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
 
     init = (node_type, node_cum, node_zmask, node_cmask, node_open, n_used)
     (ntype, cum, zmask, cmask, nopen, nused), (takes, unsched, clamped) = lax.scan(
-        step, init, (requests, counts, compat, allow_zone, allow_cap, max_per_node))
+        step, init, (requests, counts, compat, allow_zone, allow_cap,
+                     max_per_node, prior_counts))
     return ntype, cum, zmask, cmask, nopen, nused, takes, unsched, clamped.any()
 
 
 @partial(jax.jit, static_argnames=("n_max", "k_max"))
 def _solve_kernel_packed(alloc, price, avail, requests, counts, compat,
-                         allow_zone, allow_cap, max_per_node, node_type,
-                         node_cum, node_zmask, node_cmask, node_open, n_used,
-                         n_max: int, k_max: int):
+                         allow_zone, allow_cap, max_per_node, prior_counts,
+                         node_type, node_cum, node_zmask, node_cmask,
+                         node_open, n_used, n_max: int, k_max: int):
     """Kernel + single-buffer output packing.
 
     The deployment TPU sits behind a network tunnel where every host read
@@ -172,9 +181,9 @@ def _solve_kernel_packed(alloc, price, avail, requests, counts, compat,
       [.. : ..+k_max]      take values
     """
     out = _solve_kernel(alloc, price, avail, requests, counts, compat,
-                        allow_zone, allow_cap, max_per_node, node_type,
-                        node_cum, node_zmask, node_cmask, node_open, n_used,
-                        n_max=n_max)
+                        allow_zone, allow_cap, max_per_node, prior_counts,
+                        node_type, node_cum, node_zmask, node_cmask,
+                        node_open, n_used, n_max=n_max)
     ntype, _cum, _zm, _cm, _no, nused, takes, unsched, overflow = out
     flat = takes.reshape(-1)
     nnz = jnp.sum(flat > 0)
@@ -238,17 +247,25 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
     node_cmask = np.zeros((n_existing, cat.C), bool)
     node_open = np.zeros(n_existing, bool)
     for i, n in enumerate(existing):
+        assert len(n.cum) <= R, (
+            f"existing node cum has {len(n.cum)} resources but the current "
+            f"axis is {R} — the resource axis only grows within a process")
         node_type[i] = n.type_idx
         node_cum[i, : len(n.cum)] = n.cum
         node_zmask[i] = n.zone_mask
         node_cmask[i] = n.cap_mask
         node_open[i] = True
 
+    k_max = 4 * n_max + Gp  # sparse-take budget; regrown on nnz overflow
     while True:
-        k_max = 4 * n_max + Gp  # sparse-take budget; nnz check guards it
+        prior = np.zeros((Gp, n_max), np.int32)
+        for i, n in enumerate(existing):
+            for g, cnt in n.prior_by_group.items():
+                if g < Gp:
+                    prior[g, i] = cnt
         packed = _solve_kernel_packed(
             dcat.alloc, dcat.price, dcat.avail, requests, counts,
-            compat, allow_zone, allow_cap, max_per_node,
+            compat, allow_zone, allow_cap, max_per_node, jnp.asarray(prior),
             jnp.asarray(_pad_to(node_type, n_max)),
             jnp.asarray(_pad_to(node_cum, n_max)),
             jnp.asarray(_pad_to(node_zmask, n_max)),
@@ -262,10 +279,14 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
         ntype = buf[o: o + n_max]; o += n_max
         idx = buf[o: o + k_max]; o += k_max
         vals = buf[o: o + k_max]
-        assert nnz <= k_max, f"sparse take budget exceeded: {nnz} > {k_max}"
+        if nnz > k_max:
+            # sparse budget too small: takes were truncated — regrow & rerun
+            k_max = _bucket(nnz)
+            continue
         if not overflowed or not auto_n or n_max >= n_existing + total_pods:
             break
         n_max = min(_bucket(n_max * 2), _bucket(n_existing + total_pods))
+        k_max = 4 * n_max + Gp
 
     # --- host-side reconstruction (vectorized, no device reads) ---
     # pods_by_group keys refer to THIS enc's group indices; existing nodes'

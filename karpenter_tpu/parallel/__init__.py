@@ -1,1 +1,5 @@
+"""Mesh + sharding: multi-chip distribution of the solver."""
 
+from .mesh import make_mesh, run_sharded_solve, sharded_solve_fn
+
+__all__ = ["make_mesh", "run_sharded_solve", "sharded_solve_fn"]

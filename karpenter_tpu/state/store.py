@@ -1,0 +1,117 @@
+"""In-memory cluster state store — the sim's API server.
+
+Plays the role the Kubernetes API server plays for the reference (its
+coordination bus; SURVEY.md §5 'distributed communication backend'): all
+durable state lives here, controllers watch it, and restart recovery is
+'rebuild from the store' exactly like the reference rebuilds from watches.
+Event hooks provide the watch mechanism.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..models.nodeclaim import Node, NodeClaim
+from ..models.nodepool import NodeClassSpec, NodePool
+from ..models.pod import Pod
+
+
+class Store:
+    def __init__(self) -> None:
+        self.pods: Dict[str, Pod] = {}
+        self.nodepools: Dict[str, NodePool] = {}
+        self.nodeclasses: Dict[str, NodeClassSpec] = {}
+        self.nodeclaims: Dict[str, NodeClaim] = {}
+        self.nodes: Dict[str, Node] = {}
+        self._watchers: Dict[str, List[Callable]] = defaultdict(list)
+        self.events: List[tuple] = []  # (kind, object-name, reason, message)
+
+    # --- watch / events ---
+    def watch(self, kind: str, fn: Callable) -> None:
+        self._watchers[kind].append(fn)
+
+    def _notify(self, kind: str, action: str, obj) -> None:
+        for fn in self._watchers[kind]:
+            fn(action, obj)
+
+    def record_event(self, kind: str, name: str, reason: str, message: str = "") -> None:
+        self.events.append((kind, name, reason, message))
+
+    # --- pods ---
+    def add_pod(self, pod: Pod) -> Pod:
+        key = f"{pod.namespace}/{pod.name}"
+        self.pods[key] = pod
+        self._notify("pod", "add", pod)
+        return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        pod = self.pods.pop(f"{namespace}/{name}", None)
+        if pod:
+            self._notify("pod", "delete", pod)
+
+    def pending_pods(self) -> List[Pod]:
+        return [p for p in self.pods.values()
+                if p.phase == "Pending" and p.node_name is None]
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        return [p for p in self.pods.values() if p.node_name == node_name]
+
+    def bind_pod(self, pod: Pod, node_name: str) -> None:
+        pod.node_name = node_name
+        pod.phase = "Running"
+        self._notify("pod", "bind", pod)
+
+    # --- nodepools / nodeclasses ---
+    def add_nodepool(self, np_: NodePool) -> NodePool:
+        self.nodepools[np_.name] = np_
+        self._notify("nodepool", "add", np_)
+        return np_
+
+    def add_nodeclass(self, nc: NodeClassSpec) -> NodeClassSpec:
+        self.nodeclasses[nc.name] = nc
+        self._notify("nodeclass", "add", nc)
+        return nc
+
+    def nodepools_by_weight(self) -> List[NodePool]:
+        """Descending weight — provisioning tries heavier pools first
+        (reference NodePool weight, karpenter.sh_nodepools.yaml:427-432)."""
+        return sorted(self.nodepools.values(), key=lambda p: -p.weight)
+
+    # --- nodeclaims ---
+    def add_nodeclaim(self, nc: NodeClaim) -> NodeClaim:
+        self.nodeclaims[nc.name] = nc
+        self._notify("nodeclaim", "add", nc)
+        return nc
+
+    def delete_nodeclaim(self, name: str) -> None:
+        nc = self.nodeclaims.pop(name, None)
+        if nc:
+            self._notify("nodeclaim", "delete", nc)
+
+    def nodeclaims_for_pool(self, pool: str) -> List[NodeClaim]:
+        return [c for c in self.nodeclaims.values() if c.nodepool == pool]
+
+    def nodeclaim_by_provider_id(self, provider_id: str) -> Optional[NodeClaim]:
+        """The instance-id field index (reference operator.go:298-319)."""
+        for c in self.nodeclaims.values():
+            if c.provider_id == provider_id:
+                return c
+        return None
+
+    # --- nodes ---
+    def add_node(self, node: Node) -> Node:
+        self.nodes[node.name] = node
+        self._notify("node", "add", node)
+        return node
+
+    def delete_node(self, name: str) -> None:
+        node = self.nodes.pop(name, None)
+        if node:
+            self._notify("node", "delete", node)
+
+    def node_for_nodeclaim(self, claim: NodeClaim) -> Optional[Node]:
+        for n in self.nodes.values():
+            if n.provider_id == claim.provider_id:
+                return n
+        return None

@@ -1,0 +1,152 @@
+"""End-to-end slice: pending pods → Solve → NodeClaims → fake nodes → bound.
+
+The reference's scale-suite floor (BASELINE config #1): 500 pods, one
+NodePool, ~20 instance types on the kwok-style fake cloud.
+"""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodeclaim import Phase
+from karpenter_tpu.models.pod import Pod, Toleration, Taint
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def add_pods(sim, n, cpu="500m", mem="1Gi", prefix="p", **kw):
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def all_bound(sim):
+    return all(p.node_name is not None for p in sim.store.pods.values())
+
+
+class TestE2ESlice:
+    def test_500_pods_end_to_end(self):
+        sim = make_sim()
+        add_pods(sim, 500)
+        ok = sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        assert ok, f"unbound={len(sim.store.pending_pods())}"
+        # all claims initialized, nodes ready
+        claims = list(sim.store.nodeclaims.values())
+        assert claims
+        assert all(c.phase == Phase.INITIALIZED for c in claims)
+        # dense packing: far fewer nodes than pods
+        assert len(sim.store.nodes) < 100
+        # single solve batch → single CreateFleet call (batching works)
+        assert sim.cloud.api_calls["create_fleet"] <= 3
+        # pods actually fit their nodes
+        for node in sim.store.nodes.values():
+            used = Resources()
+            for p in sim.store.pods_on_node(node.name):
+                used = used.add(p.requests)
+            assert used.fits(node.allocatable)
+
+    def test_in_flight_claims_absorb_followup_pods(self):
+        sim = make_sim()
+        add_pods(sim, 20)
+        sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        n_claims = len(sim.store.nodeclaims)
+        # small follow-up batch fits in the headroom of existing nodes...
+        # but v1 only packs onto in-flight claims; bound-node headroom reuse
+        # arrives with cluster-state (consolidation) — so allow new claims,
+        # just require everything binds again
+        add_pods(sim, 5, prefix="follow")
+        ok = sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        assert ok
+
+    def test_ice_failover(self):
+        sim = make_sim()
+        # exhaust every spot pool so launches fail over to on-demand
+        for t in sim.cloud.types.values():
+            for o in t.offerings:
+                if o.capacity_type == "spot":
+                    sim.cloud.set_capacity(t.name, o.zone, "spot", 0)
+        add_pods(sim, 50)
+        ok = sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        assert ok
+        for c in sim.store.nodeclaims.values():
+            assert c.capacity_type == "on-demand"
+
+    def test_ice_marks_unavailable_and_resolves(self):
+        sim = make_sim()
+        # kill capacity for everything except one family to force ICE retries
+        seen = sim.catalog.unavailable
+        for t in sim.cloud.types.values():
+            for o in t.offerings:
+                if not t.name.startswith("m5."):
+                    sim.cloud.set_capacity(t.name, o.zone, o.capacity_type, 0)
+        add_pods(sim, 30)
+        ok = sim.engine.run_until(lambda: all_bound(sim), timeout=180)
+        assert ok
+        assert all(c.instance_type.startswith("m5.")
+                   for c in sim.store.nodeclaims.values())
+
+    def test_nodepool_taints_and_tolerations(self):
+        taint = Taint(key="dedicated", value="ml", effect="NoSchedule")
+        sim = make_sim(nodepool=NodePool(name="tainted", taints=[taint]))
+        add_pods(sim, 5, prefix="plain")
+        tolerant = add_pods(sim, 5, prefix="tol",
+                            tolerations=[Toleration(key="dedicated", operator="Exists")])
+        sim.engine.run_for(30)
+        # tolerant pods bound; plain pods unschedulable (no other pool)
+        assert all(p.node_name is not None for p in tolerant)
+        plain = [p for p in sim.store.pods.values() if p.name.startswith("plain")]
+        assert all(p.node_name is None for p in plain)
+        assert any(e[2] == "FailedScheduling" for e in sim.store.events)
+
+    def test_multi_nodepool_weight_and_fallthrough(self):
+        from karpenter_tpu.catalog import small_catalog
+        sim = make_sim(types=small_catalog(8))  # includes the g5 gpu family
+        del sim.store.nodepools["default"]
+        # heavy pool restricted to m5 family; light pool open
+        heavy = NodePool(name="heavy", weight=10)
+        heavy.requirements.add(Requirement(L.INSTANCE_FAMILY, Operator.IN, ("m5",)))
+        light = NodePool(name="light", weight=1)
+        sim.store.add_nodepool(heavy)
+        sim.store.add_nodepool(light)
+        add_pods(sim, 10)
+        # gpu-needing pod can't go on m5 → falls through to light pool
+        add_pods(sim, 1, prefix="gpu", cpu="1", mem="2Gi",
+                 node_affinity=[{"key": L.INSTANCE_GPU_COUNT,
+                                 "operator": "Gt", "values": ["0"]}])
+        ok = sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        assert ok
+        by_pool = {}
+        for c in sim.store.nodeclaims.values():
+            by_pool.setdefault(c.nodepool, []).append(c)
+        assert set(by_pool) == {"heavy", "light"}
+        assert all(c.instance_type.startswith("m5.") for c in by_pool["heavy"])
+        assert all(c.instance_type.startswith("g") for c in by_pool["light"])
+
+    def test_nodepool_limits(self):
+        pool = NodePool(name="limited",
+                        limits=Resources.parse({"cpu": "8"}))
+        sim = make_sim(nodepool=pool)
+        add_pods(sim, 100, cpu="1", mem="1Gi")
+        sim.engine.run_for(30)
+        total_cpu = sum(c.capacity.get("cpu") for c in sim.store.nodeclaims.values())
+        assert 0 < total_cpu <= 8
+        assert any(e[2] == "LimitExceeded" for e in sim.store.events)
+
+    def test_registration_timeout_reaps_claim(self):
+        sim = make_sim()
+        # instances never register (infinite delay)
+        sim.cloud.config.register_delay = 10**9
+        add_pods(sim, 3)
+        sim.engine.run_for(20)
+        first = set(sim.store.nodeclaims)
+        assert first  # launched, waiting
+        sim.engine.run_for(16 * 60, step=30)
+        # original claims reaped by liveness; pods returned to pending and
+        # the provisioner retried with fresh claims
+        assert not (first & set(sim.store.nodeclaims))
+        assert any(e[2] == "RegistrationTimeout" for e in sim.store.events)
+        assert all(p.node_name is None for p in sim.store.pods.values())

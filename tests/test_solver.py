@@ -152,3 +152,81 @@ class TestSolveQuality:
         enc = encode_pods(mk_pods(110, "500m", "1Gi"), cat)
         h = solve_host(cat, enc)
         assert len(h.nodes) < 110 / 4  # dense packing
+
+
+class TestReviewFindings:
+    """Regressions for the solver code-review round."""
+
+    def setup_method(self):
+        self.types = small_catalog()
+        self.cat = encode_catalog(self.types)
+
+    def test_zero_request_pods_no_overflow(self):
+        """All-zero-request pods (legal in k8s) must not wrap the prefix
+        cumsum; pods-slot resource still bounds them."""
+        pods = [Pod(name=f"z-{i}", requests=Resources({"pods": 1.0}))
+                for i in range(300)]
+        enc = encode_pods(pods, self.cat)
+        h, d = assert_agree(self.cat, enc)
+        assert sum(n.pod_count() for n in h.nodes) == 300
+
+    def test_anti_affinity_across_reconciles(self):
+        """An existing node already hosting a matching pod must not accept
+        another via prior_by_group."""
+        pods = mk_pods(3, "250m", "512Mi", "aa",
+                       labels={"app": "x"},
+                       affinity_terms=[PodAffinityTerm(
+                           topology_key="kubernetes.io/hostname",
+                           label_selector={"app": "x"}, anti=True)])
+        enc = encode_pods(pods, self.cat)
+        t = next(i for i, n in enumerate(self.cat.names) if n.endswith("8xlarge"))
+        existing = [VirtualNode(
+            type_idx=t, zone_mask=np.ones(self.cat.Z, bool),
+            cap_mask=np.ones(self.cat.C, bool),
+            cum=np.zeros(len(self.cat.resources), np.float32),
+            prior_by_group={0: 1},  # already hosts one matching pod
+            existing_name="inflight-1")]
+        h, d = assert_agree(self.cat, enc, existing)
+        # the existing node took none of the three (cap 1, prior 1)
+        assert h.nodes[0].pods_by_group.get(0, 0) == 0
+        assert len(h.nodes) == 4  # 3 new single-pod nodes
+
+    def test_existing_pods_by_group_not_carried(self):
+        """Result pods_by_group reports only this solve's placements even if
+        the caller passed nodes with a stale dict."""
+        enc = encode_pods(mk_pods(4), self.cat)
+        t = next(i for i, n in enumerate(self.cat.names) if n.endswith("8xlarge"))
+        existing = [VirtualNode(
+            type_idx=t, zone_mask=np.ones(self.cat.Z, bool),
+            cap_mask=np.ones(self.cat.C, bool),
+            cum=np.zeros(len(self.cat.resources), np.float32),
+            pods_by_group={99: 7},  # stale indices from a previous solve
+            existing_name="inflight-1")]
+        h, d = assert_agree(self.cat, enc, existing)
+        assert 99 not in h.nodes[0].pods_by_group
+        assert 99 not in d.nodes[0].pods_by_group
+
+    def test_oversize_cum_asserts_clearly(self):
+        enc = encode_pods(mk_pods(2), self.cat)
+        bad = [VirtualNode(
+            type_idx=0, zone_mask=np.ones(self.cat.Z, bool),
+            cap_mask=np.ones(self.cat.C, bool),
+            cum=np.zeros(99, np.float32), existing_name="x")]
+        with pytest.raises(AssertionError, match="resource axis"):
+            solve_host(self.cat, enc, bad)
+        with pytest.raises(AssertionError, match="resource axis"):
+            solve_device(self.cat, enc, bad)
+
+    def test_explicit_small_n_max_regrows_sparse_budget(self):
+        """Many groups landing on few nodes: nnz can exceed the 4x budget;
+        solve must regrow, not truncate."""
+        pods = []
+        for i in range(40):  # 40 distinct tiny shapes -> 40 groups
+            pods.append(Pod(name=f"m-{i}",
+                            requests=Resources.parse(
+                                {"cpu": f"{10+i}m", "memory": "64Mi"})))
+        enc = encode_pods(pods, self.cat)
+        d = solve_device(self.cat, enc, n_max=64)
+        h = solve_host(self.cat, enc)
+        assert sum(n.pod_count() for n in d.nodes) == 40
+        assert len(d.nodes) == len(h.nodes)
