@@ -1,0 +1,293 @@
+"""Disruption controller: drift, expiration, emptiness, consolidation.
+
+Reference behavior (website/docs concepts/disruption.md:9-130 +
+designs/consolidation.md): each pass builds disruptable candidates
+(do-not-disrupt pods, budgets, consolidate-after stability gate), then in
+order Drift → Expiration → Emptiness → Multi-node consolidation →
+Single-node consolidation. Consolidation decisions pre-spin replacements
+before the old node drains; spot→spot replacement requires a ≥15-type
+flexibility floor (disruption.md:120-130).
+
+TPU-native: every "can the cluster absorb this node's pods" question is a
+batched re-solve on the same kernel as provisioning — candidate pods are
+re-encoded and solved against the other nodes' live headroom, with new
+nodes allowed only below the candidate's price. Multi-node consolidation
+binary-searches the largest disruptable prefix of the cost-ordered
+candidate list, each probe one kernel call (the reference does a
+sequential heuristic subset search on the CPU).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..catalog.provider import CatalogProvider
+from ..models import labels as L
+from ..models.nodeclaim import NodeClaim, Phase
+from ..models.nodepool import NodePool
+from ..ops.facade import Solver
+from ..state.cluster import NodeView, build_node_views
+from ..state.store import Store
+from .termination import TerminationController
+
+SPOT_TO_SPOT_MIN_TYPES = 15  # reference flexibility floor (disruption.md:129)
+
+
+@dataclass
+class PendingDisruption:
+    """A decided disruption waiting on its replacement to come up."""
+
+    victim_claims: List[str]
+    replacement_claims: List[str]
+    reason: str
+    decided_at: float
+
+
+@dataclass
+class DisruptionController:
+    store: Store
+    solver: Solver
+    catalog: CatalogProvider
+    provisioner: object           # reuses its _launch machinery
+    termination: TerminationController
+    name: str = "disruption"
+    requeue: float = 5.0
+    _pending: List[PendingDisruption] = field(default_factory=list)
+    stats: Dict[str, int] = field(default_factory=lambda: {
+        "empty": 0, "drift": 0, "expired": 0, "consolidated": 0,
+        "multi_consolidated": 0})
+
+    def reconcile(self, now: float) -> float:
+        self._advance_pending(now)
+        for pool in self.store.nodepools_by_weight():
+            self._reconcile_pool(pool, now)
+        return self.requeue
+
+    # --- pending replacements: delete victims once replacements are up ---
+    def _advance_pending(self, now: float) -> None:
+        still = []
+        for pd in self._pending:
+            repl = [self.store.nodeclaims.get(r) for r in pd.replacement_claims]
+            if any(r is None or r.phase == Phase.FAILED for r in repl):
+                # replacement failed: abort the disruption, keep the victims
+                self.store.record_event("disruption", ",".join(pd.victim_claims),
+                                        "ReplacementFailed", pd.reason)
+                continue
+            if all(r.phase == Phase.INITIALIZED for r in repl):
+                for v in pd.victim_claims:
+                    claim = self.store.nodeclaims.get(v)
+                    if claim is not None:
+                        self.termination.delete_nodeclaim(claim, now, pd.reason)
+                continue
+            if now - pd.decided_at > 15 * 60:
+                continue  # stale decision: drop
+            still.append(pd)
+        self._pending = still
+
+    # --- per-pool pass ---
+    def _reconcile_pool(self, pool: NodePool, now: float) -> None:
+        node_class = self.store.nodeclasses.get(pool.node_class)
+        cat = self.solver.tensors(node_class)
+        views = [v for v in build_node_views(self.store, cat, now)
+                 if v.claim.nodepool == pool.name]
+        if not views:
+            return
+        budget_for = lambda reason: self._budget(pool, views, reason)
+
+        # 1. drift (nodeclass hash mismatch) + expiration
+        for v in views:
+            if budget_for("Drifted") <= 0:
+                break
+            if self._is_drifted(v, node_class):
+                self._replace(pool, [v], "Drifted", now, cat, views)
+            elif (pool.expire_after is not None
+                  and now - v.claim.created_at > pool.expire_after):
+                self._replace(pool, [v], "Expired", now, cat, views,
+                              stat="expired")
+
+        if pool.disruption.consolidation_policy == "WhenEmpty":
+            self._empty_pass(pool, views, now)
+            return
+        if pool.disruption.consolidation_policy not in (
+                "WhenEmpty", "WhenEmptyOrUnderutilized"):
+            return
+
+        # 2. emptiness
+        self._empty_pass(pool, views, now)
+
+        # 3. consolidation (stability gate: node initialized long enough)
+        settle = pool.disruption.consolidate_after
+        candidates = [
+            v for v in views
+            if v.claim.phase == Phase.INITIALIZED
+            and not v.has_do_not_disrupt()
+            and v.pods
+            and not v.claim.is_deleting()
+            and not self._is_pending_victim(v.name)
+            and now - v.claim.initialized_at >= settle]
+        candidates.sort(key=lambda v: v.disruption_cost())
+        if not candidates:
+            return
+        if budget_for("Underutilized") <= 0:
+            return
+        if len(candidates) > 1:
+            if self._multi_node(pool, candidates, now, cat, views):
+                return
+        self._single_node(pool, candidates, now, cat, views,
+                          budget_for("Underutilized"))
+
+    # --- emptiness ---
+    def _empty_pass(self, pool: NodePool, views: List[NodeView],
+                    now: float) -> None:
+        budget = self._budget(pool, views, "Empty")
+        settle = pool.disruption.consolidate_after
+        for v in views:
+            if budget <= 0:
+                break
+            if (not v.pods and v.claim.phase == Phase.INITIALIZED
+                    and not v.claim.is_deleting()
+                    and not self._is_pending_victim(v.name)
+                    and now - v.claim.initialized_at >= settle):
+                self.termination.delete_nodeclaim(v.claim, now, "Empty")
+                self.stats["empty"] += 1
+                budget -= 1
+
+    # --- drift ---
+    def _is_drifted(self, v: NodeView, node_class) -> bool:
+        if node_class is None:
+            return False
+        stamped = v.claim.annotations.get("karpenter.tpu/nodeclass-hash")
+        return stamped is not None and stamped != node_class.hash()
+
+    # --- consolidation simulations ---
+    def _simulate_removal(self, pool: NodePool, victims: List[NodeView],
+                          cat, views: List[NodeView],
+                          max_new_price: Optional[float]):
+        """Re-solve the victims' pods against the other nodes' headroom.
+        Returns (launches, feasible) where feasible means nothing was left
+        unschedulable and new nodes (if any) cost < max_new_price total."""
+        victim_names = {v.name for v in victims}
+        pods = [p for v in victims for p in v.pods]
+        others = [v for v in views if v.name not in victim_names
+                  and not v.claim.is_deleting()
+                  and not self._is_pending_victim(v.name)]
+        node_class = self.store.nodeclasses.get(pool.node_class)
+        out = self.solver.solve(
+            pods, pool, node_class,
+            existing=[v.virtual for v in others],
+            existing_pods={v.name: v.pods for v in others})
+        if out.unschedulable:
+            return out, False
+        if max_new_price is not None:
+            new_price = sum(l.price for l in out.launches)
+            if new_price >= max_new_price - 1e-9:
+                return out, False
+        return out, True
+
+    def _single_node(self, pool: NodePool, candidates: List[NodeView],
+                     now: float, cat, views: List[NodeView],
+                     budget: int) -> None:
+        done = 0
+        for v in candidates:
+            if done >= budget:
+                break
+            out, ok = self._simulate_removal(pool, [v], cat, views, v.price)
+            if not ok:
+                continue
+            if out.launches and not self._spot_floor_ok(v, out, cat):
+                continue
+            self._execute(pool, [v], out, "Underutilized", now)
+            self.stats["consolidated"] += 1
+            done += 1
+
+    def _multi_node(self, pool: NodePool, candidates: List[NodeView],
+                    now: float, cat, views: List[NodeView]) -> bool:
+        """Binary-search the largest prefix of cost-ordered candidates whose
+        pods re-solve onto the rest + at most one cheaper replacement
+        (reference multi-node consolidation, disruption.md:96-103)."""
+        budget = self._budget(pool, views, "Underutilized")
+        hi = min(len(candidates), max(budget, 0))
+        if hi < 2:
+            return False
+        lo, best = 2, None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            victims = candidates[:mid]
+            total_price = sum(v.price for v in victims)
+            out, ok = self._simulate_removal(pool, victims, cat, views,
+                                             total_price)
+            if ok and len(out.launches) <= 1:
+                best = (victims, out)
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        if best is None:
+            return False
+        victims, out = best
+        self._execute(pool, victims, out, "Underutilized", now)
+        self.stats["multi_consolidated"] += 1
+        return True
+
+    def _spot_floor_ok(self, victim: NodeView, out, cat) -> bool:
+        """Spot→spot replacement needs ≥15 distinct cheaper instance types
+        of flexibility, else consolidation would chase the spot market
+        (reference disruption.md:120-130)."""
+        if victim.claim.capacity_type != "spot":
+            return True
+        for launch in out.launches:
+            if launch.capacity_type != "spot":
+                continue
+            distinct = {o[0] for o in launch.overrides
+                        if o[2] == "spot" and o[3] < victim.price}
+            if len(distinct) < SPOT_TO_SPOT_MIN_TYPES:
+                return False
+        return True
+
+    # --- execution: pre-spin replacement, then drain victims ---
+    def _replace(self, pool: NodePool, victims: List[NodeView], reason: str,
+                 now: float, cat, views: List[NodeView],
+                 stat: str = "drift") -> None:
+        if self._is_pending_victim(victims[0].name) or victims[0].claim.is_deleting():
+            return
+        out, ok = self._simulate_removal(pool, victims, cat, views, None)
+        if not ok:
+            return
+        self._execute(pool, victims, out, reason, now)
+        self.stats[stat if stat in self.stats else "drift"] += 1
+
+    def _execute(self, pool: NodePool, victims: List[NodeView], out,
+                 reason: str, now: float) -> None:
+        node_class = self.store.nodeclasses.get(pool.node_class)
+        launched, failed = self.provisioner._launch(pool, node_class,
+                                                    out.launches, now)
+        if failed:
+            # replacement launch failed; roll back what did launch and keep
+            # the victims
+            for claim in launched:
+                self.termination.delete_nodeclaim(claim, now, "ReplacementAborted")
+            return
+        repl_names = [c.name for c in launched]
+        if not out.launches:
+            # no replacement needed: drain immediately
+            for v in victims:
+                self.termination.delete_nodeclaim(v.claim, now, reason)
+            return
+        self._pending.append(PendingDisruption(
+            victim_claims=[v.name for v in victims],
+            replacement_claims=repl_names, reason=reason, decided_at=now))
+        self.store.record_event("disruption", ",".join(v.name for v in victims),
+                                reason, f"replacements: {repl_names}")
+
+    # --- budgets ---
+    def _budget(self, pool: NodePool, views: List[NodeView], reason: str) -> int:
+        total = len(views)
+        allowed = pool.disruption.allowed_disruptions(reason, total)
+        disrupting = sum(1 for v in views if v.claim.is_deleting())
+        disrupting += sum(len(pd.victim_claims) for pd in self._pending)
+        return max(0, allowed - disrupting)
+
+    def _is_pending_victim(self, name: str) -> bool:
+        return any(name in pd.victim_claims for pd in self._pending)

@@ -1,0 +1,46 @@
+"""Garbage collection: leaked-instance reaper.
+
+Reference: pkg/controllers/nodeclaim/garbagecollection/controller.go:41-112
+— a 2-minute polling sweep terminating cloud instances whose NodeClaim is
+gone (launch raced a crash, claim deleted out-of-band), and dropping node
+objects whose instance is gone.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from ..state.store import Store
+
+SWEEP_INTERVAL = 120.0
+MIN_AGE = 30.0  # don't reap instances still racing their claim creation
+
+
+@dataclass
+class GarbageCollectionController:
+    store: Store
+    cloud: object
+    name: str = "gc"
+    requeue: float = SWEEP_INTERVAL
+    stats: Dict[str, int] = field(default_factory=lambda: {
+        "instances_reaped": 0, "nodes_reaped": 0})
+
+    def reconcile(self, now: float) -> float:
+        claimed = {c.provider_id for c in self.store.nodeclaims.values()
+                   if c.provider_id}
+        for inst in self.cloud.describe():
+            if inst.provider_id in claimed:
+                continue
+            if now - inst.launch_time < MIN_AGE:
+                continue
+            self.cloud.terminate([inst.id])
+            self.stats["instances_reaped"] += 1
+            self.store.record_event("instance", inst.id, "GarbageCollected",
+                                    "no NodeClaim")
+        live = {i.provider_id for i in self.cloud.describe()}
+        for node in list(self.store.nodes.values()):
+            if node.provider_id not in live:
+                self.store.delete_node(node.name)
+                self.stats["nodes_reaped"] += 1
+        return self.requeue

@@ -1,0 +1,62 @@
+"""Interruption controller: queue consumer → graceful drain ahead of
+capacity loss.
+
+Reference: pkg/controllers/interruption/controller.go:62-139 — long-polls
+the SQS queue in 10-message batches, parses EventBridge messages (spot
+interruption, rebalance recommendation, scheduled change, state change),
+maps instance → NodeClaim via the provider-id index, deletes the NodeClaim
+(triggering graceful drain) and marks the offering unavailable on spot
+interrupts so the next Solve avoids the reclaimed pool.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from ..catalog.provider import CatalogProvider
+from ..state.store import Store
+from .termination import TerminationController
+
+ACTIONABLE = {"spot-interruption", "scheduled-change", "state-change"}
+# rebalance recommendations are observability-only by default, like the
+# reference (it deletes only for actionable kinds)
+
+
+@dataclass
+class InterruptionController:
+    store: Store
+    cloud: object
+    catalog: CatalogProvider
+    termination: TerminationController
+    name: str = "interruption"
+    requeue: float = 0.5
+    batch_size: int = 10
+    stats: Dict[str, int] = field(default_factory=dict)
+
+    def reconcile(self, now: float) -> float:
+        while True:
+            messages = self.cloud.poll_interruptions(self.batch_size)
+            if not messages:
+                return self.requeue
+            for msg in list(messages):
+                self._handle(msg, now)
+                self.cloud.delete_message(msg)
+            if len(messages) < self.batch_size:
+                return self.requeue
+
+    def _handle(self, msg: dict, now: float) -> None:
+        kind = msg.get("kind", "")
+        self.stats[kind] = self.stats.get(kind, 0) + 1
+        if kind == "spot-interruption":
+            # the reclaimed pool will be tight for a while
+            self.catalog.unavailable.mark_unavailable(
+                msg["instance_type"], msg["zone"], msg["capacity_type"],
+                reason="spot-interrupted")
+        if kind not in ACTIONABLE:
+            return
+        claim = self.store.nodeclaim_by_provider_id(msg.get("provider_id", ""))
+        if claim is None:
+            return
+        self.store.record_event("nodeclaim", claim.name, "Interrupted", kind)
+        self.termination.delete_nodeclaim(claim, now, kind)

@@ -68,16 +68,17 @@ class Provisioner:
         if not node_class.ready:
             return pods  # NodeClass readiness gate (cloudprovider.go:102-111)
         cat = self.solver.tensors(node_class)
-        # in-flight claims of this pool absorb pods first; their current
-        # pods ride along so anti-affinity caps hold across reconciles
+        # live + in-flight claims of this pool absorb pods first (real-node
+        # headroom reuse; reference simulates against cluster state the same
+        # way); their current pods ride along so anti-affinity caps hold
+        # across reconciles
+        from ..state.cluster import build_node_views
         existing, existing_pods = [], {}
-        for claim in self.store.nodeclaims_for_pool(pool.name):
-            if claim.is_deleting() or claim.phase == Phase.FAILED:
+        for view in build_node_views(self.store, cat, now):
+            if view.claim.nodepool != pool.name:
                 continue
-            vn = virtual_node_from_claim(claim, cat, claim.resource_requests)
-            if vn is not None:
-                existing.append(vn)
-                existing_pods[claim.name] = self._pods_of_claim(claim)
+            existing.append(view.virtual)
+            existing_pods[view.claim.name] = view.pods
         out = self.solver.solve(pods, pool, node_class, existing,
                                 existing_pods=existing_pods)
         self.stats["solves"] += 1
@@ -116,7 +117,7 @@ class Provisioner:
                 self.store.record_event("nodepool", pool.name, "LimitExceeded",
                                         f"cannot schedule {p.name}")
 
-        failed_pods = self._launch(pool, node_class, launches, now)
+        _, failed_pods = self._launch(pool, node_class, launches, now)
         leftover = [by_key[k] for k in out.unschedulable] + over_limit_pods + failed_pods
         return leftover
 
@@ -152,9 +153,10 @@ class Provisioner:
 
     # --- launch ---
     def _launch(self, pool: NodePool, node_class: NodeClassSpec,
-                launches: List[NodeLaunch], now: float) -> List[Pod]:
+                launches: List[NodeLaunch], now: float):
+        """Returns (created_claims, pods_of_failed_launches)."""
         if not launches:
-            return []
+            return [], []
         requests, claims = [], []
         for launch in launches:
             claim = NodeClaim(
@@ -178,6 +180,7 @@ class Provisioner:
                 tags={**node_class.tags, "karpenter.tpu/nodepool": pool.name}))
         results = self.cloud.create_fleet(requests)
 
+        launched: List[NodeClaim] = []
         failed_pods: List[Pod] = []
         for (claim, launch), res in zip(claims, results):
             if isinstance(res, Instance):
@@ -202,11 +205,12 @@ class Provisioner:
                     if pod is not None:
                         self._nominate(pod, claim)
                 self.stats["launches"] += 1
+                launched.append(claim)
             else:
                 self._handle_launch_error(claim, res)
                 failed_pods.extend(self.store.pods[k] for k in launch.pod_keys
                                    if k in self.store.pods)
-        return failed_pods
+        return launched, failed_pods
 
     def _handle_launch_error(self, claim: NodeClaim, err: CloudError) -> None:
         claim.phase = Phase.FAILED

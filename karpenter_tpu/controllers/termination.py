@@ -1,0 +1,81 @@
+"""Termination controller: graceful drain + finalize.
+
+Reference behavior (core termination controller + the provider's Delete
+path, SURVEY.md §3.4): a NodeClaim with a deletion timestamp gets its node
+tainted `disrupted:NoSchedule`, pods are evicted (respecting a grace
+period), the cloud instance is terminated, and only then does the claim
+disappear (finalizer semantics — nothing leaks even across restarts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..models import labels as L
+from ..models.nodeclaim import NodeClaim, Phase
+from ..models.pod import Taint
+from ..state.store import Store
+from .provisioner import NOMINATED
+
+DISRUPTED_TAINT = Taint(key="karpenter.tpu/disrupted", effect="NoSchedule")
+DEFAULT_GRACE = 30.0
+
+
+@dataclass
+class TerminationController:
+    store: Store
+    cloud: object
+    name: str = "termination"
+    requeue: float = 0.5
+    drain_grace: float = DEFAULT_GRACE
+    _drain_started: Dict[str, float] = field(default_factory=dict)
+
+    def delete_nodeclaim(self, claim: NodeClaim, now: float, reason: str = "") -> None:
+        """Entry point other controllers use (interruption, disruption,
+        expiration): marks for deletion; reconcile drives the drain."""
+        if claim.deletion_timestamp is None:
+            claim.deletion_timestamp = now
+            claim.phase = Phase.TERMINATING
+            self.store.record_event("nodeclaim", claim.name, "Terminating", reason)
+
+    def reconcile(self, now: float) -> float:
+        for claim in list(self.store.nodeclaims.values()):
+            if claim.deletion_timestamp is None:
+                continue
+            self._terminate_one(claim, now)
+        return self.requeue
+
+    def _terminate_one(self, claim: NodeClaim, now: float) -> None:
+        node = self.store.node_for_nodeclaim(claim)
+        if node is not None:
+            # taint so nothing schedules onto it mid-drain
+            if not any(t.key == DISRUPTED_TAINT.key for t in node.taints):
+                node.taints.append(DISRUPTED_TAINT)
+            start = self._drain_started.setdefault(claim.name, now)
+            grace = claim.termination_grace_period or self.drain_grace
+            pods = self.store.pods_on_node(node.name)
+            if pods and now - start < grace:
+                # evict: unbind, pods return to pending for rescheduling.
+                # Keep nominations pointing at OTHER claims (a pre-spun
+                # consolidation replacement) — only clear ones aimed here.
+                for p in pods:
+                    p.node_name = None
+                    p.phase = "Pending"
+                    if p.annotations.get(NOMINATED) == claim.name:
+                        p.annotations.pop(NOMINATED)
+                return  # wait a tick for rescheduling before teardown
+            self.store.delete_node(node.name)
+        # un-nominate pods still pointing at this claim
+        for p in self.store.pods.values():
+            if p.annotations.get(NOMINATED) == claim.name:
+                del p.annotations[NOMINATED]
+                p.node_name = None
+                p.phase = "Pending"
+        if claim.provider_id:
+            iid = claim.provider_id.rsplit("/", 1)[-1]
+            self.cloud.terminate([iid])
+        claim.phase = Phase.TERMINATED
+        self._drain_started.pop(claim.name, None)
+        self.store.delete_nodeclaim(claim.name)
+        self.store.record_event("nodeclaim", claim.name, "Terminated")

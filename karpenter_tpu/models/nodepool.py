@@ -43,7 +43,10 @@ class Budget:
     def max_disruptions(self, total_nodes: int) -> int:
         s = self.nodes.strip()
         if s.endswith("%"):
-            return int(total_nodes * float(s[:-1]) / 100.0)
+            # ceil so a small pool under a percentage budget can still make
+            # progress (a floor would freeze a 1-node pool at "10%" forever)
+            import math
+            return math.ceil(total_nodes * float(s[:-1]) / 100.0)
         return int(s)
 
 

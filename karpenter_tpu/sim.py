@@ -14,9 +14,13 @@ from typing import List, Optional
 from .catalog.generator import GeneratorConfig, generate_catalog, small_catalog
 from .catalog.provider import CatalogProvider
 from .cloud.fake import FakeCloud, FakeCloudConfig
+from .controllers.disruption import DisruptionController
 from .controllers.engine import Engine
+from .controllers.gc import GarbageCollectionController
+from .controllers.interruption import InterruptionController
 from .controllers.lifecycle import BindingController, LifecycleController
 from .controllers.provisioner import Provisioner
+from .controllers.termination import TerminationController
 from .models.instancetype import InstanceType
 from .models.nodepool import NodeClassSpec, NodePool
 from .ops.facade import Solver
@@ -35,6 +39,10 @@ class SimEnvironment:
     provisioner: Provisioner
     lifecycle: LifecycleController
     binding: BindingController
+    termination: TerminationController
+    disruption: DisruptionController
+    interruption: InterruptionController
+    gc: GarbageCollectionController
 
 
 def make_sim(types: Optional[List[InstanceType]] = None,
@@ -51,7 +59,16 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                               catalog=catalog)
     lifecycle = LifecycleController(store=store, cloud=cloud)
     binding = BindingController(store=store)
-    engine = Engine(clock=clock).add(provisioner, lifecycle, binding)
+    termination = TerminationController(store=store, cloud=cloud)
+    disruption = DisruptionController(store=store, solver=solver,
+                                      catalog=catalog, provisioner=provisioner,
+                                      termination=termination)
+    interruption = InterruptionController(store=store, cloud=cloud,
+                                          catalog=catalog,
+                                          termination=termination)
+    gc = GarbageCollectionController(store=store, cloud=cloud)
+    engine = Engine(clock=clock).add(provisioner, lifecycle, binding,
+                                     termination, disruption, interruption, gc)
 
     # cloud → store node materialization (kubelet joining the cluster)
     cloud.on_node_created.append(store.add_node)
@@ -71,4 +88,6 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     return SimEnvironment(clock=clock, store=store, cloud=cloud,
                           catalog=catalog, solver=solver, engine=engine,
                           provisioner=provisioner, lifecycle=lifecycle,
-                          binding=binding)
+                          binding=binding, termination=termination,
+                          disruption=disruption, interruption=interruption,
+                          gc=gc)

@@ -1,0 +1,85 @@
+"""ClusterState: the solver-facing view of live nodes.
+
+The reference keeps an in-memory cluster mirror (`state.NewCluster`,
+cmd/controller/main.go:43) that the scheduler and disruption controllers
+simulate against. Ours projects the Store into VirtualNodes (committed
+type + occupancy) so provisioning fills real headroom and consolidation
+re-solves against it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models import labels as L
+from ..models.nodeclaim import Node, NodeClaim, Phase
+from ..models.pod import Pod
+from ..models.resources import Resources
+from ..ops.binpack import VirtualNode
+from ..ops.encode import CatalogTensors
+from ..state.store import Store
+
+
+@dataclass
+class NodeView:
+    claim: NodeClaim
+    node: Optional[Node]
+    pods: List[Pod]
+    virtual: VirtualNode
+    price: float
+
+    @property
+    def name(self) -> str:
+        return self.claim.name
+
+    def disruption_cost(self) -> float:
+        """Candidate ordering (reference consolidation orders candidates by
+        pod count / deletion cost / priority / remaining lifetime —
+        designs/consolidation.md): cheaper-to-disrupt first."""
+        cost = 0.0
+        for p in self.pods:
+            cost += 1.0 + p.deletion_cost / 1000.0 + p.priority / 1e6
+        return cost
+
+    def has_do_not_disrupt(self) -> bool:
+        return any(p.do_not_disrupt() for p in self.pods)
+
+
+def build_node_views(store: Store, cat: CatalogTensors,
+                     clock_now: float) -> List[NodeView]:
+    views: List[NodeView] = []
+    for claim in store.nodeclaims.values():
+        if claim.is_deleting() or claim.phase not in (Phase.LAUNCHED,
+                                                      Phase.REGISTERED,
+                                                      Phase.INITIALIZED):
+            continue
+        t_idx = cat.name_to_idx.get(claim.instance_type or "")
+        if t_idx is None:
+            continue
+        node = store.node_for_nodeclaim(claim)
+        pods = store.pods_on_node(node.name) if node else []
+        # nominated-but-unbound pods also occupy the claim
+        from ..controllers.provisioner import NOMINATED
+        for p in store.pods.values():
+            if p.annotations.get(NOMINATED) == claim.name and p.node_name is None:
+                pods.append(p)
+        cum_res = Resources()
+        for p in pods:
+            cum_res = cum_res.add(p.requests)
+        vec = cum_res.to_vector()
+        cum = np.zeros(len(cat.resources), np.float32)
+        cum[: len(vec)] = vec[: len(cum)]
+        zone_mask = np.array([z == claim.zone for z in cat.zones], bool) \
+            if claim.zone else np.ones(cat.Z, bool)
+        cap_mask = np.array([c == claim.capacity_type for c in cat.captypes], bool) \
+            if claim.capacity_type else np.ones(cat.C, bool)
+        views.append(NodeView(
+            claim=claim, node=node, pods=pods,
+            virtual=VirtualNode(type_idx=t_idx, zone_mask=zone_mask,
+                                cap_mask=cap_mask, cum=cum,
+                                existing_name=claim.name),
+            price=claim.price))
+    return views

@@ -1,0 +1,159 @@
+"""Disruption: emptiness, consolidation, drift, expiration, interruption, GC."""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodeclaim import Phase
+from karpenter_tpu.models.nodepool import DisruptionSpec, NodePool
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def add_pods(sim, n, cpu="500m", mem="1Gi", prefix="p", **kw):
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def all_bound(sim):
+    return all(p.node_name is not None for p in sim.store.pods.values())
+
+
+def settle(sim, timeout=120):
+    ok = sim.engine.run_until(lambda: all_bound(sim), timeout=timeout)
+    assert ok
+    return ok
+
+
+class TestEmptiness:
+    def test_empty_node_deleted(self):
+        sim = make_sim()
+        pods = add_pods(sim, 20)
+        settle(sim)
+        n_claims = len(sim.store.nodeclaims)
+        # all pods leave → nodes become empty → consolidated away
+        for p in pods:
+            sim.store.delete_pod(p.namespace, p.name)
+        sim.engine.run_until(lambda: not sim.store.nodeclaims, timeout=300)
+        assert not sim.store.nodeclaims
+        assert sim.disruption.stats["empty"] >= 1
+        # instances actually terminated
+        assert not sim.cloud.describe()
+
+    def test_when_empty_policy_never_consolidates_utilized(self):
+        pool = NodePool(name="default",
+                        disruption=DisruptionSpec(consolidation_policy="WhenEmpty"))
+        sim = make_sim(nodepool=pool)
+        add_pods(sim, 30)
+        settle(sim)
+        n = len(sim.store.nodeclaims)
+        sim.engine.run_for(300, step=5)
+        assert len(sim.store.nodeclaims) == n  # nothing disrupted
+        assert sim.disruption.stats["consolidated"] == 0
+
+
+class TestConsolidation:
+    def test_single_node_consolidation_after_scale_down(self):
+        sim = make_sim()
+        pods = add_pods(sim, 60)
+        settle(sim)
+        n_before = len(sim.store.nodeclaims)
+        cost_before = sum(c.price for c in sim.store.nodeclaims.values())
+        # remove 70% of pods: cluster is now heavily under-utilized
+        for p in pods[: int(len(pods) * 0.7)]:
+            sim.store.delete_pod(p.namespace, p.name)
+        sim.engine.run_for(600, step=5)
+        assert all_bound(sim)  # survivors stayed scheduled
+        cost_after = sum(c.price for c in sim.store.nodeclaims.values())
+        assert len(sim.store.nodeclaims) < n_before
+        assert cost_after < cost_before
+        stats = sim.disruption.stats
+        assert stats["empty"] + stats["consolidated"] + stats["multi_consolidated"] > 0
+
+    def test_do_not_disrupt_blocks_consolidation(self):
+        sim = make_sim()
+        pods = add_pods(sim, 10, annotations={"karpenter.tpu/do-not-disrupt": "true"})
+        settle(sim)
+        claims = set(sim.store.nodeclaims)
+        # even with massive headroom, protected pods pin their nodes
+        sim.engine.run_for(400, step=5)
+        assert claims <= set(sim.store.nodeclaims)
+
+    def test_budget_limits_disruptions(self):
+        from karpenter_tpu.models.nodepool import Budget
+        pool = NodePool(name="default", disruption=DisruptionSpec(
+            budgets=[Budget(nodes="0")]))  # no voluntary disruption at all
+        sim = make_sim(nodepool=pool)
+        pods = add_pods(sim, 20)
+        settle(sim)
+        n = len(sim.store.nodeclaims)
+        for p in pods:
+            sim.store.delete_pod(p.namespace, p.name)
+        sim.engine.run_for(400, step=5)
+        assert len(sim.store.nodeclaims) == n  # budget 0 blocks even empties
+
+
+class TestDriftExpiration:
+    def test_nodeclass_drift_replaces_nodes(self):
+        sim = make_sim()
+        add_pods(sim, 10)
+        settle(sim)
+        old = set(sim.store.nodeclaims)
+        # mutate the NodeClass → hash changes → drift
+        sim.store.nodeclasses["default"].user_data = "#!/bin/bash\necho new"
+        sim.engine.run_for(600, step=5)
+        assert all_bound(sim)
+        assert sim.disruption.stats["drift"] >= 1
+        new = set(sim.store.nodeclaims)
+        assert not (old & new)  # every old claim replaced
+        nc_hash = sim.store.nodeclasses["default"].hash()
+        for c in sim.store.nodeclaims.values():
+            assert c.annotations["karpenter.tpu/nodeclass-hash"] == nc_hash
+
+    def test_expiration(self):
+        pool = NodePool(name="default", expire_after=3600.0)
+        sim = make_sim(nodepool=pool)
+        add_pods(sim, 5)
+        settle(sim)
+        old = set(sim.store.nodeclaims)
+        sim.engine.run_for(4000, step=20)
+        assert all_bound(sim)
+        assert not (old & set(sim.store.nodeclaims))
+        assert sim.disruption.stats["expired"] >= 1
+
+
+class TestInterruption:
+    def test_spot_interruption_drains_and_marks(self):
+        sim = make_sim()
+        add_pods(sim, 10)
+        settle(sim)
+        victim = next(iter(sim.store.nodeclaims.values()))
+        iid = victim.provider_id.rsplit("/", 1)[-1]
+        inst = sim.cloud.instances[iid]
+        sim.cloud.send_spot_interruption(iid)
+        sim.engine.run_for(60)
+        # claim drained + offering marked unavailable
+        assert victim.name not in sim.store.nodeclaims
+        assert sim.catalog.unavailable.is_unavailable(
+            inst.instance_type, inst.zone, inst.capacity_type)
+        # pods rescheduled elsewhere
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+
+
+class TestGC:
+    def test_leaked_instance_reaped(self):
+        sim = make_sim()
+        from karpenter_tpu.cloud.provider import LaunchOverride, LaunchRequest
+        t = next(iter(sim.cloud.types.values()))
+        o = t.offerings[0]
+        res = sim.cloud.create_fleet([LaunchRequest(
+            nodeclaim_name="ghost",
+            overrides=[LaunchOverride(t.name, o.zone, o.capacity_type, o.price)])])
+        assert res[0].id in sim.cloud.instances
+        sim.engine.run_for(200, step=10)
+        assert sim.cloud.instances[res[0].id].state == "terminated"
+        assert sim.gc.stats["instances_reaped"] == 1
