@@ -1,0 +1,117 @@
+"""Windowed request coalescing — the reference's concurrency kernel.
+
+Reference: pkg/batcher/batcher.go:32-84 — generic Batcher[T, U] with
+per-hash buckets, an idle-timeout/max-timeout trigger window, and a batch
+executor that fans one wire call back out to N callers. Instantiated for
+CreateFleet (one bucket), DescribeInstances (hash by filters), and
+TerminateInstances. Ours is asyncio-based with the same Options surface;
+the deterministic sim engine doesn't need it (one reconciler), but the
+async runtime batches concurrent reconcilers' cloud calls through it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import (Awaitable, Callable, Dict, Generic, Hashable, List,
+                    Optional, Sequence, TypeVar)
+
+T = TypeVar("T")  # request item
+U = TypeVar("U")  # response item
+
+DEFAULT_IDLE = 0.100   # reference: 100ms idle window
+DEFAULT_MAX = 1.0      # reference: 1s max window
+DEFAULT_MAX_ITEMS = 500
+
+
+@dataclass
+class BatcherOptions:
+    idle_timeout: float = DEFAULT_IDLE
+    max_timeout: float = DEFAULT_MAX
+    max_items: int = DEFAULT_MAX_ITEMS
+    # request hasher: requests with equal hashes share a wire call
+    request_hasher: Callable[[object], Hashable] = lambda _req: 0
+
+
+class Batcher(Generic[T, U]):
+    """executor(batch) -> list of per-item results (or one exception for
+    the whole batch). Callers `await submit(item)` and get their item's
+    result."""
+
+    def __init__(self, executor: Callable[[List[T]], Awaitable[List[U]]],
+                 options: Optional[BatcherOptions] = None):
+        self.executor = executor
+        self.options = options or BatcherOptions()
+        self._buckets: Dict[Hashable, "_Bucket[T, U]"] = {}
+        self.stats = {"batches": 0, "items": 0, "largest_batch": 0}
+
+    async def submit(self, item: T) -> U:
+        key = self.options.request_hasher(item)
+        bucket = self._buckets.get(key)
+        if bucket is None or bucket.closed:
+            bucket = _Bucket(self)
+            self._buckets[key] = bucket
+        return await bucket.add(item)
+
+
+class _Bucket(Generic[T, U]):
+    def __init__(self, parent: Batcher):
+        self.parent = parent
+        self.items: List[T] = []
+        self.futures: List[asyncio.Future] = []
+        self.closed = False
+        self._first_at: Optional[float] = None
+        self._idle_task: Optional[asyncio.Task] = None
+        self._loop = asyncio.get_event_loop()
+
+    async def add(self, item: T) -> U:
+        opts = self.parent.options
+        fut: asyncio.Future = self._loop.create_future()
+        self.items.append(item)
+        self.futures.append(fut)
+        now = self._loop.time()
+        if self._first_at is None:
+            self._first_at = now
+        if len(self.items) >= opts.max_items:
+            self._fire()
+        else:
+            if self._idle_task is not None:
+                self._idle_task.cancel()
+            remaining_max = self._first_at + opts.max_timeout - now
+            delay = min(opts.idle_timeout, max(0.0, remaining_max))
+            self._idle_task = self._loop.create_task(self._fire_after(delay))
+        return await fut
+
+    async def _fire_after(self, delay: float) -> None:
+        try:
+            await asyncio.sleep(delay)
+        except asyncio.CancelledError:
+            return
+        self._fire()
+
+    def _fire(self) -> None:
+        if self.closed or not self.items:
+            return
+        self.closed = True
+        if self._idle_task is not None:
+            self._idle_task.cancel()
+        items, futures = self.items, self.futures
+        stats = self.parent.stats
+        stats["batches"] += 1
+        stats["items"] += len(items)
+        stats["largest_batch"] = max(stats["largest_batch"], len(items))
+
+        async def run():
+            try:
+                results = await self.parent.executor(items)
+                for f, r in zip(futures, results):
+                    if not f.done():
+                        if isinstance(r, Exception):
+                            f.set_exception(r)
+                        else:
+                            f.set_result(r)
+            except Exception as e:  # batch-wide failure fans out to all
+                for f in futures:
+                    if not f.done():
+                        f.set_exception(e)
+        self._loop.create_task(run())

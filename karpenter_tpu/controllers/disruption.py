@@ -275,6 +275,10 @@ class DisruptionController:
             for v in victims:
                 self.termination.delete_nodeclaim(v.claim, now, reason)
             return
+        from ..metrics import DISRUPTION_DECISIONS
+        DISRUPTION_DECISIONS.inc(
+            reason=reason,
+            consolidation_type="multi" if len(victims) > 1 else "single")
         self._pending.append(PendingDisruption(
             victim_claims=[v.name for v in victims],
             replacement_claims=repl_names, reason=reason, decided_at=now))

@@ -48,6 +48,8 @@ class InterruptionController:
     def _handle(self, msg: dict, now: float) -> None:
         kind = msg.get("kind", "")
         self.stats[kind] = self.stats.get(kind, 0) + 1
+        from ..metrics import INTERRUPTION_MESSAGES
+        INTERRUPTION_MESSAGES.inc(kind=kind)
         if kind == "spot-interruption":
             # the reclaimed pool will be tight for a while
             self.catalog.unavailable.mark_unavailable(

@@ -1,0 +1,36 @@
+"""Cloudprovider metrics controller.
+
+Reference: pkg/controllers/metrics/metrics.go:31-59 — exports per-offering
+availability and price-estimate gauges for every (instanceType, zone,
+capacityType) in the catalog, refreshed on a poll.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..catalog.provider import CatalogProvider
+from ..metrics import OFFERING_AVAILABLE, OFFERING_PRICE
+
+
+@dataclass
+class CloudProviderMetricsController:
+    catalog: CatalogProvider
+    name: str = "metrics.cloudprovider"
+    requeue: float = 60.0
+    _last_epoch: tuple = ()
+
+    def reconcile(self, now: float) -> float:
+        epoch = tuple(self.catalog.epoch)
+        if epoch == self._last_epoch:
+            return self.requeue
+        self._last_epoch = epoch
+        OFFERING_AVAILABLE.clear()
+        OFFERING_PRICE.clear()
+        for t in self.catalog.list():
+            for o in t.offerings:
+                labels = dict(instance_type=t.name, zone=o.zone,
+                              capacity_type=o.capacity_type)
+                OFFERING_AVAILABLE.set(1.0 if o.available else 0.0, **labels)
+                OFFERING_PRICE.set(o.price, **labels)
+        return self.requeue

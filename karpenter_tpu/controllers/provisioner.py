@@ -26,6 +26,8 @@ from ..models import labels as L
 from ..models.nodeclaim import NodeClaim, Phase, new_nodeclaim_name
 from ..models.nodepool import NodeClassSpec, NodePool
 from ..models.pod import Pod
+from ..metrics import (ICE_ERRORS, NODECLAIMS_CREATED, PODS_SCHEDULED,
+                       PODS_UNSCHEDULABLE)
 from ..models.resources import Resources
 from ..ops.facade import NodeLaunch, Solver, virtual_node_from_claim
 from ..state.store import Store
@@ -56,6 +58,7 @@ class Provisioner:
                 break
             remaining = self._provision_pool(pool, remaining, now)
         self.stats["unschedulable"] = len(remaining)
+        PODS_UNSCHEDULABLE.set(len(remaining))
         for p in remaining:
             self.store.record_event("pod", f"{p.namespace}/{p.name}",
                                     "FailedScheduling", "no nodepool could schedule")
@@ -206,6 +209,9 @@ class Provisioner:
                         self._nominate(pod, claim)
                 self.stats["launches"] += 1
                 launched.append(claim)
+                NODECLAIMS_CREATED.inc(nodepool=claim.nodepool,
+                                       instance_type=claim.instance_type,
+                                       capacity_type=claim.capacity_type)
             else:
                 self._handle_launch_error(claim, res)
                 failed_pods.extend(self.store.pods[k] for k in launch.pod_keys
@@ -220,7 +226,9 @@ class Provisioner:
         if isinstance(err, InsufficientCapacityError):
             self.stats["ice_errors"] += 1
             for (t, z, c) in err.offerings:
+                ICE_ERRORS.inc(capacity_type=c)
                 self.catalog.unavailable.mark_unavailable(t, z, c, reason="ICE")
 
     def _nominate(self, pod: Pod, claim: NodeClaim) -> None:
         pod.annotations[NOMINATED] = claim.name
+        PODS_SCHEDULED.inc()

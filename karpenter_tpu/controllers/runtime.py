@@ -1,0 +1,78 @@
+"""Async runtime: wall-clock driver for the same controllers the sim runs.
+
+The deployment shape (reference: controller-runtime manager with leader
+election + health probes, cmd/controller/main.go): each controller gets
+its own asyncio task honoring its requeue interval; a metrics endpoint
+serves the Prometheus registry; shutdown drains cleanly. Controllers are
+sync (reconcile(now) -> requeue) and fast; long waits live between
+reconciles, so a single event loop suffices — the TPU solve itself
+releases the loop only at call granularity, which is fine at ~100-200ms
+per 100k-pod solve.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..metrics import REGISTRY
+from ..utils.clock import RealClock
+
+
+@dataclass
+class Runtime:
+    clock: object = field(default_factory=RealClock)
+    controllers: List[object] = field(default_factory=list)
+    metrics_port: int = 0  # 0 = no endpoint
+    _stop: Optional[asyncio.Event] = None
+    _server: object = None
+
+    def add(self, *controllers) -> "Runtime":
+        self.controllers.extend(controllers)
+        return self
+
+    async def _run_controller(self, c) -> None:
+        while not self._stop.is_set():
+            try:
+                requeue = c.reconcile(self.clock.now())
+            except Exception as e:  # a crashing controller must not die silently
+                import traceback
+                traceback.print_exc()
+                requeue = 5.0
+            try:
+                await asyncio.wait_for(self._stop.wait(),
+                                       timeout=max(0.01, requeue))
+            except asyncio.TimeoutError:
+                pass
+
+    async def _serve_metrics(self) -> None:
+        async def handle(reader, writer):
+            try:
+                await reader.readline()
+                body = REGISTRY.expose().encode()
+                writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
+                             b"version=0.0.4\r\nContent-Length: "
+                             + str(len(body)).encode() + b"\r\n\r\n" + body)
+                await writer.drain()
+            finally:
+                writer.close()
+        self._server = await asyncio.start_server(handle, "127.0.0.1",
+                                                  self.metrics_port)
+
+    async def start(self) -> None:
+        self._stop = asyncio.Event()
+        if self.metrics_port:
+            await self._serve_metrics()
+        tasks = [asyncio.create_task(self._run_controller(c))
+                 for c in self.controllers]
+        await self._stop.wait()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        if self._server is not None:
+            self._server.close()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()

@@ -37,6 +37,9 @@ class TerminationController:
         if claim.deletion_timestamp is None:
             claim.deletion_timestamp = now
             claim.phase = Phase.TERMINATING
+            from ..metrics import NODECLAIMS_TERMINATED
+            NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool,
+                                      reason=reason or "unknown")
             self.store.record_event("nodeclaim", claim.name, "Terminating", reason)
 
     def reconcile(self, now: float) -> float:

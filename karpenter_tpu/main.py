@@ -1,0 +1,94 @@
+"""Operator entrypoint — the cmd/controller/main.go analog.
+
+Wires options → providers (dependency order mirrors the reference's
+operator construction, pkg/operator/operator.go:127-199: pricing →
+catalog (sync hydrate before start, :187-188) → solver → controllers) and
+starts the async runtime with the metrics endpoint.
+
+The cloud backend here is the in-memory fake (this framework's kwok): a
+real TPU-cloud backend implements the same CloudProvider protocol +
+`describe_types()` seam.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from .catalog.generator import GeneratorConfig, generate_catalog
+from .catalog.provider import CatalogProvider
+from .cloud.fake import FakeCloud, FakeCloudConfig
+from .controllers.disruption import DisruptionController
+from .controllers.gc import GarbageCollectionController
+from .controllers.interruption import InterruptionController
+from .controllers.lifecycle import BindingController, LifecycleController
+from .controllers.metrics_controller import CloudProviderMetricsController
+from .controllers.provisioner import Provisioner
+from .controllers.runtime import Runtime
+from .controllers.termination import TerminationController
+from .models.nodepool import NodeClassSpec, NodePool
+from .ops.facade import Solver
+from .state.store import Store
+from .utils.clock import RealClock
+from .utils.options import Options
+
+
+def build_operator(options: Optional[Options] = None,
+                   cloud: Optional[FakeCloud] = None,
+                   store: Optional[Store] = None):
+    """Construct the full controller set; returns (runtime, store, cloud)."""
+    opts = options or Options.parse()
+    clock = RealClock()
+    store = store or Store()
+    cloud = cloud or FakeCloud(generate_catalog(
+        GeneratorConfig(region=opts.region)), clock=clock)
+    catalog = CatalogProvider(lambda: cloud.describe_types(), clock=clock)
+    catalog.raw_types()  # sync hydrate before controllers start
+    solver = Solver(catalog, backend=opts.solver_backend)
+    provisioner = Provisioner(store=store, solver=solver, cloud=cloud,
+                              catalog=catalog,
+                              batch_idle=opts.batch_idle_seconds)
+    lifecycle = LifecycleController(store=store, cloud=cloud)
+    binding = BindingController(store=store)
+    termination = TerminationController(store=store, cloud=cloud)
+    disruption = DisruptionController(store=store, solver=solver,
+                                      catalog=catalog,
+                                      provisioner=provisioner,
+                                      termination=termination)
+    gc = GarbageCollectionController(store=store, cloud=cloud)
+    metrics_c = CloudProviderMetricsController(catalog=catalog)
+
+    controllers: List[object] = [provisioner, lifecycle, binding, termination,
+                                 disruption, gc, metrics_c]
+    if opts.interruption_queue:
+        controllers.append(InterruptionController(
+            store=store, cloud=cloud, catalog=catalog,
+            termination=termination))
+
+    runtime = Runtime(clock=clock, metrics_port=opts.metrics_port)
+    runtime.add(*controllers)
+
+    class _CloudTicker:
+        name = "cloud.tick"
+
+        def reconcile(self, now: float) -> float:
+            cloud.tick()
+            return 0.5
+    cloud.on_node_created.append(store.add_node)
+    runtime.add(_CloudTicker())
+
+    store.add_nodeclass(NodeClassSpec(name="default"))
+    store.add_nodepool(NodePool(name="default"))
+    return runtime, store, cloud
+
+
+def main() -> None:
+    runtime, _store, _cloud = build_operator()
+    try:
+        asyncio.run(runtime.start())
+    except KeyboardInterrupt:
+        runtime.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,53 @@
+"""Framework metrics: the reference's metric families re-homed.
 
+Parity map (reference website/docs reference/metrics.md):
+  karpenter_nodeclaims_*            -> nodeclaims_created/terminated
+  karpenter_scheduler_scheduling_duration_seconds -> solve_duration
+  karpenter_voluntary_disruption_decisions_total  -> disruption_decisions
+  karpenter_cloudprovider_instance_type_offering_available/price_estimate
+                                    -> offering_available / offering_price
+  karpenter_pods_*                  -> pods_scheduled/unschedulable
+  batcher histograms (pkg/batcher/metrics.go) -> batch_size
+  interruption messages             -> interruption_messages
+"""
+
+from .registry import (Counter, Gauge, Histogram, Registry, DEFAULT_BUCKETS)
+
+REGISTRY = Registry()
+
+NODECLAIMS_CREATED = REGISTRY.counter(
+    "karpenter_tpu_nodeclaims_created_total",
+    "NodeClaims launched", ("nodepool", "instance_type", "capacity_type"))
+NODECLAIMS_TERMINATED = REGISTRY.counter(
+    "karpenter_tpu_nodeclaims_terminated_total",
+    "NodeClaims terminated", ("nodepool", "reason"))
+SOLVE_DURATION = REGISTRY.histogram(
+    "karpenter_tpu_solver_solve_duration_seconds",
+    "Solve() wall time", ("backend",))
+SOLVE_PODS = REGISTRY.histogram(
+    "karpenter_tpu_solver_pods_per_solve",
+    "pods per Solve()", (), buckets=(1, 10, 100, 1000, 10_000, 100_000))
+PODS_SCHEDULED = REGISTRY.counter(
+    "karpenter_tpu_pods_scheduled_total", "pods nominated to nodes", ())
+PODS_UNSCHEDULABLE = REGISTRY.gauge(
+    "karpenter_tpu_pods_unschedulable", "pods no pool could place", ())
+DISRUPTION_DECISIONS = REGISTRY.counter(
+    "karpenter_tpu_voluntary_disruption_decisions_total",
+    "disruption decisions", ("reason", "consolidation_type"))
+OFFERING_AVAILABLE = REGISTRY.gauge(
+    "karpenter_tpu_cloudprovider_instance_type_offering_available",
+    "offering availability", ("instance_type", "zone", "capacity_type"))
+OFFERING_PRICE = REGISTRY.gauge(
+    "karpenter_tpu_cloudprovider_instance_type_offering_price_estimate",
+    "offering price", ("instance_type", "zone", "capacity_type"))
+ICE_ERRORS = REGISTRY.counter(
+    "karpenter_tpu_cloudprovider_insufficient_capacity_errors_total",
+    "ICE launch failures", ("capacity_type",))
+INTERRUPTION_MESSAGES = REGISTRY.counter(
+    "karpenter_tpu_interruption_messages_total",
+    "interruption queue messages", ("kind",))
+BATCH_SIZE = REGISTRY.histogram(
+    "karpenter_tpu_cloud_batcher_batch_size", "requests per wire call",
+    ("op",), buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500))
+
+__all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram"]

@@ -1,0 +1,155 @@
+"""Minimal Prometheus-style metrics registry.
+
+Mirrors the reference's metric surface (website/docs reference/metrics.md
+catalogs ~19 groups: nodeclaims, pods, scheduler durations, disruption
+decisions, cloudprovider offering gauges, batcher histograms...). No
+external client dependency; text exposition matches the Prometheus format
+so a scraper can consume `registry.expose()` verbatim.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(str(labels.get(k, "")) for k in self.label_names)
+
+    def _fmt_labels(self, key: Tuple[str, ...]) -> str:
+        if not self.label_names:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in zip(self.label_names, key))
+        return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{self._fmt_labels(k)} {v:g}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{self._fmt_labels(k)} {v:g}")
+        return out
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            i = bisect.bisect_left(self.buckets, value)
+            for j in range(i, len(self.buckets)):
+                counts[j] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        k = self._key(labels)
+        total = self._totals.get(k, 0)
+        if not total:
+            return None
+        counts = self._counts[k]
+        target = q * total
+        for b, c in zip(self.buckets, counts):
+            if c >= target:
+                return b
+        return self.buckets[-1]
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for k in sorted(self._totals):
+            labels = self._fmt_labels(k)
+            base = labels[1:-1] if labels else ""
+            for b, c in zip(self.buckets, self._counts[k]):
+                sep = "," if base else ""
+                out.append(f'{self.name}_bucket{{{base}{sep}le="{b:g}"}} {c}')
+            out.append(f'{self.name}_bucket{{{base}{"," if base else ""}le="+Inf"}} '
+                       f"{self._totals[k]}")
+            out.append(f"{self.name}_sum{labels} {self._sums[k]:g}")
+            out.append(f"{self.name}_count{labels} {self._totals[k]}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: List[_Metric] = []
+
+    def counter(self, name, help_, label_names=()) -> Counter:
+        m = Counter(name, help_, label_names)
+        self._metrics.append(m)
+        return m
+
+    def gauge(self, name, help_, label_names=()) -> Gauge:
+        m = Gauge(name, help_, label_names)
+        self._metrics.append(m)
+        return m
+
+    def histogram(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, help_, label_names, buckets)
+        self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"

@@ -121,11 +121,17 @@ class Solver:
                         counts[gi] = counts.get(gi, 0) + 1
                 vn.prior_by_group = counts
 
+        import time as _time
+
+        from ..metrics import SOLVE_DURATION, SOLVE_PODS
+        t0 = _time.perf_counter()
         if self.backend == "host":
             result = solve_host(cat, enc, existing)
         else:
             from .solver import solve_device
             result = solve_device(cat, enc, existing)
+        SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=self.backend)
+        SOLVE_PODS.observe(float(enc.counts.sum()))
 
         return self._decode(cat, enc, result, nodepool, dropped)
 

@@ -67,8 +67,11 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                                           catalog=catalog,
                                           termination=termination)
     gc = GarbageCollectionController(store=store, cloud=cloud)
+    from .controllers.metrics_controller import CloudProviderMetricsController
+    metrics_c = CloudProviderMetricsController(catalog=catalog)
     engine = Engine(clock=clock).add(provisioner, lifecycle, binding,
-                                     termination, disruption, interruption, gc)
+                                     termination, disruption, interruption,
+                                     gc, metrics_c)
 
     # cloud → store node materialization (kubelet joining the cluster)
     cloud.on_node_created.append(store.add_node)

@@ -1,0 +1,96 @@
+"""Layered configuration: flags < env vars < explicit overrides.
+
+Reference pattern: pkg/operator/options/options.go:30-58 — provider
+options layered onto core options via an Injectable interface, every flag
+mirrored by an env var, plus feature gates (Makefile:21-24: NodeRepair,
+ReservedCapacity, SpotToSpotConsolidation, ...).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+from typing import Dict, Optional
+
+
+def _env_name(flag: str) -> str:
+    return flag.upper().replace("-", "_")
+
+
+@dataclass
+class Options:
+    cluster_name: str = "karpenter-tpu"
+    region: str = "region-1"
+    # reference default vmMemoryOverheadPercent=0.075 (options.go)
+    vm_memory_overhead_percent: float = 0.075
+    interruption_queue: str = ""          # empty = interruption handling off
+    solver_backend: str = "device"        # device | host
+    batch_idle_seconds: float = 1.0
+    batch_max_seconds: float = 10.0
+    max_instance_types: int = 60
+    isolated: bool = False                # static pricing only (isolated-vpc)
+    metrics_port: int = 8080
+    log_level: str = "info"
+    # feature gates (reference Makefile:21-24 + settings.md)
+    feature_gates: Dict[str, bool] = field(default_factory=lambda: {
+        "SpotToSpotConsolidation": True,
+        "ReservedCapacity": True,
+        "NodeRepair": True,
+        "NodeOverlay": False,
+    })
+
+    def gate(self, name: str) -> bool:
+        return self.feature_gates.get(name, False)
+
+    @classmethod
+    def parse(cls, argv: Optional[list] = None,
+              env: Optional[Dict[str, str]] = None) -> "Options":
+        env = dict(os.environ if env is None else env)
+        parser = argparse.ArgumentParser("karpenter-tpu")
+        defaults = cls()
+        for f in fields(cls):
+            if f.name == "feature_gates":
+                parser.add_argument("--feature-gates", type=str, default=None,
+                                    help="Gate=true,Gate2=false")
+                continue
+            flag = "--" + f.name.replace("_", "-")
+            default = getattr(defaults, f.name)
+            if f.type in ("bool", bool):
+                parser.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                    default=None)
+            elif f.type in ("float", float):
+                parser.add_argument(flag, type=float, default=None)
+            elif f.type in ("int", int):
+                parser.add_argument(flag, type=int, default=None)
+            else:
+                parser.add_argument(flag, type=str, default=None)
+        args = parser.parse_args(argv or [])
+
+        out = cls()
+        for f in fields(cls):
+            if f.name == "feature_gates":
+                continue
+            # precedence: explicit flag > env var > default
+            val = getattr(args, f.name, None)
+            if val is None:
+                ev = env.get(_env_name(f.name))
+                if ev is not None:
+                    cur = getattr(out, f.name)
+                    if isinstance(cur, bool):
+                        val = ev.lower() in ("1", "true", "yes")
+                    elif isinstance(cur, float):
+                        val = float(ev)
+                    elif isinstance(cur, int):
+                        val = int(ev)
+                    else:
+                        val = ev
+            if val is not None:
+                setattr(out, f.name, val)
+        gates_str = args.feature_gates or env.get("FEATURE_GATES")
+        if gates_str:
+            for part in gates_str.split(","):
+                if "=" in part:
+                    k, v = part.split("=", 1)
+                    out.feature_gates[k.strip()] = v.strip().lower() in ("1", "true", "yes")
+        return out

@@ -1,0 +1,212 @@
+"""Batcher, metrics registry, options, async runtime."""
+
+import asyncio
+
+import pytest
+
+from karpenter_tpu.cloud.batcher import Batcher, BatcherOptions
+from karpenter_tpu.metrics.registry import (Counter, Gauge, Histogram,
+                                            Registry)
+from karpenter_tpu.utils.options import Options
+
+
+class TestBatcher:
+    def test_coalesces_within_window(self):
+        async def run():
+            calls = []
+
+            async def executor(items):
+                calls.append(list(items))
+                return [i * 2 for i in items]
+
+            b = Batcher(executor, BatcherOptions(idle_timeout=0.02,
+                                                 max_timeout=0.2))
+            results = await asyncio.gather(*[b.submit(i) for i in range(20)])
+            assert results == [i * 2 for i in range(20)]
+            assert len(calls) == 1  # one wire call for 20 submits
+            assert b.stats["largest_batch"] == 20
+        asyncio.run(run())
+
+    def test_max_items_fires_immediately(self):
+        async def run():
+            calls = []
+
+            async def executor(items):
+                calls.append(list(items))
+                return items
+
+            b = Batcher(executor, BatcherOptions(idle_timeout=10.0,
+                                                 max_timeout=30.0, max_items=5))
+            await asyncio.gather(*[b.submit(i) for i in range(5)])
+            assert len(calls) == 1  # fired on max_items, not on timeout
+        asyncio.run(run())
+
+    def test_hasher_separates_buckets(self):
+        async def run():
+            calls = []
+
+            async def executor(items):
+                calls.append(list(items))
+                return items
+
+            b = Batcher(executor, BatcherOptions(
+                idle_timeout=0.02, request_hasher=lambda i: i % 2))
+            await asyncio.gather(*[b.submit(i) for i in range(10)])
+            assert len(calls) == 2  # evens and odds batched separately
+        asyncio.run(run())
+
+    def test_batch_error_fans_out(self):
+        async def run():
+            async def executor(items):
+                raise RuntimeError("wire failure")
+
+            b = Batcher(executor, BatcherOptions(idle_timeout=0.01))
+            results = await asyncio.gather(*[b.submit(i) for i in range(3)],
+                                           return_exceptions=True)
+            assert all(isinstance(r, RuntimeError) for r in results)
+        asyncio.run(run())
+
+
+class TestMetrics:
+    def test_counter_gauge(self):
+        reg = Registry()
+        c = reg.counter("test_total", "help", ("label",))
+        c.inc(label="a")
+        c.inc(2, label="a")
+        c.inc(label="b")
+        assert c.value(label="a") == 3
+        g = reg.gauge("test_gauge", "help")
+        g.set(42)
+        text = reg.expose()
+        assert 'test_total{label="a"} 3' in text
+        assert "test_gauge 42" in text
+        assert "# TYPE test_total counter" in text
+
+    def test_histogram(self):
+        reg = Registry()
+        h = reg.histogram("lat", "help", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 0.05):
+            h.observe(v)
+        text = reg.expose()
+        assert 'lat_bucket{le="0.01"} 1' in text
+        assert 'lat_bucket{le="0.1"} 3' in text
+        assert 'lat_bucket{le="+Inf"} 4' in text
+        assert h.percentile(0.5) == 0.1
+
+    def test_solve_metrics_populated_by_sim(self):
+        from karpenter_tpu.metrics import REGISTRY, SOLVE_DURATION
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.sim import make_sim
+        sim = make_sim()
+        for i in range(10):
+            sim.store.add_pod(Pod(name=f"m-{i}",
+                                  requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        sim.engine.run_for(20)
+        text = REGISTRY.expose()
+        assert "karpenter_tpu_nodeclaims_created_total" in text
+        assert "karpenter_tpu_solver_solve_duration_seconds_count" in text
+
+
+class TestOptions:
+    def test_defaults(self):
+        o = Options.parse([], env={})
+        assert o.vm_memory_overhead_percent == 0.075
+        assert o.solver_backend == "device"
+        assert o.gate("SpotToSpotConsolidation")
+
+    def test_flag_overrides_env(self):
+        o = Options.parse(["--cluster-name", "flagged"],
+                          env={"CLUSTER_NAME": "from-env"})
+        assert o.cluster_name == "flagged"
+
+    def test_env_overrides_default(self):
+        o = Options.parse([], env={"SOLVER_BACKEND": "host",
+                                   "BATCH_IDLE_SECONDS": "2.5",
+                                   "ISOLATED": "true"})
+        assert o.solver_backend == "host"
+        assert o.batch_idle_seconds == 2.5
+        assert o.isolated is True
+
+    def test_feature_gates(self):
+        o = Options.parse(["--feature-gates", "NodeOverlay=true,NodeRepair=false"],
+                          env={})
+        assert o.gate("NodeOverlay")
+        assert not o.gate("NodeRepair")
+
+
+class TestRuntime:
+    def test_async_runtime_drives_controllers(self):
+        from karpenter_tpu.controllers.runtime import Runtime
+
+        class Ticker:
+            name = "ticker"
+
+            def __init__(self):
+                self.count = 0
+
+            def reconcile(self, now):
+                self.count += 1
+                return 0.01
+
+        async def run():
+            t = Ticker()
+            rt = Runtime().add(t)
+            task = asyncio.create_task(rt.start())
+            await asyncio.sleep(0.2)
+            rt.stop()
+            await task
+            assert t.count >= 5
+        asyncio.run(run())
+
+    def test_metrics_endpoint(self):
+        from karpenter_tpu.controllers.runtime import Runtime
+
+        async def run():
+            rt = Runtime(metrics_port=19877)
+            task = asyncio.create_task(rt.start())
+            await asyncio.sleep(0.1)
+            reader, writer = await asyncio.open_connection("127.0.0.1", 19877)
+            writer.write(b"GET /metrics HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            data = await reader.read(200)
+            assert b"200 OK" in data
+            writer.close()
+            rt.stop()
+            await task
+        asyncio.run(run())
+
+
+class TestOperator:
+    def test_build_operator_runs_end_to_end(self):
+        """The real entrypoint wiring provisions pods on wall clock."""
+        from karpenter_tpu.main import build_operator
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.utils.options import Options
+        from karpenter_tpu.cloud.fake import FakeCloud, FakeCloudConfig
+        from karpenter_tpu.catalog import small_catalog
+
+        cloud = FakeCloud(small_catalog(),
+                          config=FakeCloudConfig(node_ready_delay=0.05,
+                                                 register_delay=0.02))
+        opts = Options.parse([], env={})
+        opts.metrics_port = 0
+        opts.solver_backend = "host"
+        runtime, store, _ = build_operator(opts, cloud=cloud)
+        for i in range(20):
+            store.add_pod(Pod(name=f"rt-{i}",
+                              requests=Resources.parse({"cpu": "500m",
+                                                        "memory": "1Gi"})))
+
+        async def run():
+            task = asyncio.create_task(runtime.start())
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if all(p.node_name for p in store.pods.values()):
+                    break
+            runtime.stop()
+            await task
+        asyncio.run(run())
+        assert all(p.node_name for p in store.pods.values())
+        assert store.nodeclaims
