@@ -50,6 +50,12 @@ class CatalogProvider:
             self._epoch += 1
         return cached
 
+    def bump_epoch(self) -> None:
+        """Force downstream re-resolution (e.g. discovered-capacity writes
+        mutate raw InstanceType objects in place)."""
+        self._epoch += 1
+        self._resolved_cache.flush()
+
     def refresh(self) -> None:
         """Forced refresh (the polling controller calls this; reference
         pkg/controllers/providers/instancetype/controller.go:43)."""

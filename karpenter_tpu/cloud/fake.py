@@ -73,6 +73,10 @@ class FakeCloud:
         self.api_calls: Dict[str, int] = {"create_fleet": 0, "terminate": 0,
                                           "describe": 0}
         self.interruptions: List[dict] = []  # queued interruption events
+        self.expired_reservations: set = set()
+        self.unhealthy: set = set()  # instance ids with a dead kubelet
+        from .image import default_images
+        self.images = default_images(self.clock.now())
 
     # --- capacity pool control (tests / chaos) ---
     def set_capacity(self, instance_type: str, zone: str, capacity_type: str,
@@ -111,12 +115,16 @@ class FakeCloud:
             if not self._take_capacity(key):
                 exhausted.append(key)
                 continue
+            if ov.reservation_id and ov.reservation_id in self.expired_reservations:
+                exhausted.append(key)
+                continue
             inst = Instance(
                 id=f"i-{next(_ids):08d}", instance_type=ov.instance_type,
                 zone=ov.zone, capacity_type=ov.capacity_type,
                 image_id=req.image_id, state="pending",
                 launch_time=self.clock.now(), tags=dict(req.tags),
-                price=ov.price, nodeclaim=req.nodeclaim_name)
+                price=ov.price, nodeclaim=req.nodeclaim_name,
+                reservation_id=ov.reservation_id)
             self.instances[inst.id] = inst
             return inst
         return InsufficientCapacityError(exhausted or
@@ -135,6 +143,10 @@ class FakeCloud:
     def describe_types(self) -> List[InstanceType]:
         """DescribeInstanceTypes analog — the catalog provider's backend."""
         return list(self.types.values())
+
+    def describe_images(self):
+        """DescribeImages analog — the image provider's backend."""
+        return list(self.images)
 
     def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]:
         self.api_calls["describe"] += 1
@@ -161,6 +173,9 @@ class FakeCloud:
             inst = self.instances.get(iid)
             if inst is None or inst.state == "terminated":
                 continue
+            if iid in self.unhealthy:
+                node.ready = False
+                continue
             if not node.ready and now - inst.launch_time >= self.config.node_ready_delay:
                 node.ready = True
                 for fn in self.on_node_ready:
@@ -175,6 +190,13 @@ class FakeCloud:
             labels=labels, capacity=Resources(it.capacity),
             allocatable=it.allocatable(), ready=False,
             created_at=self.clock.now())
+
+    def expire_reservation(self, reservation_id: str) -> None:
+        self.expired_reservations.add(reservation_id)
+
+    def make_unhealthy(self, instance_id: str) -> None:
+        """Chaos: the instance's kubelet stops reporting Ready."""
+        self.unhealthy.add(instance_id)
 
     # --- chaos (kwok StartKillNodeThread analog) ---
     def kill_instance(self, instance_id: str, reason: str = "chaos") -> None:

@@ -1,0 +1,216 @@
+"""Image families + bootstrap generation — the amifamily subsystem analog.
+
+Reference: pkg/providers/amifamily/ — an `AMIFamily` strategy interface
+with per-OS implementations (AL2, AL2023, Bottlerocket, Windows, Custom;
+resolver.go:88-110), image resolution from aliases (`al2023@latest` → SSM
+parameter), explicit IDs, or tag selectors (ami.go:86-166), newest-first
+sort, arch-based mapping to instance types, and bootstrap userdata
+generators (eksbootstrap.sh args, nodeadm YAML, Bottlerocket TOML, MIME
+multipart merge — pkg/providers/amifamily/bootstrap/).
+
+Ours: an `ImageFamily` strategy registry with three stock families
+(standard = cloud-init shell, declarative = YAML node config, minimal =
+TOML settings — the same three bootstrap *shapes* the reference ships),
+alias/selector resolution against the cloud's image catalog, and MIME
+merge of user-supplied userdata.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Sequence
+
+from ..models import labels as L
+from ..models.nodepool import NodeClassSpec
+from ..models.pod import Taint
+from ..models.resources import Resources
+
+
+@dataclass
+class Image:
+    id: str
+    name: str
+    family: str            # standard | declarative | minimal
+    arch: str              # amd64 | arm64
+    created_at: float
+    deprecated: bool = False
+    tags: Dict[str, str] = field(default_factory=dict)
+
+    def requirements_arch(self) -> str:
+        return self.arch
+
+
+@dataclass
+class BootstrapConfig:
+    cluster_name: str
+    cluster_endpoint: str
+    labels: Dict[str, str]
+    taints: List[Taint]
+    kubelet_max_pods: Optional[int]
+    kube_reserved: Dict[str, str]
+    custom_user_data: str = ""
+
+
+class ImageFamily(Protocol):
+    name: str
+
+    def user_data(self, cfg: BootstrapConfig) -> str: ...
+
+
+class StandardFamily:
+    """Shell bootstrap (the eksbootstrap.sh-args shape)."""
+
+    name = "standard"
+
+    def user_data(self, cfg: BootstrapConfig) -> str:
+        taints = ",".join(f"{t.key}={t.value}:{t.effect}" for t in cfg.taints)
+        labels = ",".join(f"{k}={v}" for k, v in sorted(cfg.labels.items()))
+        lines = [
+            "#!/bin/bash -xe",
+            f"/etc/node/bootstrap.sh --cluster '{cfg.cluster_name}' \\",
+            f"  --endpoint '{cfg.cluster_endpoint}' \\",
+            f"  --node-labels '{labels}' \\",
+            f"  --register-taints '{taints}'",
+        ]
+        if cfg.kubelet_max_pods is not None:
+            lines.append(f"  --max-pods {cfg.kubelet_max_pods}")
+        if cfg.custom_user_data:
+            return merge_mime([cfg.custom_user_data, "\n".join(lines)])
+        return "\n".join(lines)
+
+
+class DeclarativeFamily:
+    """YAML node-config bootstrap (the AL2023 nodeadm shape)."""
+
+    name = "declarative"
+
+    def user_data(self, cfg: BootstrapConfig) -> str:
+        out = [
+            "apiVersion: node.karpenter.tpu/v1",
+            "kind: NodeConfig",
+            "spec:",
+            "  cluster:",
+            f"    name: {cfg.cluster_name}",
+            f"    endpoint: {cfg.cluster_endpoint}",
+            "  kubelet:",
+        ]
+        if cfg.kubelet_max_pods is not None:
+            out.append(f"    maxPods: {cfg.kubelet_max_pods}")
+        if cfg.labels:
+            out.append("    nodeLabels:")
+            for k, v in sorted(cfg.labels.items()):
+                out.append(f"      {k}: '{v}'")
+        if cfg.taints:
+            out.append("    registerWithTaints:")
+            for t in cfg.taints:
+                out.append(f"      - key: {t.key}")
+                out.append(f"        value: '{t.value}'")
+                out.append(f"        effect: {t.effect}")
+        body = "\n".join(out)
+        if cfg.custom_user_data:
+            return merge_mime([cfg.custom_user_data, body])
+        return body
+
+
+class MinimalFamily:
+    """TOML settings bootstrap (the Bottlerocket shape — no shell at all)."""
+
+    name = "minimal"
+
+    def user_data(self, cfg: BootstrapConfig) -> str:
+        out = [
+            "[settings.kubernetes]",
+            f'cluster-name = "{cfg.cluster_name}"',
+            f'api-server = "{cfg.cluster_endpoint}"',
+        ]
+        if cfg.kubelet_max_pods is not None:
+            out.append(f"max-pods = {cfg.kubelet_max_pods}")
+        if cfg.labels:
+            out.append("[settings.kubernetes.node-labels]")
+            for k, v in sorted(cfg.labels.items()):
+                out.append(f'"{k}" = "{v}"')
+        if cfg.taints:
+            out.append("[settings.kubernetes.node-taints]")
+            for t in cfg.taints:
+                out.append(f'"{t.key}" = "{t.value}:{t.effect}"')
+        # minimal family ignores custom shell userdata (like Bottlerocket)
+        return "\n".join(out)
+
+
+FAMILIES: Dict[str, ImageFamily] = {
+    f.name: f for f in (StandardFamily(), DeclarativeFamily(), MinimalFamily())
+}
+
+
+def merge_mime(parts: Sequence[str]) -> str:
+    """MIME multipart merge of userdata documents (reference
+    bootstrap/mime/mime.go)."""
+    boundary = "//KARPENTER-TPU-BOUNDARY"
+    out = [f'Content-Type: multipart/mixed; boundary="{boundary[2:]}"',
+           "MIME-Version: 1.0", ""]
+    for p in parts:
+        ctype = "text/x-shellscript" if p.startswith("#!") else "text/plain"
+        out += [boundary, f'Content-Type: {ctype}; charset="us-ascii"', "", p, ""]
+    out.append(boundary + "--")
+    return "\n".join(out)
+
+
+class ImageProvider:
+    """Image discovery: alias ('standard@latest', 'standard@v1.2'),
+    explicit ids, or tag selectors; newest-first (reference ami.go:70,
+    types.go:48)."""
+
+    def __init__(self, images: Sequence[Image]):
+        self._images = list(images)
+
+    def resolve(self, nc: NodeClassSpec) -> List[Image]:
+        sel = nc.image_selector
+        live = [i for i in self._images if not i.deprecated]
+        if "alias" in sel:
+            fam, _, version = sel["alias"].partition("@")
+            pool = [i for i in live if i.family == fam]
+            if version and version != "latest":
+                pool = [i for i in pool if i.name.endswith(version)]
+            else:
+                pool = sorted(pool, key=lambda i: -i.created_at)
+                # latest per arch
+                seen, out = set(), []
+                for i in pool:
+                    if i.arch not in seen:
+                        seen.add(i.arch)
+                        out.append(i)
+                return out
+            return sorted(pool, key=lambda i: -i.created_at)
+        if "ids" in sel:
+            ids = set(sel["ids"].split(","))
+            return [i for i in self._images if i.id in ids]  # ids may pin deprecated
+        if sel:  # tag selectors
+            out = [i for i in live
+                   if all(i.tags.get(k) == v for k, v in sel.items())]
+            return sorted(out, key=lambda i: -i.created_at)
+        # default: latest of the nodeclass's family
+        return self.resolve(NodeClassSpec(
+            name=nc.name, image_selector={"alias": f"{nc.image_family}@latest"}))
+
+    def for_arch(self, images: List[Image], arch: str) -> Optional[Image]:
+        for i in images:
+            if i.arch == arch:
+                return i
+        return None
+
+
+def default_images(clock_now: float = 0.0) -> List[Image]:
+    """The fake cloud's image catalog."""
+    out = []
+    for fam in ("standard", "declarative", "minimal"):
+        for arch in ("amd64", "arm64"):
+            for ver, age in (("v1.30.1", 3000.0), ("v1.31.0", 2000.0),
+                             ("v1.32.0", 1000.0)):
+                short = hashlib.sha256(f"{fam}{arch}{ver}".encode()).hexdigest()[:8]
+                out.append(Image(
+                    id=f"img-{short}", name=f"{fam}-{arch}-{ver}",
+                    family=fam, arch=arch,
+                    created_at=clock_now - age,
+                    tags={"family": fam, "arch": arch, "version": ver}))
+    return out

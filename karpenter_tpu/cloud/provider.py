@@ -28,6 +28,7 @@ class LaunchRequest:
     nodeclaim_name: str
     overrides: List[LaunchOverride]
     image_id: str = "img-default"
+    user_data: str = ""
     tags: Dict[str, str] = field(default_factory=dict)
 
 
@@ -43,6 +44,7 @@ class Instance:
     tags: Dict[str, str] = field(default_factory=dict)
     price: float = 0.0
     nodeclaim: str = ""
+    reservation_id: Optional[str] = None
 
     @property
     def provider_id(self) -> str:

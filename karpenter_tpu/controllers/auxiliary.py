@@ -1,0 +1,143 @@
+"""Auxiliary controllers: tagging, discovered capacity, polling refreshes,
+capacity-reservation expiration.
+
+Reference parity:
+ - tagging: pkg/controllers/nodeclaim/tagging/controller.go:48-131 — tags
+   instances with Name + nodeclaim after registration.
+ - discovered capacity: pkg/controllers/providers/instancetype/capacity/
+   controller.go:70 — corrects the catalog's memory capacity for a type
+   from real registered nodes (VM overhead estimates are conservative;
+   live nodes tell the truth). 60-day cache TTL.
+ - polling refresh: pkg/controllers/providers/{pricing,instancetype}/ —
+   12h pricing refresh, 5m catalog refresh.
+ - reservation expiration: pkg/controllers/capacityreservation/
+   {capacitytype,expiration}/ — demote reserved claims to on-demand when
+   their reservation expires.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..catalog.provider import CatalogProvider
+from ..models import labels as L
+from ..models.nodeclaim import Phase
+from ..models.resources import MEMORY
+from ..state.store import Store
+from ..utils.cache import DISCOVERED_CAPACITY_TTL, TTLCache
+from ..utils.clock import Clock
+
+RESERVATION_ANNOTATION = "karpenter.tpu/reservation-id"
+
+
+@dataclass
+class TaggingController:
+    store: Store
+    cloud: object
+    name: str = "nodeclaim.tagging"
+    requeue: float = 5.0
+    _tagged: set = field(default_factory=set)
+
+    def reconcile(self, now: float) -> float:
+        for claim in self.store.nodeclaims.values():
+            if claim.phase not in (Phase.REGISTERED, Phase.INITIALIZED):
+                continue
+            if claim.name in self._tagged or not claim.provider_id:
+                continue
+            iid = claim.provider_id.rsplit("/", 1)[-1]
+            inst = getattr(self.cloud, "instances", {}).get(iid)
+            if inst is None:
+                continue
+            inst.tags["Name"] = claim.node_name or claim.name
+            inst.tags["karpenter.tpu/nodeclaim"] = claim.name
+            self._tagged.add(claim.name)
+        return self.requeue
+
+
+@dataclass
+class DiscoveredCapacityController:
+    """Learn true allocatable memory per instance type from live nodes and
+    feed it back into the catalog (overrides the 7.5% VM-overhead guess)."""
+
+    store: Store
+    catalog: CatalogProvider
+    name: str = "instancetype.capacity"
+    requeue: float = 60.0
+    _cache: Optional[TTLCache] = None
+    stats: Dict[str, int] = field(default_factory=lambda: {"discovered": 0})
+
+    def reconcile(self, now: float) -> float:
+        if self._cache is None:
+            self._cache = TTLCache(DISCOVERED_CAPACITY_TTL, self.catalog.clock)
+        changed = False
+        for node in self.store.nodes.values():
+            t = node.labels.get(L.INSTANCE_TYPE)
+            if not t or not node.ready:
+                continue
+            mem = node.capacity.get(MEMORY)
+            if mem <= 0:
+                continue
+            known = self._cache.get(t)
+            if known is None or abs(known - mem) > 1:
+                self._cache.set(t, mem)
+                changed = True
+                self.stats["discovered"] += 1
+        if changed:
+            self.apply()
+        return self.requeue
+
+    def apply(self) -> None:
+        for it in self.catalog.raw_types():
+            mem = self._cache.get(it.name) if self._cache else None
+            if mem is not None and abs(it.capacity.get(MEMORY) - mem) > 1:
+                it.capacity[MEMORY] = mem
+        self.catalog.bump_epoch()
+
+
+@dataclass
+class CatalogRefreshController:
+    """5m instance-type/offering refresh + 12h pricing refresh (staleness
+    SLOs from pkg/cache/cache.go)."""
+
+    catalog: CatalogProvider
+    name: str = "providers.refresh"
+    requeue: float = 300.0
+    pricing_interval: float = 12 * 3600
+    _last_pricing: float = 0.0
+
+    def reconcile(self, now: float) -> float:
+        self.catalog.refresh()
+        if now - self._last_pricing >= self.pricing_interval:
+            self.catalog.pricing.hydrate(self.catalog.raw_types())
+            self._last_pricing = now
+        return self.requeue
+
+
+@dataclass
+class ReservationExpirationController:
+    """Reserved claims whose capacity reservation expired are demoted to
+    on-demand (billing falls back to OD when the reservation lapses)."""
+
+    store: Store
+    cloud: object
+    name: str = "capacityreservation.expiration"
+    requeue: float = 60.0
+    stats: Dict[str, int] = field(default_factory=lambda: {"demoted": 0})
+
+    def reconcile(self, now: float) -> float:
+        expired = getattr(self.cloud, "expired_reservations", set())
+        if not expired:
+            return self.requeue
+        for claim in self.store.nodeclaims.values():
+            rid = claim.annotations.get(RESERVATION_ANNOTATION)
+            if rid and rid in expired and claim.capacity_type == L.CAPACITY_RESERVED:
+                claim.capacity_type = L.CAPACITY_ON_DEMAND
+                claim.labels[L.CAPACITY_TYPE] = L.CAPACITY_ON_DEMAND
+                node = self.store.node_for_nodeclaim(claim)
+                if node is not None:
+                    node.labels[L.CAPACITY_TYPE] = L.CAPACITY_ON_DEMAND
+                self.stats["demoted"] += 1
+                self.store.record_event("nodeclaim", claim.name,
+                                        "ReservationExpired", rid)
+        return self.requeue

@@ -157,10 +157,21 @@ class DisruptionController:
 
     # --- drift ---
     def _is_drifted(self, v: NodeView, node_class) -> bool:
+        """Drift reasons (reference drift.go:35-76): static nodeclass-hash
+        mismatch; node image no longer in the resolved image set; node zone
+        no longer in the resolved zones."""
         if node_class is None:
             return False
         stamped = v.claim.annotations.get("karpenter.tpu/nodeclass-hash")
-        return stamped is not None and stamped != node_class.hash()
+        if stamped is not None and stamped != node_class.hash():
+            return True
+        if (node_class.resolved_images and v.claim.image_id
+                and v.claim.image_id not in node_class.resolved_images):
+            return True
+        if (node_class.resolved_zones and v.claim.zone
+                and v.claim.zone not in node_class.resolved_zones):
+            return True
+        return False
 
     # --- consolidation simulations ---
     def _simulate_removal(self, pool: NodePool, victims: List[NodeView],

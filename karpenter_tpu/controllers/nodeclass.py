@@ -1,0 +1,42 @@
+"""NodeClass status controller.
+
+Reference: pkg/controllers/nodeclass/controller.go:64-166 — a status
+reconciler chain resolving images → zones → readiness, with a dry-run
+launch-authorization validation; the resolved sets feed both the launch
+path and drift detection (a node whose image left the resolved set is
+drifted — pkg/cloudprovider/drift.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..cloud.image import ImageProvider
+from ..state.store import Store
+
+
+@dataclass
+class NodeClassController:
+    store: Store
+    cloud: object
+    images: ImageProvider
+    name: str = "nodeclass"
+    requeue: float = 30.0
+    stats: Dict[str, int] = field(default_factory=lambda: {"reconciles": 0})
+
+    def reconcile(self, now: float) -> float:
+        zones = sorted({o.zone for t in self.cloud.describe_types()
+                        for o in t.offerings})
+        for nc in self.store.nodeclasses.values():
+            self.stats["reconciles"] += 1
+            resolved_imgs = self.images.resolve(nc)
+            nc.resolved_images = [i.id for i in resolved_imgs]
+            nc.resolved_zones = [z for z in zones
+                                 if not nc.zones or z in nc.zones]
+            ready = bool(nc.resolved_images) and bool(nc.resolved_zones)
+            if ready != nc.ready:
+                self.store.record_event("nodeclass", nc.name,
+                                        "Ready" if ready else "NotReady")
+            nc.ready = ready
+        return self.requeue

@@ -175,11 +175,18 @@ class Provisioner:
             claim.instance_type = launch.instance_type
             self.store.add_nodeclaim(claim)
             claims.append((claim, launch))
+            # reservation ids ride along so reserved launches can be
+            # attributed and counted against the reservation
+            res_ids = {(t.name, o.zone, o.capacity_type): o.reservation_id
+                       for t in self.catalog.raw_types()
+                       for o in t.offerings if o.reservation_id}
             requests.append(LaunchRequest(
                 nodeclaim_name=claim.name,
-                overrides=[LaunchOverride(*o) for o in launch.overrides],
+                overrides=[LaunchOverride(*o, reservation_id=res_ids.get(o[:3]))
+                           for o in launch.overrides],
                 image_id=(node_class.resolved_images[0]
                           if node_class.resolved_images else "img-default"),
+                user_data=self._user_data(pool, node_class, launch),
                 tags={**node_class.tags, "karpenter.tpu/nodepool": pool.name}))
         results = self.cloud.create_fleet(requests)
 
@@ -203,6 +210,13 @@ class Provisioner:
                 claim.labels[L.ZONE] = res.zone
                 claim.labels[L.CAPACITY_TYPE] = res.capacity_type
                 claim.labels[L.INSTANCE_TYPE] = res.instance_type
+                if res.reservation_id:
+                    claim.annotations["karpenter.tpu/reservation-id"] = res.reservation_id
+                    cap = next((o.reservation_capacity for t in self.catalog.raw_types()
+                                if t.name == res.instance_type
+                                for o in t.offerings
+                                if o.reservation_id == res.reservation_id), 0)
+                    self.catalog.mark_reservation_launched(res.reservation_id, cap)
                 for k in launch.pod_keys:
                     pod = self.store.pods.get(k)
                     if pod is not None:
@@ -228,6 +242,20 @@ class Provisioner:
             for (t, z, c) in err.offerings:
                 ICE_ERRORS.inc(capacity_type=c)
                 self.catalog.unavailable.mark_unavailable(t, z, c, reason="ICE")
+
+    def _user_data(self, pool: NodePool, node_class: NodeClassSpec,
+                   launch: NodeLaunch) -> str:
+        from ..cloud.image import FAMILIES, BootstrapConfig
+        fam = FAMILIES.get(node_class.image_family)
+        if fam is None:
+            return node_class.user_data  # custom family: verbatim userdata
+        return fam.user_data(BootstrapConfig(
+            cluster_name="karpenter-tpu",
+            cluster_endpoint="https://cluster.internal",
+            labels=launch.labels, taints=pool.taints,
+            kubelet_max_pods=node_class.kubelet_max_pods,
+            kube_reserved=node_class.kubelet_kube_reserved,
+            custom_user_data=node_class.user_data))
 
     def _nominate(self, pod: Pod, claim: NodeClaim) -> None:
         pod.annotations[NOMINATED] = claim.name

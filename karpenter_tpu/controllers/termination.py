@@ -26,6 +26,7 @@ DEFAULT_GRACE = 30.0
 class TerminationController:
     store: Store
     cloud: object
+    catalog: object = None  # optional: reservation bookkeeping
     name: str = "termination"
     requeue: float = 0.5
     drain_grace: float = DEFAULT_GRACE
@@ -78,6 +79,9 @@ class TerminationController:
         if claim.provider_id:
             iid = claim.provider_id.rsplit("/", 1)[-1]
             self.cloud.terminate([iid])
+        rid = claim.annotations.get("karpenter.tpu/reservation-id")
+        if rid and self.catalog is not None:
+            self.catalog.mark_reservation_terminated(rid, 0)
         claim.phase = Phase.TERMINATED
         self._drain_started.pop(claim.name, None)
         self.store.delete_nodeclaim(claim.name)

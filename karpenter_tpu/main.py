@@ -50,16 +50,31 @@ def build_operator(options: Optional[Options] = None,
                               batch_idle=opts.batch_idle_seconds)
     lifecycle = LifecycleController(store=store, cloud=cloud)
     binding = BindingController(store=store)
-    termination = TerminationController(store=store, cloud=cloud)
+    termination = TerminationController(store=store, cloud=cloud,
+                                        catalog=catalog)
     disruption = DisruptionController(store=store, solver=solver,
                                       catalog=catalog,
                                       provisioner=provisioner,
                                       termination=termination)
     gc = GarbageCollectionController(store=store, cloud=cloud)
     metrics_c = CloudProviderMetricsController(catalog=catalog)
-
+    from .cloud.image import ImageProvider
+    from .controllers.auxiliary import (CatalogRefreshController,
+                                        DiscoveredCapacityController,
+                                        ReservationExpirationController,
+                                        TaggingController)
+    from .controllers.nodeclass import NodeClassController
+    from .controllers.repair import NodeRepairController
+    nodeclass_c = NodeClassController(store=store, cloud=cloud,
+                                      images=ImageProvider(cloud.describe_images()))
+    repair = NodeRepairController(store=store, termination=termination,
+                                  enabled=opts.gate("NodeRepair"))
     controllers: List[object] = [provisioner, lifecycle, binding, termination,
-                                 disruption, gc, metrics_c]
+                                 disruption, gc, metrics_c, nodeclass_c,
+                                 repair, TaggingController(store=store, cloud=cloud),
+                                 DiscoveredCapacityController(store=store, catalog=catalog),
+                                 CatalogRefreshController(catalog=catalog),
+                                 ReservationExpirationController(store=store, cloud=cloud)]
     if opts.interruption_queue:
         controllers.append(InterruptionController(
             store=store, cloud=cloud, catalog=catalog,
@@ -79,6 +94,7 @@ def build_operator(options: Optional[Options] = None,
 
     store.add_nodeclass(NodeClassSpec(name="default"))
     store.add_nodepool(NodePool(name="default"))
+    nodeclass_c.reconcile(clock.now())  # sync hydrate before start
     return runtime, store, cloud
 
 

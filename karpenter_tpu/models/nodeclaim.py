@@ -99,6 +99,7 @@ class Node:
     capacity: Resources = field(default_factory=Resources)
     allocatable: Resources = field(default_factory=Resources)
     ready: bool = False
+    conditions: Dict[str, bool] = field(default_factory=dict)
     nodeclaim: Optional[str] = None
     created_at: float = 0.0
     deletion_timestamp: Optional[float] = None

@@ -59,7 +59,8 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                               catalog=catalog)
     lifecycle = LifecycleController(store=store, cloud=cloud)
     binding = BindingController(store=store)
-    termination = TerminationController(store=store, cloud=cloud)
+    termination = TerminationController(store=store, cloud=cloud,
+                                        catalog=catalog)
     disruption = DisruptionController(store=store, solver=solver,
                                       catalog=catalog, provisioner=provisioner,
                                       termination=termination)
@@ -67,11 +68,26 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                                           catalog=catalog,
                                           termination=termination)
     gc = GarbageCollectionController(store=store, cloud=cloud)
+    from .cloud.image import ImageProvider
+    from .controllers.auxiliary import (CatalogRefreshController,
+                                        DiscoveredCapacityController,
+                                        ReservationExpirationController,
+                                        TaggingController)
     from .controllers.metrics_controller import CloudProviderMetricsController
+    from .controllers.nodeclass import NodeClassController
+    from .controllers.repair import NodeRepairController
     metrics_c = CloudProviderMetricsController(catalog=catalog)
-    engine = Engine(clock=clock).add(provisioner, lifecycle, binding,
-                                     termination, disruption, interruption,
-                                     gc, metrics_c)
+    nodeclass_c = NodeClassController(store=store, cloud=cloud,
+                                      images=ImageProvider(cloud.describe_images()))
+    repair = NodeRepairController(store=store, termination=termination)
+    tagging = TaggingController(store=store, cloud=cloud)
+    discovered = DiscoveredCapacityController(store=store, catalog=catalog)
+    refresh = CatalogRefreshController(catalog=catalog)
+    res_exp = ReservationExpirationController(store=store, cloud=cloud)
+    engine = Engine(clock=clock).add(nodeclass_c, provisioner, lifecycle,
+                                     binding, termination, disruption,
+                                     interruption, gc, metrics_c, repair,
+                                     tagging, discovered, refresh, res_exp)
 
     # cloud → store node materialization (kubelet joining the cluster)
     cloud.on_node_created.append(store.add_node)
@@ -88,6 +104,7 @@ def make_sim(types: Optional[List[InstanceType]] = None,
 
     store.add_nodeclass(NodeClassSpec(name="default"))
     store.add_nodepool(nodepool or NodePool(name="default"))
+    nodeclass_c.reconcile(clock.now())  # sync hydrate (operator.go:151 analog)
     return SimEnvironment(clock=clock, store=store, cloud=cloud,
                           catalog=catalog, solver=solver, engine=engine,
                           provisioner=provisioner, lifecycle=lifecycle,

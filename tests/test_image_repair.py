@@ -1,0 +1,175 @@
+"""Image families, bootstrap, nodeclass status, repair, reservations,
+tagging, discovered capacity."""
+
+import pytest
+
+from karpenter_tpu.cloud.image import (FAMILIES, BootstrapConfig, Image,
+                                       ImageProvider, default_images,
+                                       merge_mime)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodeClassSpec, NodePool
+from karpenter_tpu.models.pod import Pod, Taint
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def add_pods(sim, n, cpu="500m", mem="1Gi", prefix="p", **kw):
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def settle(sim, timeout=120):
+    ok = sim.engine.run_until(
+        lambda: all(p.node_name for p in sim.store.pods.values()), timeout=timeout)
+    assert ok
+
+
+class TestBootstrap:
+    def setup_method(self):
+        self.cfg = BootstrapConfig(
+            cluster_name="c1", cluster_endpoint="https://ep",
+            labels={"a": "1"}, taints=[Taint(key="t", value="v", effect="NoSchedule")],
+            kubelet_max_pods=58, kube_reserved={})
+
+    def test_standard_family_shell(self):
+        ud = FAMILIES["standard"].user_data(self.cfg)
+        assert ud.startswith("#!/bin/bash")
+        assert "--cluster 'c1'" in ud and "t=v:NoSchedule" in ud
+
+    def test_declarative_family_yaml(self):
+        ud = FAMILIES["declarative"].user_data(self.cfg)
+        assert "kind: NodeConfig" in ud and "maxPods: 58" in ud
+        assert "registerWithTaints:" in ud
+
+    def test_minimal_family_toml(self):
+        ud = FAMILIES["minimal"].user_data(self.cfg)
+        assert "[settings.kubernetes]" in ud
+        assert '"t" = "v:NoSchedule"' in ud
+        # minimal ignores custom shell userdata
+        self.cfg.custom_user_data = "#!/bin/sh\necho x"
+        assert "echo x" not in FAMILIES["minimal"].user_data(self.cfg)
+
+    def test_mime_merge(self):
+        self.cfg.custom_user_data = "#!/bin/sh\necho custom-first"
+        ud = FAMILIES["standard"].user_data(self.cfg)
+        assert "multipart/mixed" in ud
+        assert ud.index("custom-first") < ud.index("--cluster")  # custom runs first
+
+
+class TestImageProvider:
+    def setup_method(self):
+        self.prov = ImageProvider(default_images(10000.0))
+
+    def test_alias_latest_per_arch(self):
+        imgs = self.prov.resolve(NodeClassSpec(image_selector={"alias": "standard@latest"}))
+        assert len(imgs) == 2  # one per arch
+        assert {i.arch for i in imgs} == {"amd64", "arm64"}
+        assert all(i.name.endswith("v1.32.0") for i in imgs)
+
+    def test_alias_pinned_version(self):
+        imgs = self.prov.resolve(NodeClassSpec(image_selector={"alias": "standard@v1.31.0"}))
+        assert imgs and all(i.name.endswith("v1.31.0") for i in imgs)
+
+    def test_tag_selector(self):
+        imgs = self.prov.resolve(NodeClassSpec(
+            image_selector={"family": "minimal", "version": "v1.30.1"}))
+        assert imgs and all(i.family == "minimal" for i in imgs)
+
+    def test_default_family(self):
+        imgs = self.prov.resolve(NodeClassSpec(image_family="declarative"))
+        assert imgs and all(i.family == "declarative" for i in imgs)
+
+
+class TestNodeClassStatus:
+    def test_resolution_and_launch_uses_resolved_image(self):
+        sim = make_sim()
+        nc = sim.store.nodeclasses["default"]
+        assert nc.resolved_images and nc.resolved_zones
+        add_pods(sim, 5)
+        settle(sim)
+        for c in sim.store.nodeclaims.values():
+            assert c.image_id in nc.resolved_images
+
+    def test_image_rotation_drifts_nodes(self):
+        sim = make_sim()
+        add_pods(sim, 5)
+        settle(sim)
+        old_claims = set(sim.store.nodeclaims)
+        # pin the nodeclass to an older image -> all nodes drift
+        sim.store.nodeclasses["default"].image_selector = {"alias": "standard@v1.30.1"}
+        sim.engine.run_for(600, step=5)
+        assert sim.disruption.stats["drift"] >= 1
+        assert not (old_claims & set(sim.store.nodeclaims))
+
+
+class TestRepair:
+    def test_unhealthy_node_replaced_after_toleration(self):
+        sim = make_sim()
+        add_pods(sim, 4)
+        settle(sim)
+        victim_node = next(iter(sim.store.nodes.values()))
+        victim_claim = victim_node.nodeclaim
+        iid = victim_node.provider_id.rsplit("/", 1)[-1]
+        sim.cloud.make_unhealthy(iid)  # kubelet stops reporting
+        sim.engine.run_for(33 * 60, step=30)
+        assert victim_claim not in sim.store.nodeclaims
+        assert any(e[2] == "Unhealthy" for e in sim.store.events)
+        # pods rescheduled
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()), timeout=120)
+
+
+class TestReservations:
+    def test_reserved_launch_and_expiry_demotion(self):
+        from karpenter_tpu.catalog import generate_catalog
+        types = [t for t in generate_catalog()
+                 if any(o.capacity_type == "reserved" for o in t.offerings)]
+        assert types
+        sim = make_sim(types=types[:10])
+        t = sim.catalog.raw_types()[0]
+        res_off = next(o for o in t.offerings if o.capacity_type == "reserved")
+        # a pod pinned to reserved capacity on this type
+        add_pods(sim, 1, cpu="1", mem="1Gi", prefix="resv",
+                 node_selector={L.INSTANCE_TYPE: t.name,
+                                L.CAPACITY_TYPE: "reserved"})
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        assert claim.capacity_type == "reserved"
+        rid = claim.annotations.get("karpenter.tpu/reservation-id")
+        assert rid == res_off.reservation_id
+        # expire the reservation -> claim demoted to on-demand
+        sim.cloud.expire_reservation(rid)
+        sim.engine.run_for(120, step=5)
+        assert claim.capacity_type == "on-demand"
+        assert claim.labels[L.CAPACITY_TYPE] == "on-demand"
+
+
+class TestTaggingDiscovery:
+    def test_instances_tagged_after_registration(self):
+        sim = make_sim()
+        add_pods(sim, 3)
+        settle(sim)
+        sim.engine.run_for(10)  # let the tagging pass run post-registration
+        for c in sim.store.nodeclaims.values():
+            iid = c.provider_id.rsplit("/", 1)[-1]
+            inst = sim.cloud.instances[iid]
+            assert inst.tags.get("karpenter.tpu/nodeclaim") == c.name
+            assert inst.tags.get("Name")
+
+    def test_discovered_capacity_feeds_catalog(self):
+        sim = make_sim()
+        add_pods(sim, 3)
+        settle(sim)
+        node = next(iter(sim.store.nodes.values()))
+        t_name = node.labels[L.INSTANCE_TYPE]
+        from karpenter_tpu.models.resources import MEMORY
+        # kubelet reports truer (lower) memory than the 7.5% estimate
+        real = node.capacity[MEMORY] * 0.98
+        node.capacity[MEMORY] = real
+        sim.engine.run_for(120, step=10)
+        updated = next(t for t in sim.catalog.raw_types() if t.name == t_name)
+        assert abs(updated.capacity[MEMORY] - real) < 2
