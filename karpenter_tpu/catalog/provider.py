@@ -39,6 +39,8 @@ class CatalogProvider:
         self._epoch = 0  # bumps when the raw catalog changes
         self._reservation_remaining: dict = {}
         self._reservation_version = 0
+        self._overlays: list = []
+        self._overlay_version = 0
 
     # --- raw catalog (UpdateInstanceTypes analog, 5m TTL) ---
     def raw_types(self) -> List[InstanceType]:
@@ -49,6 +51,11 @@ class CatalogProvider:
             self.pricing.hydrate(cached)
             self._epoch += 1
         return cached
+
+    def set_overlays(self, overlays: list) -> None:
+        """NodeOverlay price/capacity overrides, applied at resolution."""
+        self._overlays = list(overlays)
+        self._overlay_version += 1
 
     def bump_epoch(self) -> None:
         """Force downstream re-resolution (e.g. discovered-capacity writes
@@ -71,6 +78,7 @@ class CatalogProvider:
         cached = self._resolved_cache.get(key)
         if cached is not None:
             return cached
+        from ..models.overlay import apply_overlays
         resolved = []
         for t in self.raw_types():
             offerings = self._inject_offerings(t, nc)
@@ -79,6 +87,9 @@ class CatalogProvider:
             resolved.append(InstanceType(
                 name=t.name, requirements=t.requirements, capacity=t.capacity,
                 overhead=t.overhead, offerings=offerings))
+        # overlays apply LAST so price adjustments act on the live injected
+        # prices, not the raw catalog's
+        resolved = apply_overlays(resolved, self._overlays)
         self._resolved_cache.set(key, resolved)
         return resolved
 
@@ -87,7 +98,7 @@ class CatalogProvider:
         ICE marks, price updates, reservation bookkeeping. (The review found
         the original (hash, seqnum) key served stale prices/reservations.)"""
         return (self._epoch, self.unavailable.seqnum, self.pricing.updates,
-                self._reservation_version)
+                self._reservation_version, self._overlay_version)
 
     def _inject_offerings(self, t: InstanceType, nc: NodeClassSpec) -> List[Offering]:
         out = []

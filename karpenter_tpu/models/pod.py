@@ -90,6 +90,7 @@ class Pod:
     uid: int = field(default_factory=lambda: next(_uid))
     node_name: Optional[str] = None  # bound node (None = pending)
     phase: str = "Pending"
+    _sig: Optional[Tuple] = field(default=None, repr=False, compare=False)
 
     def scheduling_requirements(self) -> Requirements:
         """nodeSelector + required nodeAffinity as one Requirements conjunction."""
@@ -122,19 +123,27 @@ class Pod:
         pods' anti-affinity / topology-spread selectors can distinguish pods
         by them; deduping across label sets would merge pods that must be
         spread apart.
+
+        Cached after first computation (a pod's scheduling constraints are
+        immutable post-creation) — this is the encode hot path at 100k pods.
         """
-        return (
+        if self._sig is not None:
+            return self._sig
+        empty = ()
+        self._sig = (
             self.namespace,
             self.owner,
-            tuple(sorted(self.labels.items())),
-            tuple(sorted(self.requests.items())),
-            tuple(sorted(self.node_selector.items())),
+            tuple(sorted(self.labels.items())) if self.labels else empty,
+            tuple(sorted(self.requests.items())) if self.requests else empty,
+            tuple(sorted(self.node_selector.items())) if self.node_selector else empty,
             tuple(sorted((t["key"], t["operator"], tuple(t.get("values", ())))
-                         for t in self.node_affinity)),
-            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
+                         for t in self.node_affinity)) if self.node_affinity else empty,
+            tuple(sorted((t.key, t.operator, t.value, t.effect)
+                         for t in self.tolerations)) if self.tolerations else empty,
             tuple(sorted((c.topology_key, c.max_skew, c.when_unsatisfiable,
                           tuple(sorted(c.label_selector.items())))
-                         for c in self.topology_spread)),
+                         for c in self.topology_spread)) if self.topology_spread else empty,
             tuple(sorted((t.topology_key, t.anti, tuple(sorted(t.label_selector.items())))
-                         for t in self.affinity_terms)),
+                         for t in self.affinity_terms)) if self.affinity_terms else empty,
         )
+        return self._sig

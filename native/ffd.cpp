@@ -1,0 +1,201 @@
+// Native group-FFD solver — the compiled in-process Solve() implementation.
+//
+// Role: (a) the apples-to-apples baseline for the TPU kernel (the reference
+// implements Solve() as a tight compiled first-fit-decreasing loop in Go;
+// this is the same algorithm in C++), and (b) the production host fallback
+// when no accelerator is attached.
+//
+// Semantics are identical to karpenter_tpu/ops/binpack.solve_host — same
+// f32 arithmetic (EPS slack), same flat-argmin tie-breaks — so the golden
+// agreement tests cover all three backends.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this environment).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace {
+
+constexpr float EPS = 1e-4f;
+constexpr int64_t BIG = 1000000000;
+constexpr float F32_MAX = std::numeric_limits<float>::max();
+
+struct Dims {
+  int64_t G, T, Z, C, R, Nmax, Ne;  // groups, types, zones, captypes, resources, node cap, existing
+};
+
+// per-node state (struct-of-arrays for cache friendliness)
+struct NodeState {
+  std::vector<int32_t> type;
+  std::vector<float> cum;        // [N * R]
+  std::vector<uint8_t> zmask;    // [N * Z]
+  std::vector<uint8_t> cmask;    // [N * C]
+  int64_t used = 0;
+};
+
+inline int64_t fit_count(const float* alloc_t, const float* cum,
+                         const float* req, int64_t R) {
+  float k = static_cast<float>(BIG);
+  for (int64_t r = 0; r < R; ++r) {
+    if (req[r] > 0.0f) {
+      float v = std::floor((alloc_t[r] - cum[r]) / req[r] + EPS);
+      if (v < k) k = v;
+    }
+  }
+  if (k < 0.0f) return 0;
+  return static_cast<int64_t>(k);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, 1 if Nmax overflowed (some pods dropped to
+// unschedulable that a larger Nmax would place).
+//
+// Inputs are row-major flat arrays. Existing nodes occupy the first Ne
+// rows of the node-state output arrays and must be pre-filled by the
+// caller (type, cum, zmask, cmask); prior_counts is [G * Nmax].
+// Outputs: node_type/cum/zmask/cmask [Nmax...], takes [G * Nmax],
+// unsched [G], n_used.
+int32_t ffd_solve(
+    const float* alloc,        // [T * R]
+    const float* price,        // [T * Z * C]
+    const uint8_t* avail,      // [T * Z * C]
+    const float* requests,     // [G * R]
+    const int32_t* counts,     // [G]
+    const uint8_t* compat,     // [G * T]
+    const uint8_t* allow_zone, // [G * Z]
+    const uint8_t* allow_cap,  // [G * C]
+    const int32_t* max_per_node,  // [G]
+    const int32_t* prior_counts,  // [G * Nmax] (may be null)
+    int64_t G, int64_t T, int64_t Z, int64_t C, int64_t R,
+    int64_t Nmax, int64_t Ne,
+    int32_t* node_type,        // [Nmax] in/out
+    float* node_cum,           // [Nmax * R] in/out
+    uint8_t* node_zmask,       // [Nmax * Z] in/out
+    uint8_t* node_cmask,       // [Nmax * C] in/out
+    int32_t* takes,            // [G * Nmax] out
+    int32_t* unsched,          // [G] out
+    int64_t* n_used_out) {
+  int64_t used = Ne;
+  int32_t overflowed = 0;
+  std::memset(takes, 0, sizeof(int32_t) * G * Nmax);
+  std::memset(unsched, 0, sizeof(int32_t) * G);
+
+  std::vector<int64_t> slots_t(T);
+
+  for (int64_t g = 0; g < G; ++g) {
+    const float* req = requests + g * R;
+    int64_t cap_per = max_per_node[g] == 0 ? BIG : max_per_node[g];
+    int64_t rem = counts[g];
+    if (rem == 0) continue;
+
+    // 1. fill open nodes in index order (first-fit)
+    for (int64_t n = 0; n < used && rem > 0; ++n) {
+      int32_t t = node_type[n];
+      if (!compat[g * T + t]) continue;
+      // zone/captype mask intersection must keep >=1 available offering
+      bool off_ok = false;
+      for (int64_t z = 0; z < Z && !off_ok; ++z) {
+        if (!(node_zmask[n * Z + z] && allow_zone[g * Z + z])) continue;
+        for (int64_t c = 0; c < C; ++c) {
+          if (node_cmask[n * C + c] && allow_cap[g * C + c] &&
+              avail[(t * Z + z) * C + c]) {
+            off_ok = true;
+            break;
+          }
+        }
+      }
+      if (!off_ok) continue;
+      int64_t cap_eff = cap_per;
+      if (prior_counts) cap_eff -= prior_counts[g * Nmax + n];
+      if (cap_eff <= 0) continue;
+      int64_t take = fit_count(alloc + t * R, node_cum + n * R, req, R);
+      if (take > cap_eff) take = cap_eff;
+      if (take > rem) take = rem;
+      if (take < 1) continue;
+      for (int64_t r = 0; r < R; ++r)
+        node_cum[n * R + r] += static_cast<float>(take) * req[r];
+      for (int64_t z = 0; z < Z; ++z)
+        node_zmask[n * Z + z] &= allow_zone[g * Z + z];
+      for (int64_t c = 0; c < C; ++c)
+        node_cmask[n * C + c] &= allow_cap[g * C + c];
+      takes[g * Nmax + n] += static_cast<int32_t>(take);
+      rem -= take;
+    }
+    if (rem == 0) continue;
+
+    // 2. cost-per-slot argmin over admissible offerings (flat tie-break:
+    //    lowest (t, z, c) index among equal minima, matching the kernel)
+    for (int64_t t = 0; t < T; ++t) {
+      float k = static_cast<float>(BIG);
+      bool any_req = false;
+      for (int64_t r = 0; r < R; ++r) {
+        if (req[r] > 0.0f) {
+          any_req = true;
+          float v = std::floor(alloc[t * R + r] / req[r] + EPS);
+          if (v < k) k = v;
+        }
+      }
+      int64_t s = any_req ? static_cast<int64_t>(std::fmax(k, 0.0f)) : BIG;
+      slots_t[t] = s < cap_per ? s : cap_per;
+    }
+    float best = F32_MAX;
+    int64_t best_t = -1;
+    for (int64_t t = 0; t < T; ++t) {
+      if (!compat[g * T + t] || slots_t[t] < 1) continue;
+      float denom = static_cast<float>(slots_t[t] < 1 ? 1 : slots_t[t]);
+      for (int64_t z = 0; z < Z; ++z) {
+        if (!allow_zone[g * Z + z]) continue;
+        for (int64_t c = 0; c < C; ++c) {
+          if (!allow_cap[g * C + c]) continue;
+          if (!avail[(t * Z + z) * C + c]) continue;
+          float cps = price[(t * Z + z) * C + c] / denom;
+          if (cps < best) {  // strict <: first flat index wins ties
+            best = cps;
+            best_t = t;
+          }
+        }
+      }
+    }
+    if (best_t < 0) {
+      unsched[g] += static_cast<int32_t>(rem);
+      continue;
+    }
+    int64_t s = slots_t[best_t] < 1 ? 1 : slots_t[best_t];
+    while (rem > 0) {
+      if (used >= Nmax) {
+        overflowed = 1;
+        unsched[g] += static_cast<int32_t>(rem);
+        break;
+      }
+      int64_t take = rem < s ? rem : s;
+      int64_t n = used++;
+      node_type[n] = static_cast<int32_t>(best_t);
+      for (int64_t r = 0; r < R; ++r)
+        node_cum[n * R + r] = static_cast<float>(take) * req[r];
+      for (int64_t z = 0; z < Z; ++z) {
+        uint8_t az = 0;
+        for (int64_t c = 0; c < C; ++c)
+          az |= avail[(best_t * Z + z) * C + c];
+        node_zmask[n * Z + z] = allow_zone[g * Z + z] && az;
+      }
+      for (int64_t c = 0; c < C; ++c) {
+        uint8_t ac = 0;
+        for (int64_t z = 0; z < Z; ++z)
+          ac |= avail[(best_t * Z + z) * C + c];
+        node_cmask[n * C + c] = allow_cap[g * C + c] && ac;
+      }
+      takes[g * Nmax + n] = static_cast<int32_t>(take);
+      rem -= take;
+    }
+  }
+  *n_used_out = used;
+  return overflowed;
+}
+
+}  // extern "C"

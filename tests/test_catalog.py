@@ -1,3 +1,4 @@
+import pytest
 import numpy as np
 
 from karpenter_tpu.catalog import (CatalogProvider, GeneratorConfig,
@@ -100,3 +101,50 @@ def test_nodeclass_zone_filter():
     assert types
     for t in types:
         assert all(o.zone == "zone-a" for o in t.offerings)
+
+
+class TestNodeOverlay:
+    def test_price_and_capacity_overrides(self):
+        from karpenter_tpu.models.overlay import NodeOverlay
+        from karpenter_tpu.models.requirements import Operator, Requirement, Requirements
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.catalog import CatalogProvider, small_catalog
+
+        prov = CatalogProvider(lambda: small_catalog())
+        base = {t.name: t for t in prov.list()}
+        m5l = base["m5.large"]
+        base_price = m5l.offerings[0].price
+        e0 = prov.epoch
+
+        prov.set_overlays([
+            NodeOverlay(name="surcharge",
+                        requirements=Requirements(Requirement(
+                            L.INSTANCE_FAMILY, Operator.IN, ("m5",))),
+                        price_adjustment="+50%"),
+            NodeOverlay(name="device-plugin",
+                        requirements=Requirements(Requirement(
+                            L.INSTANCE_FAMILY, Operator.IN, ("m5",))),
+                        capacity=Resources({"vendor.io/widget": 4.0})),
+        ])
+        assert prov.epoch != e0  # overlay version invalidates caches
+        after = {t.name: t for t in prov.list()}
+        assert after["m5.large"].offerings[0].price == pytest.approx(base_price * 1.5)
+        assert after["m5.large"].capacity["vendor.io/widget"] == 4.0
+        # non-matching types untouched
+        assert after["c5.large"].offerings[0].price == base["c5.large"].offerings[0].price
+
+    def test_absolute_price_and_weight(self):
+        from karpenter_tpu.models.overlay import NodeOverlay, apply_overlays
+        from karpenter_tpu.models.requirements import Operator, Requirement, Requirements
+        from karpenter_tpu.catalog import small_catalog
+        types = small_catalog()
+        heavy = NodeOverlay(name="pin", weight=10,
+                            requirements=Requirements(Requirement(
+                                L.INSTANCE_FAMILY, Operator.IN, ("m5",))),
+                            price_adjustment="0.01")
+        light = NodeOverlay(name="discount", weight=1,
+                            requirements=Requirements(Requirement(
+                                L.INSTANCE_FAMILY, Operator.IN, ("m5",))),
+                            price_adjustment="-50%")
+        out = {t.name: t for t in apply_overlays(types, [light, heavy])}
+        assert all(o.price == 0.01 for o in out["m5.large"].offerings)

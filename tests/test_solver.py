@@ -230,3 +230,74 @@ class TestReviewFindings:
         h = solve_host(self.cat, enc)
         assert sum(n.pod_count() for n in d.nodes) == 40
         assert len(d.nodes) == len(h.nodes)
+
+
+class TestNativeBackend:
+    """C++ group-FFD must agree with the oracle node-for-node."""
+
+    def setup_method(self):
+        from karpenter_tpu.ops import native
+        if not native.available():
+            pytest.skip("no C++ toolchain")
+        self.types = small_catalog()
+        self.cat = encode_catalog(self.types)
+
+    def _agree(self, enc, existing=None):
+        from karpenter_tpu.ops.native import solve_native
+        h = solve_host(self.cat, enc, existing)
+        n = solve_native(self.cat, enc, existing)
+        assert not validate_solution(self.cat, enc, n), validate_solution(self.cat, enc, n)
+        assert len(h.nodes) == len(n.nodes)
+        for a, b in zip(h.nodes, n.nodes):
+            assert a.type_idx == b.type_idx
+            assert a.pods_by_group == b.pods_by_group
+            assert (a.zone_mask == b.zone_mask).all()
+            assert (a.cap_mask == b.cap_mask).all()
+        assert h.unschedulable == n.unschedulable
+        assert h.launches == n.launches
+        return h, n
+
+    def test_heterogeneous(self):
+        pods = (mk_pods(40, "250m", "512Mi", "s") + mk_pods(25, "2", "4Gi", "l")
+                + mk_pods(10, "4", "8Gi", "xl"))
+        self._agree(encode_pods(pods, self.cat))
+
+    def test_constrained(self):
+        pods = (mk_pods(20, "1", "2Gi", "a", node_selector={L.INSTANCE_FAMILY: "m5"})
+                + mk_pods(15, "1", "2Gi", "b",
+                          node_affinity=[{"key": L.CAPACITY_TYPE, "operator": "In",
+                                          "values": ["spot"]}]))
+        self._agree(encode_pods(pods, self.cat))
+
+    def test_anti_affinity_with_existing(self):
+        pods = mk_pods(3, "250m", "512Mi", "aa", labels={"app": "x"},
+                       affinity_terms=[PodAffinityTerm(
+                           topology_key="kubernetes.io/hostname",
+                           label_selector={"app": "x"}, anti=True)])
+        enc = encode_pods(pods, self.cat)
+        t = next(i for i, n in enumerate(self.cat.names) if n.endswith("8xlarge"))
+        existing = [VirtualNode(
+            type_idx=t, zone_mask=np.ones(self.cat.Z, bool),
+            cap_mask=np.ones(self.cat.C, bool),
+            cum=np.zeros(len(self.cat.resources), np.float32),
+            prior_by_group={0: 1}, existing_name="inflight-1")]
+        h, n = self._agree(enc, existing)
+        assert n.nodes[0].pods_by_group.get(0, 0) == 0
+
+    def test_unschedulable(self):
+        enc = encode_pods(mk_pods(5, "1000", "1Gi", "huge"), self.cat)
+        h, n = self._agree(enc)
+        assert sum(n.unschedulable.values()) == 5
+
+    def test_full_catalog(self):
+        cat = encode_catalog(generate_catalog())
+        pods = (mk_pods(200, "500m", "1Gi", "w") +
+                mk_pods(50, "2", "4Gi", "x",
+                        node_affinity=[{"key": L.INSTANCE_CATEGORY, "operator": "In",
+                                        "values": ["c", "m"]}]))
+        enc = encode_pods(pods, cat)
+        from karpenter_tpu.ops.native import solve_native
+        h = solve_host(cat, enc)
+        n = solve_native(cat, enc)
+        assert len(h.nodes) == len(n.nodes)
+        assert h.launches == n.launches
