@@ -72,6 +72,69 @@ def main() -> None:
     host_s = timeit(lambda: solve_host(cat, enc100k), repeats=3)
     detail["host_ffd_100k_ms"] = round(host_s * 1e3, 1)
     detail["pods_per_sec"] = round(100_000 / tpu_s)
+    try:
+        from karpenter_tpu.ops.native import solve_native
+        solve_native(cat, enc100k)
+        detail["native_cpp_100k_ms"] = round(
+            timeit(lambda: solve_native(cat, enc100k)) * 1e3, 1)
+    except Exception:
+        pass
+
+    # --- config 3: 50k pods with anti-affinity + zone topology spread ---
+    from karpenter_tpu.models.pod import (PodAffinityTerm,
+                                          TopologySpreadConstraint)
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.ops.binpack import split_spread_groups
+    pods3 = []
+    for i in range(50_000):
+        s = i % 40
+        kw = dict(requests=Resources.parse(
+            {"cpu": shapes[s % len(shapes)][0], "memory": shapes[s % len(shapes)][1]}),
+            labels={"app": f"svc-{s}"})
+        if s % 3 == 0:
+            kw["topology_spread"] = [TopologySpreadConstraint(
+                topology_key=L.ZONE, max_skew=1)]
+        if s % 7 == 0:
+            kw["affinity_terms"] = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": f"svc-{s}"}, anti=True)]
+        pods3.append(Pod(name=f"c3-{i}", **kw))
+    t0 = time.perf_counter()
+    enc3 = split_spread_groups(encode_pods(pods3, cat), cat)
+    detail["c3_encode_50k_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    solve_device(cat, enc3)
+    detail["c3_50k_affinity_ms"] = round(
+        timeit(lambda: solve_device(cat, enc3), repeats=3) * 1e3, 1)
+
+    # --- config 4: 5k-node consolidation screen (one batched kernel call) ---
+    import numpy as np
+    from karpenter_tpu.models.nodeclaim import NodeClaim
+    from karpenter_tpu.ops.binpack import VirtualNode
+    from karpenter_tpu.ops.consolidate import consolidation_screen
+    from karpenter_tpu.state.cluster import NodeView
+    N = 5000
+    cpods = mk_pods(N * 4)
+    enc4 = encode_pods(cpods, cat)
+    t2x = [i for i, n in enumerate(cat.names) if n.endswith(".2xlarge")][:20]
+    views = []
+    for i in range(N):
+        vn = VirtualNode(
+            type_idx=t2x[i % len(t2x)],
+            zone_mask=np.ones(cat.Z, bool), cap_mask=np.ones(cat.C, bool),
+            cum=np.asarray(enc4.requests[i % enc4.G] * 4, np.float32),
+            existing_name=f"n{i}")
+        claim = NodeClaim(name=f"n{i}", nodepool="default")
+        views.append(NodeView(claim=claim, node=None,
+                              pods=cpods[i * 4:(i + 1) * 4], virtual=vn,
+                              price=0.1))
+    counts = np.zeros((N, enc4.G), np.int32)
+    for i in range(N):
+        for p in cpods[i * 4:(i + 1) * 4]:
+            counts[i, i % enc4.G] += 1
+    consolidation_screen(cat, enc4, views, counts)
+    detail["c4_5k_node_screen_ms"] = round(
+        timeit(lambda: consolidation_screen(cat, enc4, views, counts),
+               repeats=3) * 1e3, 1)
 
     result = {
         "metric": "p50 Solve() latency, 100k pods x full catalog",

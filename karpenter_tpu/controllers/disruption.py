@@ -201,10 +201,13 @@ class DisruptionController:
     def _single_node(self, pool: NodePool, candidates: List[NodeView],
                      now: float, cat, views: List[NodeView],
                      budget: int) -> None:
-        done = 0
-        for v in candidates:
-            if done >= budget:
+        ordered = self._screen_order(pool, candidates, cat, views)
+        done, sims = 0, 0
+        max_sims = max(3 * budget, 10)  # exact-verification budget
+        for v in ordered:
+            if done >= budget or sims >= max_sims:
                 break
+            sims += 1
             out, ok = self._simulate_removal(pool, [v], cat, views, v.price)
             if not ok:
                 continue
@@ -213,6 +216,44 @@ class DisruptionController:
             self._execute(pool, [v], out, "Underutilized", now)
             self.stats["consolidated"] += 1
             done += 1
+
+    def _screen_order(self, pool: NodePool, candidates: List[NodeView],
+                      cat, views: List[NodeView]) -> List[NodeView]:
+        """Batched TPU screen over ALL candidates (one kernel call against
+        the WHOLE cluster's headroom), then order: screened-feasible by
+        descending price (biggest savings first), then the rest (feasible
+        only with replacements) by price."""
+        import numpy as np
+
+        from ..ops.consolidate import consolidation_screen
+        from ..ops.encode import encode_pods
+        all_pods = [p for v in views for p in v.pods]
+        if not all_pods:
+            return candidates
+        enc = encode_pods(all_pods, cat,
+                          extra_requirements=pool.requirements,
+                          taints=pool.taints + pool.startup_taints)
+        if enc.G == 0:
+            return candidates
+        sig_to_g = {g.representative.constraint_signature(): i
+                    for i, g in enumerate(enc.groups)}
+        counts = np.zeros((len(views), enc.G), np.int32)
+        for i, v in enumerate(views):
+            for p in v.pods:
+                gi = sig_to_g.get(p.constraint_signature())
+                if gi is not None:
+                    counts[i, gi] += 1
+        try:
+            screen, _slack = consolidation_screen(cat, enc, views, counts)
+        except Exception:
+            return candidates  # screen is best-effort; fall back to cost order
+        ok = {v.name for i, v in enumerate(views) if screen[i]}
+        first = [v for v in candidates if v.name in ok]
+        rest = [v for v in candidates if v.name not in ok]
+        first.sort(key=lambda v: -v.price)
+        rest.sort(key=lambda v: -v.price)
+        self.stats["screened"] = len(first)
+        return first + rest
 
     def _multi_node(self, pool: NodePool, candidates: List[NodeView],
                     now: float, cat, views: List[NodeView]) -> bool:

@@ -157,3 +157,73 @@ class TestGC:
         sim.engine.run_for(200, step=10)
         assert sim.cloud.instances[res[0].id].state == "terminated"
         assert sim.gc.stats["instances_reaped"] == 1
+
+
+class TestConsolidationScreen:
+    def test_screen_identifies_absorbable_nodes(self):
+        """Batched screen: a mostly-empty cluster screens nearly all nodes
+        as absorbable; a packed cluster screens none."""
+        import numpy as np
+        from karpenter_tpu.ops.consolidate import consolidation_screen
+        from karpenter_tpu.ops.encode import encode_pods
+
+        sim = make_sim()
+        pods = add_pods(sim, 40)
+        settle(sim)
+        cat = sim.solver.tensors(sim.store.nodeclasses["default"])
+        from karpenter_tpu.state.cluster import build_node_views
+        # drop most pods: lots of headroom
+        for p in pods[:30]:
+            sim.store.delete_pod(p.namespace, p.name)
+        views = build_node_views(sim.store, cat, sim.clock.now())
+        all_pods = [p for v in views for p in v.pods]
+        enc = encode_pods(all_pods, cat)
+        sig_to_g = {g.representative.constraint_signature(): i
+                    for i, g in enumerate(enc.groups)}
+        counts = np.zeros((len(views), max(enc.G, 1)), np.int32)
+        for i, v in enumerate(views):
+            for p in v.pods:
+                counts[i, sig_to_g[p.constraint_signature()]] += 1
+        screen, slack = consolidation_screen(cat, enc, views, counts)
+        assert screen.any()  # at least one node's pods fit elsewhere
+
+    def test_screen_speeds_up_large_consolidation(self):
+        """5k-node-scale screen completes in one batched call (config #4
+        shape, scaled down for CI but structurally identical)."""
+        import numpy as np
+        import time
+        from karpenter_tpu.ops.consolidate import consolidation_screen
+        from karpenter_tpu.ops.encode import encode_pods
+        from karpenter_tpu.ops.binpack import VirtualNode
+        from karpenter_tpu.state.cluster import NodeView
+        from karpenter_tpu.models.nodeclaim import NodeClaim, Phase
+        from karpenter_tpu.catalog import generate_catalog
+        from karpenter_tpu.ops.encode import encode_catalog
+
+        cat = encode_catalog(generate_catalog())
+        N = 500
+        rng = np.random.default_rng(0)
+        pods = [Pod(name=f"p{i}", requests=Resources.parse(
+            {"cpu": "500m", "memory": "1Gi"})) for i in range(N * 4)]
+        enc = encode_pods(pods, cat)
+        views = []
+        t_idx = [i for i, n in enumerate(cat.names) if n.endswith(".2xlarge")][:10]
+        for i in range(N):
+            t = t_idx[i % len(t_idx)]
+            vn = VirtualNode(
+                type_idx=t, zone_mask=np.ones(cat.Z, bool),
+                cap_mask=np.ones(cat.C, bool),
+                cum=np.asarray(enc.requests[0] * 4, np.float32),
+                existing_name=f"n{i}")
+            claim = NodeClaim(name=f"n{i}", nodepool="default")
+            claim.price = 0.1
+            views.append(NodeView(claim=claim, node=None,
+                                  pods=pods[i * 4:(i + 1) * 4], virtual=vn,
+                                  price=0.1))
+        counts = np.full((N, enc.G), 4, np.int32)
+        consolidation_screen(cat, enc, views, counts)  # compile
+        t0 = time.perf_counter()
+        screen, slack = consolidation_screen(cat, enc, views, counts)
+        dt = time.perf_counter() - t0
+        assert dt < 2.0  # one batched call, not N simulations
+        assert screen.shape == (N,)
