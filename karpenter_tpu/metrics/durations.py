@@ -1,0 +1,41 @@
+"""Scale-test duration-event pipeline.
+
+Reference: scale-suite durations flow to AWS Timestream
+(test/pkg/environment/aws/metrics.go:36-38,65-110) and are graphed via the
+CloudFormation-provisioned Grafana. Ours records the same shape of events
+(test name, dimensions, duration) to a local JSONL file that any dashboard
+can ingest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+DEFAULT_PATH = os.environ.get("KARPENTER_TPU_DURATIONS",
+                              os.path.join(os.path.dirname(os.path.dirname(
+                                  os.path.dirname(os.path.abspath(__file__)))),
+                                  "scale_durations.jsonl"))
+
+
+class DurationRecorder:
+    def __init__(self, path: str = DEFAULT_PATH):
+        self.path = path
+
+    def record(self, name: str, seconds: float,
+               dimensions: Optional[Dict[str, str]] = None) -> None:
+        evt = {"measure": "duration", "name": name, "seconds": round(seconds, 4),
+               "dimensions": dimensions or {}, "recorded_at": time.time()}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(evt) + "\n")
+
+    @contextmanager
+    def measure(self, name: str, sim_clock=None, **dimensions):
+        """Measure wall (or sim) time of a block."""
+        t0 = sim_clock.now() if sim_clock else time.perf_counter()
+        yield
+        t1 = sim_clock.now() if sim_clock else time.perf_counter()
+        self.record(name, t1 - t0, {k: str(v) for k, v in dimensions.items()})

@@ -44,6 +44,24 @@ class SimEnvironment:
     interruption: InterruptionController
     gc: GarbageCollectionController
 
+    def start_chaos(self, interval: float = 60.0, seed: int = 0) -> None:
+        """kwok kill-node-thread analog (kwok/ec2/ec2.go:253-282): kill a
+        random running instance every `interval` sim-seconds; the state-
+        change interruption event + GC/liveness recover the cluster."""
+        import random
+        rng = random.Random(seed)
+        state = {"last": self.clock.now()}
+
+        def hook(now: float) -> None:
+            if now - state["last"] >= interval:
+                state["last"] = now
+                running = [i for i in self.cloud.instances.values()
+                           if i.state == "running"]
+                if running:
+                    self.cloud.kill_instance(rng.choice(running).id,
+                                             reason="chaos")
+        self.engine.add_hook(hook)
+
 
 def make_sim(types: Optional[List[InstanceType]] = None,
              backend: str = "host",

@@ -227,3 +227,23 @@ class TestConsolidationScreen:
         dt = time.perf_counter() - t0
         assert dt < 2.0  # one batched call, not N simulations
         assert screen.shape == (N,)
+
+
+class TestChaos:
+    def test_cluster_survives_kill_thread(self):
+        """kwok-style chaos: periodic instance kills; the state-change
+        events drain dead claims, GC reaps orphans, pods reschedule."""
+        sim = make_sim()
+        pods = add_pods(sim, 30)
+        settle(sim)
+        sim.start_chaos(interval=120.0, seed=42)
+        sim.engine.run_for(900, step=5)
+        # chaos killed something
+        killed = [i for i in sim.cloud.instances.values() if i.state == "terminated"]
+        assert killed
+        # and the cluster healed: every pod is bound to a live node
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=300)
+        for p in sim.store.pods.values():
+            node = sim.store.nodes[p.node_name]
+            iid = node.provider_id.rsplit("/", 1)[-1]
+            assert sim.cloud.instances[iid].state == "running"

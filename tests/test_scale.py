@@ -1,0 +1,129 @@
+"""Scale suite — the reference's scale-test grid on the fake cloud.
+
+Reference scale points (test/suites/scale/provisioning_test.go:76-259,
+deprovisioning_test.go:128-434; our BASELINE.md):
+  - node-dense: 500 nodes x 1 pod each
+  - pod-dense: 6,600 pods -> ~60 nodes x 110 pods
+  - deprovisioning: 200-node consolidation
+  - interruption throughput: 1k queued messages
+Durations are recorded through the duration-event pipeline
+(metrics/durations.py — the Timestream analog). Sim time, not wall time,
+measures the provisioning latency the way the reference's suite does.
+"""
+
+import os
+import tempfile
+
+import pytest
+
+from karpenter_tpu.catalog import GeneratorConfig, generate_catalog
+from karpenter_tpu.metrics.durations import DurationRecorder
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+RECORDER = DurationRecorder(os.path.join(tempfile.gettempdir(),
+                                         "karpenter_tpu_test_durations.jsonl"))
+
+
+def all_bound(sim):
+    return all(p.node_name is not None for p in sim.store.pods.values())
+
+
+@pytest.mark.slow
+class TestScaleSuite:
+    def test_node_dense_500x1(self):
+        """500 single-pod nodes (hostname anti-affinity forces 1/node)."""
+        sim = make_sim()
+        for i in range(500):
+            sim.store.add_pod(Pod(
+                name=f"nd-{i}", labels={"app": "dense"},
+                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                affinity_terms=[PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector={"app": "dense"}, anti=True)]))
+        with RECORDER.measure("node-dense", sim_clock=sim.clock, pods=500):
+            ok = sim.engine.run_until(lambda: all_bound(sim), timeout=1800)
+        assert ok
+        assert len(sim.store.nodes) == 500
+        assert all(len(sim.store.pods_on_node(n)) == 1 for n in sim.store.nodes)
+
+    def test_pod_dense_6600(self):
+        """6,600 pods pack densely (reference: 60 nodes x 110 pods)."""
+        sim = make_sim(types=generate_catalog(GeneratorConfig(
+            families=["m5", "m6", "c5", "c6", "r5"])))
+        for i in range(6600):
+            sim.store.add_pod(Pod(
+                name=f"pd-{i}",
+                requests=Resources.parse({"cpu": "100m", "memory": "256Mi"})))
+        with RECORDER.measure("pod-dense", sim_clock=sim.clock, pods=6600):
+            ok = sim.engine.run_until(lambda: all_bound(sim), timeout=1800)
+        assert ok
+        # pods-per-node is capped by the 110-737 ENI-style limits; dense
+        # packing should land in the same order of magnitude as the
+        # reference's 60 nodes
+        assert len(sim.store.nodes) <= 90
+        # single CreateFleet batch for the whole burst
+        assert sim.cloud.api_calls["create_fleet"] <= 3
+
+    def test_deprovisioning_200_node_consolidation(self):
+        """200 under-utilized nodes consolidate down (reference
+        deprovisioning_test.go:346-434)."""
+        sim = make_sim()
+        pods = []
+        for i in range(800):
+            p = Pod(name=f"dc-{i}", labels={"app": f"g{i % 200}"},
+                    requests=Resources.parse({"cpu": "1", "memory": "2Gi"}),
+                    affinity_terms=[PodAffinityTerm(
+                        topology_key="kubernetes.io/hostname",
+                        label_selector={"app": f"g{i % 200}"}, anti=True)])
+            pods.append(sim.store.add_pod(p))
+        ok = sim.engine.run_until(lambda: all_bound(sim), timeout=1800)
+        assert ok
+        n_before = len(sim.store.nodeclaims)
+        assert n_before >= 200
+        # drop the anti-affinity population -> heavy under-utilization
+        for p in pods[200:]:
+            sim.store.delete_pod(p.namespace, p.name)
+        cost_before = sum(c.price for c in sim.store.nodeclaims.values())
+        with RECORDER.measure("deprovisioning-consolidation",
+                              sim_clock=sim.clock, nodes=n_before):
+            sim.engine.run_for(1200, step=10)
+        cost_after = sum(c.price for c in sim.store.nodeclaims.values())
+        assert len(sim.store.nodeclaims) < n_before
+        assert cost_after < cost_before
+        assert all_bound(sim)
+
+    def test_interruption_throughput_1k(self):
+        """1k queued interruption messages drain the right claims
+        (reference interruption_benchmark_test.go shape)."""
+        sim = make_sim()
+        for i in range(300):
+            sim.store.add_pod(Pod(
+                name=f"it-{i}",
+                requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=600)
+        claims = list(sim.store.nodeclaims.values())
+        victims = claims[: len(claims) // 2]
+        # flood the queue: many duplicate + unknown-instance messages
+        import itertools
+        for v, _ in zip(itertools.cycle(victims), range(900)):
+            iid = v.provider_id.rsplit("/", 1)[-1]
+            sim.cloud.send_spot_interruption(iid)
+        for i in range(100):
+            sim.cloud.interruptions.append({
+                "kind": "spot-interruption", "instance_id": f"i-unknown{i}",
+                "provider_id": f"tpu:///zone-a/i-unknown{i}",
+                "instance_type": "m5.large", "zone": "zone-a",
+                "capacity_type": "spot", "time": sim.clock.now()})
+        with RECORDER.measure("interruption-1k", sim_clock=sim.clock,
+                              messages=1000):
+            sim.engine.run_until(lambda: not sim.cloud.interruptions,
+                                 timeout=600)
+        assert not sim.cloud.interruptions  # all 1k consumed + acked
+        sim.engine.run_for(120, step=5)  # finish the 30s-grace drains
+        for v in victims:
+            assert v.name not in sim.store.nodeclaims  # drained
+        # cluster recovers
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=600)
