@@ -98,18 +98,30 @@ class DiscoveredCapacityController:
 @dataclass
 class CatalogRefreshController:
     """5m instance-type/offering refresh + 12h pricing refresh (staleness
-    SLOs from pkg/cache/cache.go)."""
+    SLOs from pkg/cache/cache.go). A ChangeMonitor dedupes discovery
+    logging the way the reference's pretty.ChangeMonitor does
+    (instancetype.go:261-266)."""
 
     catalog: CatalogProvider
+    store: Optional[Store] = None
     name: str = "providers.refresh"
     requeue: float = 300.0
     pricing_interval: float = 12 * 3600
     _last_pricing: float = 0.0
+    _monitor: object = None
 
     def reconcile(self, now: float) -> float:
+        from ..utils.changemonitor import ChangeMonitor
+        if self._monitor is None:
+            self._monitor = ChangeMonitor(clock=self.catalog.clock)
         self.catalog.refresh()
+        types = self.catalog.raw_types()
+        if self.store is not None and self._monitor.has_changed(
+                "instance-types", sorted(t.name for t in types)):
+            self.store.record_event("catalog", "instance-types", "Discovered",
+                                    f"{len(types)} instance types")
         if now - self._last_pricing >= self.pricing_interval:
-            self.catalog.pricing.hydrate(self.catalog.raw_types())
+            self.catalog.pricing.hydrate(types)
             self._last_pricing = now
         return self.requeue
 

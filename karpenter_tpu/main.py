@@ -73,7 +73,7 @@ def build_operator(options: Optional[Options] = None,
                                  disruption, gc, metrics_c, nodeclass_c,
                                  repair, TaggingController(store=store, cloud=cloud),
                                  DiscoveredCapacityController(store=store, catalog=catalog),
-                                 CatalogRefreshController(catalog=catalog),
+                                 CatalogRefreshController(catalog=catalog, store=store),
                                  ReservationExpirationController(store=store, cloud=cloud)]
     if opts.interruption_queue:
         controllers.append(InterruptionController(

@@ -60,10 +60,27 @@ def _pod_key(p: Pod) -> str:
 
 
 class Solver:
-    def __init__(self, catalog: CatalogProvider, backend: str = "device"):
+    def __init__(self, catalog: CatalogProvider, backend: str = "auto"):
         self.catalog = catalog
+        if backend == "auto":
+            backend = self._detect_backend()
         self.backend = backend
         self._cat_cache: Dict[tuple, CatalogTensors] = {}
+        self._dcat_cache: Dict[tuple, object] = {}  # device-resident tensors
+        self._last_cat_key: tuple = ()
+
+    @staticmethod
+    def _detect_backend() -> str:
+        """auto: TPU kernel when an accelerator is attached, else the
+        compiled C++ solver, else the numpy oracle."""
+        try:
+            import jax
+            if any(d.platform != "cpu" for d in jax.devices()):
+                return "device"
+        except Exception:
+            pass
+        from . import native
+        return "native" if native.available() else "host"
 
     def tensors(self, node_class: Optional[NodeClassSpec] = None) -> CatalogTensors:
         nc = node_class or NodeClassSpec()
@@ -74,6 +91,7 @@ class Solver:
             hit = encode_catalog(types)
             self._cat_cache.clear()  # one epoch's views at a time
             self._cat_cache[key] = hit
+        self._last_cat_key = key
         return hit
 
     def solve(self, pods: Sequence[Pod], nodepool: NodePool,
@@ -127,9 +145,21 @@ class Solver:
         t0 = _time.perf_counter()
         if self.backend == "host":
             result = solve_host(cat, enc, existing)
+        elif self.backend == "native":
+            from .native import solve_native
+            result = solve_native(cat, enc, existing)
         else:
-            from .solver import solve_device
-            result = solve_device(cat, enc, existing)
+            from .solver import device_catalog, solve_device
+            R = enc.requests.shape[1]
+            # keyed on (nodeclass hash, catalog epoch, R) — NOT id(cat):
+            # a freed CatalogTensors' address can be reused by its successor
+            dkey = self._last_cat_key + (R,)
+            dcat = self._dcat_cache.get(dkey)
+            if dcat is None:
+                self._dcat_cache.clear()  # one epoch resident at a time
+                dcat = device_catalog(cat, R)
+                self._dcat_cache[dkey] = dcat
+            result = solve_device(cat, enc, existing, dcat=dcat)
         SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=self.backend)
         SOLVE_PODS.observe(float(enc.counts.sum()))
 

@@ -100,7 +100,7 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     repair = NodeRepairController(store=store, termination=termination)
     tagging = TaggingController(store=store, cloud=cloud)
     discovered = DiscoveredCapacityController(store=store, catalog=catalog)
-    refresh = CatalogRefreshController(catalog=catalog)
+    refresh = CatalogRefreshController(catalog=catalog, store=store)
     res_exp = ReservationExpirationController(store=store, cloud=cloud)
     engine = Engine(clock=clock).add(nodeclass_c, provisioner, lifecycle,
                                      binding, termination, disruption,

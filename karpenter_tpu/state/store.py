@@ -62,13 +62,18 @@ class Store:
         pod.phase = "Running"
         self._notify("pod", "bind", pod)
 
-    # --- nodepools / nodeclasses ---
+    # --- nodepools / nodeclasses (validated at admission, like the
+    # reference's CEL rules on the CRDs) ---
     def add_nodepool(self, np_: NodePool) -> NodePool:
+        from ..models.validation import validate_nodepool
+        validate_nodepool(np_)
         self.nodepools[np_.name] = np_
         self._notify("nodepool", "add", np_)
         return np_
 
     def add_nodeclass(self, nc: NodeClassSpec) -> NodeClassSpec:
+        from ..models.validation import validate_nodeclass
+        validate_nodeclass(nc)
         self.nodeclasses[nc.name] = nc
         self._notify("nodeclass", "add", nc)
         return nc

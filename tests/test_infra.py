@@ -112,7 +112,7 @@ class TestOptions:
     def test_defaults(self):
         o = Options.parse([], env={})
         assert o.vm_memory_overhead_percent == 0.075
-        assert o.solver_backend == "device"
+        assert o.solver_backend == "auto"
         assert o.gate("SpotToSpotConsolidation")
 
     def test_flag_overrides_env(self):
@@ -210,3 +210,17 @@ class TestOperator:
         asyncio.run(run())
         assert all(p.node_name for p in store.pods.values())
         assert store.nodeclaims
+
+
+class TestChangeMonitor:
+    def test_dedupes_until_change_or_ttl(self):
+        from karpenter_tpu.utils.changemonitor import ChangeMonitor
+        from karpenter_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        m = ChangeMonitor(ttl=100.0, clock=clock)
+        assert m.has_changed("k", ["a", "b"])
+        assert not m.has_changed("k", ["a", "b"])   # same value: quiet
+        assert m.has_changed("k", ["a", "b", "c"])  # changed: log
+        assert not m.has_changed("k", ["a", "b", "c"])
+        clock.step(101)
+        assert m.has_changed("k", ["a", "b", "c"])  # TTL re-log

@@ -1,0 +1,133 @@
+"""Admission validation (the reference's CEL-test-suite analog)."""
+
+import pytest
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import (Budget, DisruptionSpec,
+                                           NodeClassSpec, NodePool)
+from karpenter_tpu.models.pod import Taint
+from karpenter_tpu.models.requirements import Operator, Requirement
+from karpenter_tpu.models.validation import (ValidationError,
+                                             validate_nodeclass,
+                                             validate_nodepool)
+
+
+def ok_pool(**kw):
+    return NodePool(name="valid", **kw)
+
+
+class TestNodePoolValidation:
+    def test_valid_passes(self):
+        validate_nodepool(ok_pool())
+
+    def test_bad_name(self):
+        with pytest.raises(ValidationError, match="name"):
+            validate_nodepool(NodePool(name="Bad_Name!"))
+
+    def test_restricted_label(self):
+        with pytest.raises(ValidationError, match="restricted"):
+            validate_nodepool(ok_pool(labels={L.NODEPOOL: "x"}))
+        with pytest.raises(ValidationError, match="restricted"):
+            validate_nodepool(ok_pool(labels={"kubernetes.io/custom": "x"}))
+
+    def test_restricted_requirement(self):
+        p = ok_pool()
+        p.requirements.add(Requirement(L.HOSTNAME, Operator.IN, ("n1",)))
+        with pytest.raises(ValidationError, match="restricted"):
+            validate_nodepool(p)
+
+    def test_min_values_range(self):
+        p = ok_pool()
+        p.requirements.add(Requirement(L.INSTANCE_TYPE, Operator.EXISTS,
+                                       min_values=51))
+        with pytest.raises(ValidationError, match="minValues"):
+            validate_nodepool(p)
+
+    def test_numeric_label_values(self):
+        p = ok_pool()
+        p.requirements.add(Requirement(L.INSTANCE_CPU, Operator.IN, ("four",)))
+        with pytest.raises(ValidationError, match="numeric"):
+            validate_nodepool(p)
+
+    def test_taint_effect(self):
+        with pytest.raises(ValidationError, match="taint effect"):
+            validate_nodepool(ok_pool(taints=[Taint(key="k", effect="Sometimes")]))
+
+    def test_budget_ranges(self):
+        bad = DisruptionSpec(budgets=[Budget(nodes="150%")])
+        with pytest.raises(ValidationError, match="percentage"):
+            validate_nodepool(ok_pool(disruption=bad))
+        with pytest.raises(ValidationError, match="budget"):
+            validate_nodepool(ok_pool(
+                disruption=DisruptionSpec(budgets=[Budget(nodes="lots")])))
+
+    def test_consolidation_policy(self):
+        with pytest.raises(ValidationError, match="consolidationPolicy"):
+            validate_nodepool(ok_pool(
+                disruption=DisruptionSpec(consolidation_policy="Sometimes")))
+
+    def test_store_rejects_invalid(self):
+        from karpenter_tpu.state.store import Store
+        with pytest.raises(ValidationError):
+            Store().add_nodepool(NodePool(name="UPPER"))
+
+
+class TestNodeClassValidation:
+    def test_valid_passes(self):
+        validate_nodeclass(NodeClassSpec(name="default"))
+
+    def test_alias_exclusive(self):
+        with pytest.raises(ValidationError, match="alias"):
+            validate_nodeclass(NodeClassSpec(
+                name="x", image_selector={"alias": "standard@latest",
+                                          "family": "standard"}))
+
+    def test_max_pods_range(self):
+        with pytest.raises(ValidationError, match="maxPods"):
+            validate_nodeclass(NodeClassSpec(name="x", kubelet_max_pods=9999))
+
+    def test_restricted_tags(self):
+        with pytest.raises(ValidationError, match="tag"):
+            validate_nodeclass(NodeClassSpec(
+                name="x", tags={"karpenter.tpu/nodepool": "y"}))
+
+    def test_metadata_tokens(self):
+        with pytest.raises(ValidationError, match="metadata"):
+            validate_nodeclass(NodeClassSpec(name="x", metadata_http_tokens="off"))
+
+
+class TestReviewFixes:
+    def test_subdomain_restriction(self):
+        with pytest.raises(ValidationError, match="restricted"):
+            validate_nodepool(NodePool(name="p",
+                                       labels={"node.kubernetes.io/custom": "x"}))
+        # unrelated domains that merely contain the string are fine
+        validate_nodepool(NodePool(name="p", labels={"mykubernetes.io/x": "y"}))
+
+    def test_auto_backend_resolves(self):
+        from karpenter_tpu.catalog import CatalogProvider, small_catalog
+        from karpenter_tpu.ops.facade import Solver
+        s = Solver(CatalogProvider(lambda: small_catalog()), backend="auto")
+        assert s.backend in ("device", "native", "host")
+
+    def test_dcat_cache_invalidated_on_epoch_change(self):
+        """Device tensors must not survive a catalog epoch change (the
+        id()-reuse bug)."""
+        from karpenter_tpu.catalog import CatalogProvider, small_catalog
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.ops.facade import Solver
+        prov = CatalogProvider(lambda: small_catalog())
+        s = Solver(prov, backend="device")
+        from karpenter_tpu.models.nodepool import NodePool
+        pods = [Pod(name="a", requests=Resources.parse({"cpu": "1", "memory": "1Gi"}))]
+        out1 = s.solve(pods, NodePool(name="p"))
+        key1 = s._last_cat_key
+        # ICE-mark the chosen offering -> epoch changes -> new device tensors
+        l = out1.launches[0]
+        prov.unavailable.mark_unavailable(l.instance_type, l.zone, l.capacity_type)
+        out2 = s.solve(pods, NodePool(name="p"))
+        assert s._last_cat_key != key1
+        l2 = out2.launches[0]
+        assert (l2.instance_type, l2.zone, l2.capacity_type) != \
+            (l.instance_type, l.zone, l.capacity_type)
