@@ -55,6 +55,7 @@ class DisruptionController:
     termination: TerminationController
     name: str = "disruption"
     requeue: float = 5.0
+    spot_to_spot: bool = True  # SpotToSpotConsolidation feature gate
     _pending: List[PendingDisruption] = field(default_factory=list)
     stats: Dict[str, int] = field(default_factory=lambda: {
         "empty": 0, "drift": 0, "expired": 0, "consolidated": 0,
@@ -292,6 +293,8 @@ class DisruptionController:
         for launch in out.launches:
             if launch.capacity_type != "spot":
                 continue
+            if not self.spot_to_spot:
+                return False  # gate off: never replace spot with spot
             distinct = {o[0] for o in launch.overrides
                         if o[2] == "spot" and o[3] < victim.price}
             if len(distinct) < SPOT_TO_SPOT_MIN_TYPES:

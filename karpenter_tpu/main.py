@@ -55,7 +55,8 @@ def build_operator(options: Optional[Options] = None,
     disruption = DisruptionController(store=store, solver=solver,
                                       catalog=catalog,
                                       provisioner=provisioner,
-                                      termination=termination)
+                                      termination=termination,
+                                      spot_to_spot=opts.gate("SpotToSpotConsolidation"))
     gc = GarbageCollectionController(store=store, cloud=cloud)
     metrics_c = CloudProviderMetricsController(catalog=catalog)
     from .cloud.image import ImageProvider

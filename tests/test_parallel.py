@@ -46,3 +46,17 @@ def test_sharded_solve_agrees_with_host():
         assert ntype[i] == n.type_idx
         for g in range(enc.G):
             assert takes[g, i] == n.pods_by_group.get(g, 0)
+
+
+def test_graft_entry_contract():
+    """The driver's entry() must stay jittable with its example args."""
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+    import jax
+    import numpy as np
+    fn, args = g.entry()
+    out = jax.jit(fn)(*[np.asarray(a) for a in args])
+    jax.block_until_ready(out)
+    nused = int(np.asarray(out[5]))
+    assert nused > 0
