@@ -53,10 +53,12 @@ class Provisioner:
         if not pending:
             return self.requeue
         remaining: List[Pod] = pending
+        spread_occupancy = self._cluster_occupancy(now)
         for pool in self.store.nodepools_by_weight():
             if not remaining:
                 break
-            remaining = self._provision_pool(pool, remaining, now)
+            remaining = self._provision_pool(pool, remaining, now,
+                                             spread_occupancy)
         self.stats["unschedulable"] = len(remaining)
         PODS_UNSCHEDULABLE.set(len(remaining))
         for p in remaining:
@@ -64,9 +66,39 @@ class Provisioner:
                                     "FailedScheduling", "no nodepool could schedule")
         return self.requeue
 
+    def _cluster_occupancy(self, now: float):
+        """Cluster-wide (zone, pods) per node — every pool's claims plus
+        unmanaged nodes — for topology-spread domain counting (k8s counts
+        matching pods wherever they run, not per NodePool)."""
+        out = []
+        claim_node_names = set()
+        # one pass over all pods: nominated-but-unbound pods per claim
+        nominated: Dict[str, List[Pod]] = {}
+        for p in self.store.pods.values():
+            c = p.annotations.get(NOMINATED)
+            if c is not None and p.node_name is None:
+                nominated.setdefault(c, []).append(p)
+        for claim in self.store.nodeclaims.values():
+            if claim.node_name:
+                # claim its node even when deleting, so the drained node's
+                # pods aren't double-counted through the unmanaged loop
+                claim_node_names.add(claim.node_name)
+            if claim.is_deleting():
+                continue
+            pods = list(nominated.get(claim.name, []))
+            if claim.node_name:
+                pods.extend(self.store.pods_on_node(claim.node_name))
+            out.append((claim.zone, pods))
+        for node in self.store.nodes.values():
+            if node.name in claim_node_names:
+                continue
+            out.append((node.labels.get(L.ZONE),
+                        self.store.pods_on_node(node.name)))
+        return out
+
     # --- per-pool pass ---
-    def _provision_pool(self, pool: NodePool, pods: List[Pod],
-                        now: float) -> List[Pod]:
+    def _provision_pool(self, pool: NodePool, pods: List[Pod], now: float,
+                        spread_occupancy=None) -> List[Pod]:
         node_class = self.store.nodeclasses.get(pool.node_class) or NodeClassSpec()
         if not node_class.ready:
             return pods  # NodeClass readiness gate (cloudprovider.go:102-111)
@@ -83,7 +115,8 @@ class Provisioner:
             existing.append(view.virtual)
             existing_pods[view.claim.name] = view.pods
         out = self.solver.solve(pods, pool, node_class, existing,
-                                existing_pods=existing_pods)
+                                existing_pods=existing_pods,
+                                spread_occupancy=spread_occupancy)
         self.stats["solves"] += 1
 
         by_key = {f"{p.namespace}/{p.name}": p for p in pods}
@@ -109,7 +142,8 @@ class Provisioner:
                                   for k, v in pool.limits.items()})
             if all(v > 0 for v in headroom.values()):
                 out2 = self.solver.solve(over_limit_pods, pool, node_class,
-                                         capacity_cap=headroom)
+                                         capacity_cap=headroom,
+                                         spread_occupancy=spread_occupancy)
                 by_key2 = {f"{p.namespace}/{p.name}": p for p in over_limit_pods}
                 by_key.update(by_key2)
                 l2, over_limit_pods, usage = self._filter_by_limits(

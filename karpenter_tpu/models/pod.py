@@ -61,7 +61,22 @@ class TopologySpreadConstraint:
     topology_key: str
     max_skew: int = 1
     when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
-    label_selector: Dict[str, str] = field(default_factory=dict)
+    # Selector semantics (k8s LabelSelectorAsSelector, one deviation):
+    #   None (default) — the constraint spreads the pod's own dedupe group
+    #     (in k8s a nil selector matches nothing, making the constraint
+    #     vacuous; every real workload sets selector = its own labels, so
+    #     the None default does what those workloads mean without the
+    #     boilerplate)
+    #   {}            — matches EVERY pod in the namespace
+    #   non-empty     — matches pods whose labels contain all entries
+    label_selector: Optional[Dict[str, str]] = None
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        """Does a pod with `labels` match this constraint's selector?
+        (None → no external pods; callers handle the self-group case.)"""
+        if self.label_selector is None:
+            return False
+        return all(labels.get(k) == v for k, v in self.label_selector.items())
 
 
 @dataclass
@@ -69,6 +84,10 @@ class PodAffinityTerm:
     topology_key: str
     label_selector: Dict[str, str] = field(default_factory=dict)
     anti: bool = False  # True for podAntiAffinity
+    # False = preferredDuringSchedulingIgnoredDuringExecution: best-effort,
+    # never blocks placement (excluded from conflict matrices and per-node
+    # caps; the solver may honor it when free)
+    required: bool = True
 
 
 @dataclass
@@ -79,6 +98,11 @@ class Pod:
     node_selector: Dict[str, str] = field(default_factory=dict)
     # requiredDuringSchedulingIgnoredDuringExecution terms ({key,operator,values})
     node_affinity: List[dict] = field(default_factory=list)
+    # preferredDuringScheduling terms ({key,operator,values,weight}) — the
+    # encoder narrows the group's compatible types to each preference in
+    # descending weight order while at least one available offering
+    # survives; an unsatisfiable preference is dropped, never blocking
+    preferred_node_affinity: List[dict] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
     affinity_terms: List[PodAffinityTerm] = field(default_factory=list)
@@ -104,9 +128,10 @@ class Pod:
         return self.annotations.get(DO_NOT_DISRUPT) == "true"
 
     def has_self_anti_affinity(self) -> bool:
-        """Hostname anti-affinity against the pod's own labels (max 1/node)."""
+        """Required hostname anti-affinity against the pod's own labels
+        (max 1/node); preferred terms never block."""
         for t in self.affinity_terms:
-            if t.anti and t.topology_key == "kubernetes.io/hostname":
+            if t.anti and t.required and t.topology_key == "kubernetes.io/hostname":
                 if all(self.labels.get(k) == v for k, v in t.label_selector.items()):
                     return True
         return False
@@ -138,12 +163,19 @@ class Pod:
             tuple(sorted(self.node_selector.items())) if self.node_selector else empty,
             tuple(sorted((t["key"], t["operator"], tuple(t.get("values", ())))
                          for t in self.node_affinity)) if self.node_affinity else empty,
+            tuple(sorted((t["key"], t["operator"], tuple(t.get("values", ())),
+                          t.get("weight", 1))
+                         for t in self.preferred_node_affinity))
+            if self.preferred_node_affinity else empty,
             tuple(sorted((t.key, t.operator, t.value, t.effect)
                          for t in self.tolerations)) if self.tolerations else empty,
-            tuple(sorted((c.topology_key, c.max_skew, c.when_unsatisfiable,
-                          tuple(sorted(c.label_selector.items())))
-                         for c in self.topology_spread)) if self.topology_spread else empty,
-            tuple(sorted((t.topology_key, t.anti, tuple(sorted(t.label_selector.items())))
+            tuple(sorted(((c.topology_key, c.max_skew, c.when_unsatisfiable,
+                           None if c.label_selector is None
+                           else tuple(sorted(c.label_selector.items())))
+                          for c in self.topology_spread),
+                         key=repr)) if self.topology_spread else empty,
+            tuple(sorted((t.topology_key, t.anti, t.required,
+                          tuple(sorted(t.label_selector.items())))
                          for t in self.affinity_terms)) if self.affinity_terms else empty,
         )
         return self._sig

@@ -38,7 +38,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .encode import CatalogTensors, EncodedPods, align_resources
+from .encode import (CatalogTensors, EncodedPods, align_resources,
+                     build_conflicts)
 
 BIG = 10**9
 
@@ -58,6 +59,12 @@ class VirtualNode:
     # already hosts a matching pod can't take another across reconciles;
     # resources are accounted separately via cum.
     prior_by_group: Dict[int, int] = field(default_factory=dict)
+    # bool [G] over the CURRENT enc's groups: groups this node may not take
+    # because a resident pod's (or the group's own) required anti-affinity
+    # forbids co-location. Facade-computed from the node's actual resident
+    # pods — covers residents that map to NO current group (their labels
+    # still repel incoming pods). None = nothing banned.
+    banned_groups: Optional[np.ndarray] = None
 
     def pod_count(self) -> int:
         return sum(self.pods_by_group.values())
@@ -75,20 +82,122 @@ class SolveResult:
         return [n for n in self.nodes if n.existing_name is None]
 
 
-def split_spread_groups(enc: EncodedPods, cat: CatalogTensors) -> EncodedPods:
+def _water_fill(offsets: np.ndarray, total: int) -> np.ndarray:
+    """Distribute `total` new pods over zones with existing per-zone counts
+    `offsets` so every increment lands on a currently-least-occupied zone
+    (the k8s topology-spread admission rule: placing on a min-count domain
+    always keeps skew ≤ maxSkew). Returns per-zone additional counts.
+
+    Closed form instead of a pod-by-pod loop: find the highest water level L
+    with sum(max(0, L - offsets)) ≤ total, fill to L, then hand the
+    remainder one-per-zone to zones sitting exactly at L (ascending index —
+    deterministic)."""
+    off = np.asarray(offsets, np.int64)
+    k = len(off)
+    if k == 0 or total <= 0:
+        return np.zeros(k, np.int64)
+    lo, hi = int(off.min()), int(off.min()) + total
+    while lo < hi:  # binary search on the level
+        mid = (lo + hi + 1) // 2
+        if int(np.maximum(0, mid - off).sum()) <= total:
+            lo = mid
+        else:
+            hi = mid - 1
+    add = np.maximum(0, lo - off)
+    rem = total - int(add.sum())
+    at_level = np.flatnonzero(off + add == lo)
+    add[at_level[:rem]] += 1
+    return add
+
+
+@dataclass
+class SpreadConstraintCounts:
+    """One zone-spread constraint of a group, with prior domain occupancy.
+
+    counts: i64 [Z] — matching pods already in each zone (cluster-wide,
+    computed by the facade from live + in-flight nodes).
+    self_matches: whether the group's own pods match the constraint's
+    selector — if so, each placement increments the domain count; if not,
+    placements are checked against the (static) counts but don't move them
+    (k8s computes skew over *matching* pods only).
+    """
+
+    counts: np.ndarray
+    max_skew: int = 1
+    self_matches: bool = True
+    # ScheduleAnyway: never gates admission, only steers the zone choice
+    soft: bool = False
+
+
+def _assign_spread(zones: np.ndarray, total: int,
+                   constraints: List[SpreadConstraintCounts],
+                   ) -> Tuple[np.ndarray, int]:
+    """Per-zone additional counts honoring every hard constraint; returns
+    (adds [len(zones)], n_unassignable).
+
+    Single self-matching constraint → closed-form water-fill (placing on a
+    current-min domain always keeps skew ≤ maxSkew). Multiple constraints →
+    per-pod greedy: a zone is admissible iff every HARD constraint passes
+    the k8s rule (count_z + Δ − min ≤ maxSkew); among admissible zones the
+    choice minimizes (soft-constraint violations, max domain count, index)
+    so ScheduleAnyway constraints steer but never block — an element-wise
+    merge of the count vectors cannot express either property."""
+    if len(constraints) == 1 and constraints[0].self_matches:
+        return _water_fill(constraints[0].counts[zones],
+                           int(total)), 0
+    cnt = [c.counts[zones].astype(np.int64).copy() for c in constraints]
+    adds = np.zeros(len(zones), np.int64)
+    for _ in range(int(total)):
+        best, best_key = -1, None
+        for j in range(len(zones)):
+            ok = True
+            soft_viol = 0
+            for c, cc in zip(constraints, cnt):
+                delta = 1 if c.self_matches else 0
+                if cc[j] + delta - int(cc.min()) > c.max_skew:
+                    if c.soft:
+                        soft_viol += 1
+                    else:
+                        ok = False
+                        break
+            if not ok:
+                continue
+            key = (soft_viol,
+                   max(int(cc[j]) for cc in cnt) if cnt else 0, j)
+            if best_key is None or key < best_key:
+                best, best_key = j, key
+        if best < 0:
+            return adds, int(total) - int(adds.sum())
+        adds[best] += 1
+        for c, cc in zip(constraints, cnt):
+            if c.self_matches:
+                cc[best] += 1
+    return adds, 0
+
+
+def split_spread_groups(enc: EncodedPods, cat: CatalogTensors,
+                        spread_counts: Optional[
+                            Dict[int, List[SpreadConstraintCounts]]] = None,
+                        ) -> EncodedPods:
     """Expand zone-topology-spread groups into per-zone pinned subgroups with
     balanced counts (skew ≤ 1 ≤ maxSkew). Host-side transformation so the
     kernels never see spread constraints — only zone-pinned groups.
 
-    v1 scope: balances each group against itself (greenfield provisioning;
-    existing domain counts are handled by the provisioner passing current
-    zone occupancy as `zone_offset` in a later round).
+    spread_counts: optional per-group list of SpreadConstraintCounts
+    (computed by the facade from cluster state). Balancing water-fills
+    against these prior domain counts, so a cluster with 10 replicas in
+    zone-a sends new replicas to the other zones first — the reference core
+    scheduler seeds its topology domain counts from live nodes the same way
+    (scheduling.md topology section). Pods no admissible zone can take are
+    emitted as a zone-less subgroup (all-False allow_zone), which both
+    solver backends report unschedulable.
     """
     idx_keep = [i for i in range(enc.G) if not enc.spread_zone[i]]
     if len(idx_keep) == enc.G:
         return enc
     rows = {"requests": [], "counts": [], "compat": [], "allow_zone": [],
-            "allow_cap": [], "max_per_node": [], "spread_zone": []}
+            "allow_cap": [], "max_per_node": [], "spread_zone": [],
+            "compat_hard": []}
     groups = []
 
     def push(i, count, zone_row):
@@ -100,24 +209,43 @@ def split_spread_groups(enc: EncodedPods, cat: CatalogTensors) -> EncodedPods:
         rows["allow_cap"].append(enc.allow_cap[i])
         rows["max_per_node"].append(enc.max_per_node[i])
         rows["spread_zone"].append(False)
+        rows["compat_hard"].append(
+            enc.compat[i] if enc.compat_hard is None else enc.compat_hard[i])
 
     for i in range(enc.G):
         if not enc.spread_zone[i]:
             push(i, int(enc.counts[i]), enc.allow_zone[i])
             continue
         zones = np.flatnonzero(enc.allow_zone[i])
+        soft = enc.spread_soft is not None and bool(enc.spread_soft[i])
+        if soft:
+            # ScheduleAnyway: pin only to zones where the group actually has
+            # an available compatible offering — an infeasible zone must
+            # fall back to the others, never to unschedulable
+            feasible = np.array(
+                [(cat.available[:, z, :] & enc.compat[i][:, None]
+                  & enc.allow_cap[i][None, :]).any() for z in zones], bool)
+            zones = zones[feasible]
         if len(zones) == 0:
             push(i, int(enc.counts[i]), enc.allow_zone[i])
             continue
         total = int(enc.counts[i])
-        base, extra = divmod(total, len(zones))
+        cons = (spread_counts or {}).get(i) or [
+            SpreadConstraintCounts(counts=np.zeros(cat.Z, np.int64))]
+        adds, n_unassignable = _assign_spread(zones, total, cons)
+        if n_unassignable and soft:
+            # preference exhausted: remaining pods go wherever fits
+            push(i, n_unassignable, enc.allow_zone[i])
+            n_unassignable = 0
         for j, z in enumerate(zones):
-            cnt = base + (1 if j < extra else 0)
+            cnt = int(adds[j])
             if cnt == 0:
                 continue
             row = np.zeros(cat.Z, bool)
             row[z] = True
             push(i, cnt, row)
+        if n_unassignable:
+            push(i, n_unassignable, np.zeros(cat.Z, bool))
 
     return EncodedPods(groups=groups,
               requests=np.array(rows["requests"], np.float32).reshape(len(groups), -1),
@@ -126,7 +254,11 @@ def split_spread_groups(enc: EncodedPods, cat: CatalogTensors) -> EncodedPods:
               allow_zone=np.array(rows["allow_zone"], bool).reshape(len(groups), -1),
               allow_cap=np.array(rows["allow_cap"], bool).reshape(len(groups), -1),
               max_per_node=np.array(rows["max_per_node"], np.int32),
-              spread_zone=np.array(rows["spread_zone"], bool))
+              spread_zone=np.array(rows["spread_zone"], bool),
+              conflict=build_conflicts(groups),
+              compat_hard=(
+                  np.array(rows["compat_hard"], bool).reshape(len(groups), -1)
+                  if enc.compat_hard is not None else None))
 
 
 EPS = np.float32(1e-4)  # f32 division slack; shared with the device kernel
@@ -172,9 +304,11 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
                     cum=np.pad(n.cum, (0, max(0, R - len(n.cum)))).astype(np.float32),
                     pods_by_group={},
                     prior_by_group=dict(n.prior_by_group),
+                    banned_groups=n.banned_groups,
                     existing_name=n.existing_name)
         for n in (existing or [])]
     unschedulable: Dict[int, int] = {}
+    conflict = enc.conflict
 
     for g in range(enc.G):
         req = enc.requests[g].astype(np.float32)
@@ -186,6 +320,11 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
                 break
             t = n.type_idx
             if not enc.compat[g, t]:
+                continue
+            if n.banned_groups is not None and n.banned_groups[g]:
+                continue
+            if conflict is not None and any(
+                    conflict[g, h] for h in n.pods_by_group):
                 continue
             zmask = n.zone_mask & enc.allow_zone[g]
             cmask = n.cap_mask & enc.allow_cap[g]
@@ -263,6 +402,17 @@ def validate_solution(cat: CatalogTensors, enc: EncodedPods,
     placed_per_group: Dict[int, int] = {}
     for idx, n in enumerate(result.nodes):
         t = n.type_idx
+        gs = [g for g, c in n.pods_by_group.items() if c > 0]
+        if n.banned_groups is not None:
+            for g in gs:
+                if n.banned_groups[g]:
+                    errors.append(f"node {idx}: banned group {g} placed")
+        if enc.conflict is not None:
+            for a in range(len(gs)):
+                for b in range(a + 1, len(gs)):
+                    if enc.conflict[gs[a], gs[b]]:
+                        errors.append(
+                            f"node {idx}: conflicting groups {gs[a]},{gs[b]} colocated")
         for g, cnt in n.pods_by_group.items():
             placed_per_group[g] = placed_per_group.get(g, 0) + cnt
             if not enc.compat[g, t]:

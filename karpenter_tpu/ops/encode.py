@@ -30,7 +30,8 @@ import numpy as np
 from ..models import labels as L
 from ..models.instancetype import InstanceType
 from ..models.pod import Pod, Taint, tolerates_all
-from ..models.requirements import Requirements, ValueSet, _tolerates_absence
+from ..models.requirements import (Operator, Requirement, Requirements,
+                                   ValueSet, _tolerates_absence)
 from ..models.resources import Resources, num_resources, resource_axis
 
 ABSENT = -1
@@ -182,10 +183,58 @@ class EncodedPods:
     allow_cap: np.ndarray     # bool [G, C]
     max_per_node: np.ndarray  # i32 [G], 0 = unlimited
     spread_zone: np.ndarray   # bool [G] — zone topology-spread requested
+    # symmetric bool [G, G] (None = no cross-group anti-affinity anywhere):
+    # conflict[i, j] → groups i and j may not share a node (hostname
+    # anti-affinity whose selector matches the other group's labels).
+    # The diagonal is False — within-group exclusion is max_per_node.
+    conflict: Optional[np.ndarray] = None
+    # bool [G] (None = all-False): the zone spread flagged in spread_zone is
+    # ScheduleAnyway — split balances over feasible zones only and never
+    # produces unschedulable subgroups
+    spread_soft: Optional[np.ndarray] = None
+    # bool [G, T] (None = identical to compat): the type mask BEFORE
+    # preferred-node-affinity narrowing. Downstream narrowing (zone-split
+    # pinning, NodePool-limit caps) can invalidate a preference that looked
+    # feasible at encode time; the facade falls back to this row so a soft
+    # preference never blocks scheduling.
+    compat_hard: Optional[np.ndarray] = None
 
     @property
     def G(self) -> int:
         return len(self.groups)
+
+
+def build_conflicts(groups: List[PodGroup]) -> Optional[np.ndarray]:
+    """Symmetric cross-group hostname-anti-affinity conflicts.
+
+    k8s enforces required anti-affinity symmetrically: an incoming pod is
+    rejected from a node if an existing pod's anti-affinity selector matches
+    it, not only the other way around — so conflict[i, j] is set when
+    EITHER group's term selects the other's labels (same namespace).
+    Returns None when no group carries anti terms (the common case), which
+    lets every backend skip conflict tracking entirely."""
+    G = len(groups)
+    anti = [[t for t in g.representative.affinity_terms
+             if t.anti and t.required and t.topology_key == L.HOSTNAME]
+            for g in groups]
+    if not any(anti):
+        return None
+    conflict = np.zeros((G, G), bool)
+    for i in range(G):
+        ri = groups[i].representative
+        for j in range(i + 1, G):
+            rj = groups[j].representative
+            if ri.namespace != rj.namespace:
+                continue
+            hit = (any(all(rj.labels.get(k) == v
+                           for k, v in t.label_selector.items())
+                       for t in anti[i])
+                   or any(all(ri.labels.get(k) == v
+                              for k, v in t.label_selector.items())
+                          for t in anti[j]))
+            if hit:
+                conflict[i, j] = conflict[j, i] = True
+    return conflict if conflict.any() else None
 
 
 def _allowed_vector(vs: ValueSet, vocab: Dict[str, int]) -> np.ndarray:
@@ -275,6 +324,9 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
     max_per_node = np.zeros(G, np.int32)
     spread_zone = np.zeros(G, bool)
 
+    spread_soft = np.zeros(G, bool)
+    hard = np.ones((G, cat.T), bool)
+
     for i, g in enumerate(groups):
         reqs = g.representative.scheduling_requirements()
         if extra_requirements is not None:
@@ -282,11 +334,20 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
         compat[i] = compat_mask(reqs, cat)
         allow_zone[i] = _axis_allow(reqs, L.ZONE, cat.zones)
         allow_cap[i] = _axis_allow(reqs, L.CAPACITY_TYPE, cat.captypes)
+        hard[i] = compat[i]
+        narrowed = _apply_preferred(g.representative, compat[i],
+                                    allow_zone[i], allow_cap[i],
+                                    requests[i], cat)
+        if narrowed is not None:
+            compat[i] = narrowed
         if g.representative.has_self_anti_affinity():
             max_per_node[i] = 1
+        any_hard_zone = False
         for tsc in g.representative.topology_spread:
-            if tsc.topology_key == L.ZONE and tsc.when_unsatisfiable == "DoNotSchedule":
+            if tsc.topology_key == L.ZONE:
                 spread_zone[i] = True
+                if tsc.when_unsatisfiable == "DoNotSchedule":
+                    any_hard_zone = True
             if tsc.topology_key == L.HOSTNAME and tsc.when_unsatisfiable == "DoNotSchedule":
                 # Conservative encoding of hostname maxSkew as a per-node
                 # cap: while any eligible node has zero matching pods (always
@@ -297,10 +358,41 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
                 # never violates the constraint.
                 cap = max(1, tsc.max_skew)
                 max_per_node[i] = cap if max_per_node[i] == 0 else min(max_per_node[i], cap)
+        spread_soft[i] = spread_zone[i] and not any_hard_zone
 
     return EncodedPods(groups=groups, requests=requests, counts=counts,
                        compat=compat, allow_zone=allow_zone, allow_cap=allow_cap,
-                       max_per_node=max_per_node, spread_zone=spread_zone)
+                       max_per_node=max_per_node, spread_zone=spread_zone,
+                       conflict=build_conflicts(groups), spread_soft=spread_soft,
+                       compat_hard=hard if (hard != compat).any() else None)
+
+
+def _apply_preferred(rep: Pod, compat_row: np.ndarray, zone_row: np.ndarray,
+                     cap_row: np.ndarray, req: np.ndarray,
+                     cat: CatalogTensors) -> Optional[np.ndarray]:
+    """Narrow a group's type mask to its preferred node-affinity terms,
+    greedily in descending weight, keeping each narrowing only while ≥1
+    available offering that FITS the pod survives — 'prefer, never block'.
+    (k8s scores preferences per node; against a catalog the analogue is
+    restricting the candidate types when the restriction is satisfiable.)
+    Returns the narrowed row, or None if no preference applied."""
+    if not rep.preferred_node_affinity:
+        return None
+    fits = (align_resources(cat.allocatable, len(req))
+            >= req[None, :] - 1e-6).all(axis=1)
+    cur = compat_row
+    terms = sorted(rep.preferred_node_affinity,
+                   key=lambda t: -t.get("weight", 1))
+    for term in terms:
+        r = Requirements()
+        r.add(Requirement(term["key"], Operator(term["operator"]),
+                          tuple(term.get("values", ()))))
+        cand = cur & compat_mask(r, cat)
+        feasible = (cat.available & (cand & fits)[:, None, None]
+                    & zone_row[None, :, None] & cap_row[None, None, :]).any()
+        if feasible:
+            cur = cand
+    return cur
 
 
 def align_resources(alloc: np.ndarray, R: int) -> np.ndarray:

@@ -27,8 +27,8 @@ from ..models.nodepool import NodeClassSpec, NodePool
 from ..models.pod import Pod
 from ..models.requirements import Requirements
 from ..models.resources import Resources
-from .binpack import (SolveResult, VirtualNode, solve_host,
-                      split_spread_groups, validate_solution)
+from .binpack import (SolveResult, SpreadConstraintCounts, VirtualNode,
+                      solve_host, split_spread_groups, validate_solution)
 from .encode import (CatalogTensors, EncodedPods, align_resources,
                      encode_catalog, encode_pods)
 
@@ -98,7 +98,9 @@ class Solver:
               node_class: Optional[NodeClassSpec] = None,
               existing: Optional[List[VirtualNode]] = None,
               capacity_cap: Optional[Resources] = None,
-              existing_pods: Optional[Dict[str, List[Pod]]] = None) -> SolveOutput:
+              existing_pods: Optional[Dict[str, List[Pod]]] = None,
+              spread_occupancy: Optional[
+                  List[Tuple[Optional[str], List[Pod]]]] = None) -> SolveOutput:
         """capacity_cap: only open nodes whose total capacity fits within it
         (the NodePool-limits headroom; the reference scheduler stops opening
         virtual nodes that would breach spec.limits the same way).
@@ -106,7 +108,13 @@ class Solver:
         existing_pods: pods already on each existing node (by existing_name)
         — matched by constraint signature into the current groups so
         per-node caps (anti-affinity/hostname-spread) hold across
-        reconciles, not just within one solve."""
+        reconciles, not just within one solve.
+
+        spread_occupancy: cluster-wide (zone, pods) per node — ALL nodes
+        including other pools' and unmanaged ones — used to seed topology-
+        spread domain counts. Defaults to deriving from `existing` (this
+        solve's nodes only), which under-counts in multi-pool clusters;
+        the provisioner passes the full view."""
         cat = self.tensors(node_class)
         if cat.T == 0 or not pods:
             return SolveOutput([], {}, [_pod_key(p) for p in pods])
@@ -120,12 +128,18 @@ class Solver:
                      for k, v in capacity_cap.items())
                  for t in types], bool)
             enc.compat &= fits_cap[None, :]
+            if enc.compat_hard is not None:
+                enc.compat_hard = enc.compat_hard & fits_cap[None, :]
         # pods dropped by the taint filter are unschedulable for this pool
         enc_keys = {_pod_key(p) for g in enc.groups for p in g.pods}
         dropped = [_pod_key(p) for p in pods if _pod_key(p) not in enc_keys]
-        enc = split_spread_groups(enc, cat)
+        occupancy = (spread_occupancy if spread_occupancy is not None
+                     else self._occupancy_from_existing(existing, existing_pods, cat))
+        enc = split_spread_groups(
+            enc, cat, self._spread_constraints(enc, cat, occupancy))
         if enc.G == 0:
             return SolveOutput([], {}, dropped)
+        self._relax_infeasible_preferences(enc, cat)
 
         if existing and existing_pods:
             sig_to_groups: Dict[tuple, List[int]] = {}
@@ -138,6 +152,7 @@ class Solver:
                     for gi in sig_to_groups.get(p.constraint_signature(), []):
                         counts[gi] = counts.get(gi, 0) + 1
                 vn.prior_by_group = counts
+            self._apply_resident_bans(enc, existing, existing_pods)
 
         import time as _time
 
@@ -164,6 +179,148 @@ class Solver:
         SOLVE_PODS.observe(float(enc.counts.sum()))
 
         return self._decode(cat, enc, result, nodepool, dropped)
+
+    @staticmethod
+    def _spread_constraints(enc: EncodedPods, cat: CatalogTensors,
+                            occupancy: List[Tuple[Optional[str], List[Pod]]],
+                            ) -> Optional[Dict[int, List[SpreadConstraintCounts]]]:
+        """Per-group zone-spread constraints seeded with cluster-wide domain
+        occupancy. `occupancy` is (zone, pods) per live/in-flight node —
+        ALL nodes, not just this pool's, since k8s counts matching pods
+        wherever they run; a node whose zone is still deferred (None)
+        contributes to no domain yet.
+
+        Selector semantics follow TopologySpreadConstraint.label_selector:
+        None spreads the group against itself only (zero prior counts
+        unless its own labels are visible in `occupancy` — they are not,
+        by definition of None matching no external pods); {} counts every
+        pod in the namespace; non-empty counts label matches. Matching is
+        memoized per (namespace, selector) — one pass over the cluster's
+        pods regardless of how many groups share a selector."""
+        if not enc.spread_zone.any():
+            return None
+        # bucket the cluster's pods by zone once
+        pods_by_zone: List[Tuple[int, List[Pod]]] = []
+        for zone, pods_on in occupancy:
+            zi = cat.zones.index(zone) if zone in cat.zones else -1
+            if zi >= 0 and pods_on:
+                pods_by_zone.append((zi, pods_on))
+        memo: Dict[tuple, np.ndarray] = {}
+
+        def counts_for(namespace: str, selector: Optional[Dict[str, str]],
+                       ) -> np.ndarray:
+            if selector is None:
+                return np.zeros(cat.Z, np.int64)
+            key = (namespace, tuple(sorted(selector.items())))
+            hit = memo.get(key)
+            if hit is None:
+                hit = np.zeros(cat.Z, np.int64)
+                for zi, pods_on in pods_by_zone:
+                    for p in pods_on:
+                        if p.namespace == namespace and all(
+                                p.labels.get(k) == v for k, v in selector.items()):
+                            hit[zi] += 1
+                memo[key] = hit
+            return hit
+
+        out: Dict[int, List[SpreadConstraintCounts]] = {}
+        for i, grp in enumerate(enc.groups):
+            if not enc.spread_zone[i]:
+                continue
+            rep = grp.representative
+            cons = []
+            for tsc in rep.topology_spread:
+                if tsc.topology_key != L.ZONE:
+                    continue
+                # ScheduleAnyway constraints also seed domain counts — they
+                # steer balancing; the split's soft path guarantees they
+                # never block
+                cons.append(SpreadConstraintCounts(
+                    counts=counts_for(rep.namespace, tsc.label_selector),
+                    max_skew=max(1, tsc.max_skew),
+                    self_matches=(tsc.label_selector is None
+                                  or tsc.matches(rep.labels)),
+                    soft=tsc.when_unsatisfiable != "DoNotSchedule"))
+            if cons:
+                out[i] = cons
+        return out or None
+
+    @staticmethod
+    def _relax_infeasible_preferences(enc: EncodedPods,
+                                      cat: CatalogTensors) -> None:
+        """Preferred node affinity must never block: after zone-split
+        pinning and NodePool-limit caps have further narrowed the problem,
+        any group whose preference-narrowed type mask no longer reaches an
+        available, fitting offering falls back to its hard mask (the pre-
+        preference row). k8s drops unsatisfiable preferences the same way —
+        they only score, never filter."""
+        if enc.compat_hard is None:
+            return
+        alloc = align_resources(cat.allocatable, enc.requests.shape[1])
+        for i in range(enc.G):
+            if (enc.compat[i] == enc.compat_hard[i]).all():
+                continue
+            fits = (alloc >= enc.requests[i][None, :] - 1e-6).all(axis=1)
+            ok = (cat.available
+                  & (enc.compat[i] & fits)[:, None, None]
+                  & enc.allow_zone[i][None, :, None]
+                  & enc.allow_cap[i][None, None, :]).any()
+            if not ok:
+                enc.compat[i] = enc.compat_hard[i]
+
+    @staticmethod
+    def _apply_resident_bans(enc: EncodedPods,
+                             existing: List[VirtualNode],
+                             existing_pods: Dict[str, List[Pod]]) -> None:
+        """Set VirtualNode.banned_groups from actual resident pods: node n
+        may not take group g if a resident's required hostname anti-affinity
+        selects g's labels, or g's own term selects a resident's labels —
+        k8s enforces both directions. Residents that map to NO current
+        group (prior_by_group can't see them) still repel this way."""
+        hostname_anti = [
+            [t for t in grp.representative.affinity_terms
+             if t.anti and t.required and t.topology_key == L.HOSTNAME]
+            for grp in enc.groups]
+        any_group_anti = any(hostname_anti)
+        for vn in existing:
+            vn.banned_groups = None  # never carry stale bans across encodings
+            residents = existing_pods.get(vn.existing_name or "", [])
+            res_anti = [(p, [t for t in p.affinity_terms
+                             if t.anti and t.required
+                             and t.topology_key == L.HOSTNAME])
+                        for p in residents]
+            if not any_group_anti and not any(ts for _, ts in res_anti):
+                continue
+            banned = np.zeros(enc.G, bool)
+            for gi, grp in enumerate(enc.groups):
+                rep = grp.representative
+                for p, p_terms in res_anti:
+                    if p.namespace != rep.namespace:
+                        continue
+                    if any(all(p.labels.get(k) == v
+                               for k, v in t.label_selector.items())
+                           for t in hostname_anti[gi]) or \
+                       any(all(rep.labels.get(k) == v
+                               for k, v in t.label_selector.items())
+                           for t in p_terms):
+                        banned[gi] = True
+                        break
+            if banned.any():
+                vn.banned_groups = banned
+
+    @staticmethod
+    def _occupancy_from_existing(existing: Optional[List[VirtualNode]],
+                                 existing_pods: Optional[Dict[str, List[Pod]]],
+                                 cat: CatalogTensors,
+                                 ) -> List[Tuple[Optional[str], List[Pod]]]:
+        """Fallback occupancy when the caller didn't supply a cluster-wide
+        view: derive (zone, pods) from the solve's own existing nodes."""
+        out: List[Tuple[Optional[str], List[Pod]]] = []
+        for vn in existing or []:
+            zs = np.flatnonzero(vn.zone_mask)
+            zone = cat.zones[int(zs[0])] if len(zs) == 1 else None
+            out.append((zone, (existing_pods or {}).get(vn.existing_name or "", [])))
+        return out
 
     # --- result mapping ---
     def _decode(self, cat: CatalogTensors, enc: EncodedPods,

@@ -43,8 +43,10 @@ def _load() -> Optional[ctypes.CDLL]:
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
         lib.ffd_solve.restype = ctypes.c_int32
+        u8p_or_null = ctypes.c_void_p  # nullable uint8* (banned / conflict)
         lib.ffd_solve.argtypes = [
             f32p, f32p, u8p, f32p, i32p, u8p, u8p, u8p, i32p, i32p,
+            u8p_or_null, u8p_or_null,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             i32p, f32p, u8p, u8p, i32p, i32p,
@@ -101,11 +103,24 @@ def solve_native(cat: CatalogTensors, enc: EncodedPods,
             if g < G:
                 prior[g, i] = cnt
 
+    banned = None
+    if any(n.banned_groups is not None for n in existing):
+        banned = np.zeros((G, n_max), np.uint8)
+        for i, n in enumerate(existing):
+            if n.banned_groups is not None:
+                banned[: len(n.banned_groups), i] = n.banned_groups
+    conflict = (np.ascontiguousarray(enc.conflict, np.uint8)
+                if enc.conflict is not None else None)
+
+    def _ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+
     takes = np.zeros((G, n_max), np.int32)
     unsched = np.zeros(G, np.int32)
     n_used = ctypes.c_int64(0)
     lib.ffd_solve(alloc, price, avail, requests, counts, compat, allow_zone,
                   allow_cap, mpn, np.ascontiguousarray(prior),
+                  _ptr(banned), _ptr(conflict),
                   G, T, Z, C, R, n_max, Ne,
                   node_type, node_cum, node_zmask, node_cmask,
                   takes, unsched, ctypes.byref(n_used))
@@ -118,6 +133,7 @@ def solve_native(cat: CatalogTensors, enc: EncodedPods,
             zone_mask=node_zmask[i].astype(bool),
             cap_mask=node_cmask[i].astype(bool),
             cum=node_cum[i].copy(), pods_by_group=pods,
+            banned_groups=existing[i].banned_groups if i < Ne else None,
             existing_name=existing[i].existing_name if i < Ne else None))
     result = SolveResult(
         nodes=nodes,

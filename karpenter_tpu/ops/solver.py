@@ -59,20 +59,31 @@ def device_catalog(cat: CatalogTensors, R: int) -> DeviceCatalog:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("n_max",))
+@partial(jax.jit, static_argnames=("n_max", "track_conflicts"))
 def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
-                  allow_cap, max_per_node, prior_counts, node_type, node_cum,
-                  node_zmask, node_cmask, node_open, n_used, n_max: int):
+                  allow_cap, max_per_node, prior_counts, banned, conflict,
+                  node_type, node_cum, node_zmask, node_cmask, node_open,
+                  n_used, n_max: int, track_conflicts: bool = False):
     """scan over G groups; returns final node state + per-(g,n) take matrix
-    + per-group unschedulable counts."""
+    + per-group unschedulable counts.
+
+    banned: bool [G, N] — node n may not take group g (facade-computed
+    resident-pod anti-affinity; see VirtualNode.banned_groups).
+    conflict + track_conflicts: cross-group anti-affinity. When the static
+    flag is False (no group has anti terms — the common case) the per-step
+    [N, G] hosted bookkeeping is compiled out entirely; conflict is then a
+    [G, 1] dummy."""
 
     T, Z, C = price.shape
     R = alloc.shape[1]
+    Gp = requests.shape[0]
     node_ids = jnp.arange(n_max)
+    group_ids = jnp.arange(Gp)
 
     def step(state, ginput):
-        ntype, cum, zmask, cmask, nopen, nused = state
-        req, count, gcompat, gzone, gcap, cap_per, prior_n = ginput
+        ntype, cum, zmask, cmask, nopen, nused, hosted = state
+        (req, count, gcompat, gzone, gcap, cap_per, prior_n, banned_n,
+         conf_g, gi) = ginput
         count = count.astype(jnp.int32)
         cap_per = jnp.where(cap_per == 0, BIG, cap_per).astype(jnp.int32)
 
@@ -90,7 +101,9 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
         cmask2 = cmask & gcap[None, :]                  # [N, C]
         off_ok = jnp.einsum("nz,nc,nzc->n", zmask2, cmask2,
                             avail[ntype], preferred_element_type=jnp.float32) > 0
-        eligible = nopen & gcompat[ntype] & off_ok
+        eligible = nopen & gcompat[ntype] & off_ok & ~banned_n
+        if track_conflicts:
+            eligible &= ~(hosted & conf_g[None, :]).any(axis=1)
         # per-node cap accounts prior occupancy of this group (anti-affinity
         # across reconciles). k is clamped to count BEFORE the prefix sum:
         # k_cap can be BIG (zero-request pods) and an int32 cumsum over the
@@ -151,20 +164,26 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
 
         unsched = jnp.where(schedulable, overflow, rem)
         g_take = take + new_take
-        return (ntype, cum, zmask, cmask, nopen, nused), (g_take, unsched, clamped)
+        if track_conflicts:
+            hosted = hosted | ((g_take > 0)[:, None] & (group_ids == gi)[None, :])
+        return (ntype, cum, zmask, cmask, nopen, nused, hosted), (
+            g_take, unsched, clamped)
 
-    init = (node_type, node_cum, node_zmask, node_cmask, node_open, n_used)
-    (ntype, cum, zmask, cmask, nopen, nused), (takes, unsched, clamped) = lax.scan(
+    hosted0 = jnp.zeros((n_max, Gp if track_conflicts else 1), bool)
+    init = (node_type, node_cum, node_zmask, node_cmask, node_open, n_used,
+            hosted0)
+    (ntype, cum, zmask, cmask, nopen, nused, _), (takes, unsched, clamped) = lax.scan(
         step, init, (requests, counts, compat, allow_zone, allow_cap,
-                     max_per_node, prior_counts))
+                     max_per_node, prior_counts, banned, conflict, group_ids))
     return ntype, cum, zmask, cmask, nopen, nused, takes, unsched, clamped.any()
 
 
-@partial(jax.jit, static_argnames=("n_max", "k_max"))
+@partial(jax.jit, static_argnames=("n_max", "k_max", "track_conflicts"))
 def _solve_kernel_packed(alloc, price, avail, requests, counts, compat,
                          allow_zone, allow_cap, max_per_node, prior_counts,
-                         node_type, node_cum, node_zmask, node_cmask,
-                         node_open, n_used, n_max: int, k_max: int):
+                         banned, conflict, node_type, node_cum, node_zmask,
+                         node_cmask, node_open, n_used, n_max: int,
+                         k_max: int, track_conflicts: bool = False):
     """Kernel + single-buffer output packing.
 
     The deployment TPU sits behind a network tunnel where every host read
@@ -182,8 +201,9 @@ def _solve_kernel_packed(alloc, price, avail, requests, counts, compat,
     """
     out = _solve_kernel(alloc, price, avail, requests, counts, compat,
                         allow_zone, allow_cap, max_per_node, prior_counts,
-                        node_type, node_cum, node_zmask, node_cmask,
-                        node_open, n_used, n_max=n_max)
+                        banned, conflict, node_type, node_cum, node_zmask,
+                        node_cmask, node_open, n_used, n_max=n_max,
+                        track_conflicts=track_conflicts)
     ntype, _cum, _zm, _cm, _no, nused, takes, unsched, overflow = out
     flat = takes.reshape(-1)
     nnz = jnp.sum(flat > 0)
@@ -256,22 +276,30 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
         node_cmask[i] = n.cap_mask
         node_open[i] = True
 
+    track = enc.conflict is not None
+    conflict = (_pad_to(_pad_to(enc.conflict, Gp, 0), Gp, 1) if track
+                else np.zeros((Gp, 1), bool))
     k_max = 4 * n_max + Gp  # sparse-take budget; regrown on nnz overflow
     while True:
         prior = np.zeros((Gp, n_max), np.int32)
+        banned = np.zeros((Gp, n_max), bool)
         for i, n in enumerate(existing):
             for g, cnt in n.prior_by_group.items():
                 if g < Gp:
                     prior[g, i] = cnt
+            if n.banned_groups is not None:
+                banned[: len(n.banned_groups), i] = n.banned_groups
         packed = _solve_kernel_packed(
             dcat.alloc, dcat.price, dcat.avail, requests, counts,
             compat, allow_zone, allow_cap, max_per_node, jnp.asarray(prior),
+            jnp.asarray(banned), jnp.asarray(conflict),
             jnp.asarray(_pad_to(node_type, n_max)),
             jnp.asarray(_pad_to(node_cum, n_max)),
             jnp.asarray(_pad_to(node_zmask, n_max)),
             jnp.asarray(_pad_to(node_cmask, n_max)),
             jnp.asarray(_pad_to(node_open, n_max)),
-            jnp.asarray(n_existing, jnp.int32), n_max=n_max, k_max=k_max)
+            jnp.asarray(n_existing, jnp.int32), n_max=n_max, k_max=k_max,
+            track_conflicts=track)
         buf = np.asarray(packed)  # ONE host read
         nused, overflowed, nnz = int(buf[0]), bool(buf[1]), int(buf[2])
         o = 3
@@ -334,6 +362,7 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
         nodes.append(VirtualNode(
             type_idx=int(nt[i]), zone_mask=zmask[i], cap_mask=cmask[i],
             cum=cum[i], pods_by_group=pods_by_node[i],
+            banned_groups=existing[i].banned_groups if i < n_existing else None,
             existing_name=existing[i].existing_name if i < n_existing else None))
 
     unschedulable = {g: int(unsched[g]) for g in range(G) if unsched[g] > 0}

@@ -53,6 +53,8 @@ def sharded_solve_fn(mesh: Mesh, n_max: int):
             rep, rep, rep,            # alloc, price, avail (catalog, replicated)
             rep, rep, rep, rep, rep, rep,  # group inputs (scan carrier)
             prior,                    # prior_counts [G, N]
+            prior,                    # banned [G, N]
+            rep,                      # conflict [G, G] (replicated like groups)
             nodes,                    # node_type
             nodes,                    # node_cum
             nodes,                    # node_zmask
@@ -77,6 +79,7 @@ def run_sharded_solve(mesh: Mesh, alloc, price, avail, requests, counts,
              jnp.asarray(allow_zone), jnp.asarray(allow_cap),
              jnp.asarray(max_per_node),
              jnp.zeros((Gp, n_max), jnp.int32),
+             jnp.zeros((Gp, n_max), bool), jnp.zeros((Gp, 1), bool),
              jnp.zeros(n_max, jnp.int32), jnp.zeros((n_max, R), jnp.float32),
              jnp.zeros((n_max, Z), bool), jnp.zeros((n_max, C), bool),
              jnp.zeros(n_max, bool), jnp.asarray(n_existing, jnp.int32))

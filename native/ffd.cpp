@@ -72,6 +72,8 @@ int32_t ffd_solve(
     const uint8_t* allow_cap,  // [G * C]
     const int32_t* max_per_node,  // [G]
     const int32_t* prior_counts,  // [G * Nmax] (may be null)
+    const uint8_t* banned,        // [G * Nmax] resident-pod anti-affinity (may be null)
+    const uint8_t* conflict,      // [G * G] cross-group anti-affinity (may be null)
     int64_t G, int64_t T, int64_t Z, int64_t C, int64_t R,
     int64_t Nmax, int64_t Ne,
     int32_t* node_type,        // [Nmax] in/out
@@ -87,6 +89,10 @@ int32_t ffd_solve(
   std::memset(unsched, 0, sizeof(int32_t) * G);
 
   std::vector<int64_t> slots_t(T);
+  // hosted[n * G + g]: node n took pods of group g in THIS solve (for
+  // cross-group anti-affinity; residents enter via `banned`)
+  std::vector<uint8_t> hosted;
+  if (conflict) hosted.assign(Nmax * G, 0);
 
   for (int64_t g = 0; g < G; ++g) {
     const float* req = requests + g * R;
@@ -98,6 +104,15 @@ int32_t ffd_solve(
     for (int64_t n = 0; n < used && rem > 0; ++n) {
       int32_t t = node_type[n];
       if (!compat[g * T + t]) continue;
+      if (banned && banned[g * Nmax + n]) continue;
+      if (conflict) {
+        bool conf = false;
+        const uint8_t* host_n = hosted.data() + n * G;
+        const uint8_t* conf_g = conflict + g * G;
+        for (int64_t h = 0; h < G && !conf; ++h)
+          conf = host_n[h] && conf_g[h];
+        if (conf) continue;
+      }
       // zone/captype mask intersection must keep >=1 available offering
       bool off_ok = false;
       for (int64_t z = 0; z < Z && !off_ok; ++z) {
@@ -125,6 +140,7 @@ int32_t ffd_solve(
       for (int64_t c = 0; c < C; ++c)
         node_cmask[n * C + c] &= allow_cap[g * C + c];
       takes[g * Nmax + n] += static_cast<int32_t>(take);
+      if (conflict) hosted[n * G + g] = 1;
       rem -= take;
     }
     if (rem == 0) continue;
@@ -191,6 +207,7 @@ int32_t ffd_solve(
         node_cmask[n * C + c] = allow_cap[g * C + c] && ac;
       }
       takes[g * Nmax + n] = static_cast<int32_t>(take);
+      if (conflict) hosted[n * G + g] = 1;
       rem -= take;
     }
   }

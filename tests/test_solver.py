@@ -301,3 +301,414 @@ class TestNativeBackend:
         n = solve_native(cat, enc)
         assert len(h.nodes) == len(n.nodes)
         assert h.launches == n.launches
+
+
+class TestSpreadOccupancy:
+    """Topology spread balanced against pre-existing domain occupancy."""
+
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog())
+
+    def test_water_fill_levels(self):
+        from karpenter_tpu.ops.binpack import _water_fill
+        assert _water_fill(np.array([0, 0, 0]), 9).tolist() == [3, 3, 3]
+        assert _water_fill(np.array([5, 0, 0]), 4).tolist() == [0, 2, 2]
+        assert _water_fill(np.array([5, 0, 0]), 12).tolist() == [1, 6, 5]
+        # remainder lands on the lowest-index zones at the water level
+        assert _water_fill(np.array([2, 2, 2]), 2).tolist() == [1, 1, 0]
+        # tiny totals never violate: each pod goes to a min zone
+        assert _water_fill(np.array([10, 0]), 1).tolist() == [0, 1]
+        assert _water_fill(np.array([3]), 5).tolist() == [5]
+        assert _water_fill(np.zeros(0, np.int64), 5).tolist() == []
+
+    def _spread_pods(self, n, sel=None, extra_tsc=(), labels=None):
+        return mk_pods(n, "250m", "512Mi", "sp",
+                       labels=labels or {"app": "web"},
+                       topology_spread=[TopologySpreadConstraint(
+                           topology_key=L.ZONE, max_skew=1,
+                           label_selector=sel)] + list(extra_tsc))
+
+    def _occupied(self, zone_idx, pods):
+        za = np.zeros(self.cat.Z, bool); za[zone_idx] = True
+        vn = VirtualNode(type_idx=0, zone_mask=za,
+                         cap_mask=np.ones(self.cat.C, bool),
+                         cum=np.zeros(len(self.cat.resources), np.float32),
+                         existing_name="n1")
+        return vn, {"n1": pods}
+
+    def test_split_with_counts_avoids_occupied_zone(self):
+        from karpenter_tpu.ops.binpack import SpreadConstraintCounts
+        pods = self._spread_pods(4, {"app": "web"})
+        enc = encode_pods(pods, self.cat)
+        counts = np.zeros(self.cat.Z, np.int64); counts[0] = 4
+        enc2 = split_spread_groups(enc, self.cat, {0: [
+            SpreadConstraintCounts(counts=counts)]})
+        # all 4 new pods go to zones b and c
+        for i in range(enc2.G):
+            z = np.flatnonzero(enc2.allow_zone[i])
+            assert z.tolist() != [0]
+        assert sorted(enc2.counts.tolist()) == [2, 2]
+        h, _ = assert_agree(self.cat, enc2)
+        assert not h.unschedulable
+
+    def test_facade_counts_from_occupancy(self):
+        from karpenter_tpu.ops.facade import Solver
+        pods = self._spread_pods(2, {"app": "web"})
+        enc = encode_pods(pods, self.cat)
+        on_node = [Pod(name=f"e{i}", labels={"app": "web"},
+                       requests=Resources.parse({"cpu": "250m"}))
+                   for i in range(3)]
+        cons = Solver._spread_constraints(
+            enc, self.cat, [("zone-a", on_node)])
+        assert cons is not None and cons[0][0].counts[0] == 3
+        assert cons[0][0].counts[1:].sum() == 0
+        enc2 = split_spread_groups(enc, self.cat, cons)
+        for i in range(enc2.G):
+            assert not enc2.allow_zone[i][0]  # zone-a skipped
+
+    def test_nil_selector_self_spreads_ignoring_cluster(self):
+        from karpenter_tpu.ops.facade import Solver
+        pods = self._spread_pods(3, None)
+        enc = encode_pods(pods, self.cat)
+        on_node = [Pod(name="e0", labels={"app": "web"},
+                       requests=Resources.parse({"cpu": "250m"}))]
+        cons = Solver._spread_constraints(enc, self.cat, [("zone-a", on_node)])
+        assert cons is not None and cons[0][0].counts.sum() == 0
+        assert cons[0][0].self_matches
+        enc2 = split_spread_groups(enc, self.cat, cons)
+        assert sorted(enc2.counts.tolist()) == [1, 1, 1]
+
+    def test_empty_selector_counts_whole_namespace(self):
+        from karpenter_tpu.ops.facade import Solver
+        pods = self._spread_pods(2, {})
+        enc = encode_pods(pods, self.cat)
+        on_node = [Pod(name="e0", labels={"anything": "else"},
+                       requests=Resources.parse({"cpu": "250m"}))]
+        cons = Solver._spread_constraints(enc, self.cat, [("zone-b", on_node)])
+        assert cons is not None and cons[0][0].counts[1] == 1
+
+    def test_deferred_zone_node_contributes_nothing(self):
+        from karpenter_tpu.ops.facade import Solver
+        pods = self._spread_pods(3, {"app": "web"})
+        enc = encode_pods(pods, self.cat)
+        on_node = [Pod(name="e0", labels={"app": "web"},
+                       requests=Resources.parse({"cpu": "250m"}))]
+        cons = Solver._spread_constraints(enc, self.cat, [(None, on_node)])
+        assert cons is not None and cons[0][0].counts.sum() == 0
+
+    def test_multi_constraint_per_constraint_admission(self):
+        # two selectors with opposing occupancy: a max-merge would claim
+        # both zones balanced; per-constraint admission must run greedily
+        from karpenter_tpu.ops.binpack import (SpreadConstraintCounts,
+                                               _assign_spread)
+        zones = np.array([0, 1])
+        c1 = SpreadConstraintCounts(np.array([10, 10, 0]), 1, True)
+        c2 = SpreadConstraintCounts(np.array([0, 0, 0]), 1, True)
+        adds, bad = _assign_spread(zones, 2, [c1, c2])
+        assert adds.tolist() == [1, 1] and bad == 0
+        # infeasible: c1 allows only zone 1 (counts [2,0]+skew1) while c2
+        # allows only zone 0 — nothing admits both
+        c1 = SpreadConstraintCounts(np.array([2, 0, 0]), 1, True)
+        c2 = SpreadConstraintCounts(np.array([0, 2, 0]), 1, True)
+        adds, bad = _assign_spread(zones, 3, [c1, c2])
+        assert adds.sum() == 0 and bad == 3
+
+    def test_unassignable_pods_reported_unschedulable(self):
+        from karpenter_tpu.models import labels as LL
+        other = TopologySpreadConstraint(topology_key=LL.ZONE, max_skew=1,
+                                         label_selector={"other": "x"})
+        pods = self._spread_pods(2, {"app": "web"}, extra_tsc=[other])
+        enc = encode_pods(pods, self.cat)
+        from karpenter_tpu.ops.binpack import SpreadConstraintCounts
+        # conflicting constraints: no zone admissible
+        cons = {0: [SpreadConstraintCounts(np.array([5, 0, 0]), 1, True),
+                    SpreadConstraintCounts(np.array([0, 5, 5]), 1, False)]}
+        enc2 = split_spread_groups(enc, self.cat, cons)
+        h, d = assert_agree(self.cat, enc2)
+        assert sum(h.unschedulable.values()) == 2
+
+    def test_non_self_matching_constraint_static_counts(self):
+        from karpenter_tpu.ops.binpack import (SpreadConstraintCounts,
+                                               _assign_spread)
+        # constraint whose selector does not match the group: counts stay
+        # static, so many pods can land in any zone within skew of the
+        # static minimum
+        c = SpreadConstraintCounts(np.array([1, 0, 0]), 1, False)
+        adds, bad = _assign_spread(np.array([0, 1, 2]), 6, [c])
+        assert bad == 0 and adds.sum() == 6
+
+
+class TestCrossGroupAntiAffinity:
+    """Selector-based anti-affinity between distinct pod groups —
+    k8s enforces required anti-affinity symmetrically, so neither side of a
+    matching (term, labels) pair may colocate with the other."""
+
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog())
+
+    def _anti(self, sel):
+        return [PodAffinityTerm(topology_key="kubernetes.io/hostname",
+                                label_selector=sel, anti=True)]
+
+    def test_conflict_matrix(self):
+        from karpenter_tpu.ops.encode import build_conflicts
+        # db pods repel web pods; sizes differ so they form distinct groups
+        pods = (mk_pods(2, "1", "2Gi", "db", labels={"tier": "db"},
+                        affinity_terms=self._anti({"tier": "web"})) +
+                mk_pods(3, "500m", "1Gi", "web", labels={"tier": "web"}))
+        enc = encode_pods(pods, self.cat)
+        assert enc.conflict is not None
+        gi = {enc.groups[i].representative.labels.get("tier"): i
+              for i in range(enc.G)}
+        assert enc.conflict[gi["db"], gi["web"]]
+        assert enc.conflict[gi["web"], gi["db"]]  # symmetric
+        assert not enc.conflict.diagonal().any()
+
+    def test_no_anti_terms_no_matrix(self):
+        enc = encode_pods(mk_pods(5), self.cat)
+        assert enc.conflict is None
+
+    def test_conflicting_groups_never_colocate_all_backends(self):
+        pods = (mk_pods(4, "1", "2Gi", "db", labels={"tier": "db"},
+                        affinity_terms=self._anti({"tier": "web"})) +
+                mk_pods(6, "500m", "1Gi", "web", labels={"tier": "web"}))
+        enc = encode_pods(pods, self.cat)
+        h, d = assert_agree(self.cat, enc)
+        from karpenter_tpu.ops.native import solve_native
+        n = solve_native(self.cat, enc)
+        assert not validate_solution(self.cat, enc, n)
+        for result in (h, d, n):
+            assert not result.unschedulable
+            tiers_by_node = []
+            for node in result.nodes:
+                tiers = {enc.groups[g].representative.labels["tier"]
+                         for g, c in node.pods_by_group.items() if c}
+                tiers_by_node.append(tiers)
+                assert tiers != {"db", "web"}
+            assert {"db"} in tiers_by_node and {"web"} in tiers_by_node
+
+    def test_namespace_scoping(self):
+        pods = (mk_pods(2, "1", "2Gi", "db", labels={"tier": "db"},
+                        affinity_terms=self._anti({"tier": "web"})) +
+                [Pod(name=f"w{i}", namespace="other",
+                     labels={"tier": "web"},
+                     requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}))
+                 for i in range(3)])
+        enc = encode_pods(pods, self.cat)
+        assert enc.conflict is None  # different namespaces don't repel
+
+    def test_resident_pods_repel_new_groups(self):
+        # existing node hosts a pod with anti-affinity against app=x; new
+        # app=x pods must avoid that node even though the resident maps to
+        # no current group
+        from karpenter_tpu.ops.facade import Solver
+        from karpenter_tpu.catalog import CatalogProvider  # noqa: F401
+        new_pods = mk_pods(2, "250m", "512Mi", "nx", labels={"app": "x"})
+        enc = encode_pods(new_pods, self.cat)
+        t = next(i for i, n in enumerate(self.cat.names) if n.endswith("8xlarge"))
+        vn = VirtualNode(
+            type_idx=t, zone_mask=np.ones(self.cat.Z, bool),
+            cap_mask=np.ones(self.cat.C, bool),
+            cum=np.zeros(len(self.cat.resources), np.float32),
+            existing_name="n1")
+        resident = Pod(name="guard", labels={"app": "guard"},
+                       requests=Resources.parse({"cpu": "100m"}),
+                       affinity_terms=self._anti({"app": "x"}))
+        Solver._apply_resident_bans(enc, [vn], {"n1": [resident]})
+        assert vn.banned_groups is not None and vn.banned_groups.all()
+        h = solve_host(self.cat, enc, [vn])
+        assert not validate_solution(self.cat, enc, h)
+        # nothing placed on n1; new node(s) opened instead
+        assert h.nodes[0].pod_count() == 0
+        assert sum(n.pod_count() for n in h.nodes[1:]) == 2
+        d = solve_device(self.cat, enc, [vn])
+        assert d.nodes[0].pod_count() == 0
+        from karpenter_tpu.ops.native import solve_native
+        n = solve_native(self.cat, enc, [vn])
+        assert n.nodes[0].pod_count() == 0
+
+    def test_banned_groups_reset_between_solves(self):
+        from karpenter_tpu.ops.facade import Solver
+        enc = encode_pods(mk_pods(2), self.cat)
+        vn = VirtualNode(
+            type_idx=0, zone_mask=np.ones(self.cat.Z, bool),
+            cap_mask=np.ones(self.cat.C, bool),
+            cum=np.zeros(len(self.cat.resources), np.float32),
+            banned_groups=np.ones(enc.G, bool), existing_name="n1")
+        Solver._apply_resident_bans(enc, [vn], {"n1": []})
+        assert vn.banned_groups is None
+
+
+class TestSoftConstraints:
+    """Preferred (soft) constraints: honored when feasible, never blocking."""
+
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog())
+
+    def test_soft_spread_balances_when_feasible(self):
+        pods = mk_pods(6, "250m", "512Mi", "ss",
+                       topology_spread=[TopologySpreadConstraint(
+                           topology_key=L.ZONE, max_skew=1,
+                           when_unsatisfiable="ScheduleAnyway")])
+        enc = encode_pods(pods, self.cat)
+        assert enc.spread_zone[0] and enc.spread_soft[0]
+        enc2 = split_spread_groups(enc, self.cat)
+        assert sorted(enc2.counts.tolist()) == [2, 2, 2]
+        h, _ = assert_agree(self.cat, enc2)
+        assert not h.unschedulable
+
+    def test_soft_spread_skips_infeasible_zone(self):
+        # kill all zone-a offerings: soft spread must route pods to b/c
+        cat = encode_catalog(small_catalog())
+        cat.available[:, 0, :] = False
+        pods = mk_pods(4, "250m", "512Mi", "ss",
+                       topology_spread=[TopologySpreadConstraint(
+                           topology_key=L.ZONE, max_skew=1,
+                           when_unsatisfiable="ScheduleAnyway")])
+        enc = encode_pods(pods, cat)
+        enc2 = split_spread_groups(enc, cat)
+        for i in range(enc2.G):
+            assert not enc2.allow_zone[i][0]
+        h = solve_host(cat, enc2)
+        assert not h.unschedulable
+        # hard spread by contrast strands the zone-a share
+        pods_hard = mk_pods(4, "250m", "512Mi", "hs",
+                            topology_spread=[TopologySpreadConstraint(
+                                topology_key=L.ZONE, max_skew=1)])
+        ench = split_spread_groups(encode_pods(pods_hard, cat), cat)
+        hh = solve_host(cat, ench)
+        assert sum(hh.unschedulable.values()) > 0
+
+    def test_hard_beats_soft_when_both_present(self):
+        pods = mk_pods(3, "250m", "512Mi", "hb",
+                       topology_spread=[
+                           TopologySpreadConstraint(topology_key=L.ZONE,
+                                                    max_skew=1),
+                           TopologySpreadConstraint(
+                               topology_key=L.ZONE, max_skew=2,
+                               when_unsatisfiable="ScheduleAnyway")])
+        enc = encode_pods(pods, self.cat)
+        assert enc.spread_zone[0] and not enc.spread_soft[0]
+
+    def test_preferred_affinity_narrows_when_feasible(self):
+        pods = mk_pods(4, "1", "2Gi", "pa",
+                       preferred_node_affinity=[{
+                           "key": L.INSTANCE_FAMILY, "operator": "In",
+                           "values": ["m5"], "weight": 10}])
+        enc = encode_pods(pods, self.cat)
+        h, _ = assert_agree(self.cat, enc)
+        assert not h.unschedulable
+        for n in h.nodes:
+            assert self.cat.names[n.type_idx].startswith("m5.")
+
+    def test_preferred_affinity_dropped_when_infeasible(self):
+        pods = mk_pods(4, "1", "2Gi", "pa",
+                       preferred_node_affinity=[{
+                           "key": L.INSTANCE_FAMILY, "operator": "In",
+                           "values": ["no-such-family"], "weight": 10}])
+        enc = encode_pods(pods, self.cat)
+        h, _ = assert_agree(self.cat, enc)
+        assert not h.unschedulable and h.nodes
+
+    def test_preferred_weight_order_greedy(self):
+        # heavier preference wins when the two cannot both hold
+        pods = mk_pods(2, "1", "2Gi", "pw",
+                       preferred_node_affinity=[
+                           {"key": L.INSTANCE_FAMILY, "operator": "In",
+                            "values": ["m5"], "weight": 1},
+                           {"key": L.INSTANCE_FAMILY, "operator": "In",
+                            "values": ["r5"], "weight": 100}])
+        enc = encode_pods(pods, self.cat)
+        h, _ = assert_agree(self.cat, enc)
+        for n in h.nodes:
+            assert self.cat.names[n.type_idx].startswith("r5.")
+
+    def test_soft_anti_affinity_never_blocks(self):
+        pods = mk_pods(5, "250m", "512Mi", "sa", labels={"app": "x"},
+                       affinity_terms=[PodAffinityTerm(
+                           topology_key="kubernetes.io/hostname",
+                           label_selector={"app": "x"}, anti=True,
+                           required=False)])
+        enc = encode_pods(pods, self.cat)
+        assert enc.conflict is None
+        assert enc.max_per_node[0] == 0  # no hard cap
+        h, _ = assert_agree(self.cat, enc)
+        assert not h.unschedulable
+
+
+class TestSoftConstraintReviewFixes:
+    """Regressions from review: soft constraints must never block, even
+    combined with hard ones or with downstream narrowing."""
+
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog())
+
+    def test_soft_constraint_never_gates_admission(self):
+        from karpenter_tpu.ops.binpack import (SpreadConstraintCounts,
+                                               _assign_spread)
+        zones = np.array([0, 1])
+        hard = SpreadConstraintCounts(np.array([0, 3, 0]), 1, True, soft=False)
+        soft = SpreadConstraintCounts(np.array([5, 0, 0]), 1, True, soft=True)
+        # hard admits only zone 0; soft "admits" only zone 1 — soft must
+        # lose: all pods land in zone 0, none unassignable
+        adds, bad = _assign_spread(zones, 3, [hard, soft])
+        assert bad == 0 and adds[0] == 3
+
+    def test_soft_steers_choice_when_hard_indifferent(self):
+        from karpenter_tpu.ops.binpack import (SpreadConstraintCounts,
+                                               _assign_spread)
+        zones = np.array([0, 1])
+        hard = SpreadConstraintCounts(np.array([0, 0, 0]), 5, True, soft=False)
+        soft = SpreadConstraintCounts(np.array([4, 0, 0]), 1, True, soft=True)
+        adds, bad = _assign_spread(zones, 2, [hard, soft])
+        assert bad == 0 and adds[1] == 2  # soft pushes away from zone 0
+
+    def test_preference_relaxed_after_zone_split(self):
+        # preferred family is only available in zone-a; hard zone spread
+        # pins subgroups to b and c too — those must fall back to any family
+        from karpenter_tpu.catalog import CatalogProvider
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.ops.facade import Solver
+        types = small_catalog()
+        prov = CatalogProvider(lambda: types)
+        solver = Solver(prov, backend="host")
+        cat = solver.tensors()
+        # make m5 unavailable outside zone-a (through the real ICE cache so
+        # the facade's epoch-keyed re-encode keeps the marking)
+        for n in cat.names:
+            if n.startswith("m5."):
+                for z in cat.zones[1:]:
+                    for c in cat.captypes:
+                        prov.unavailable.mark_unavailable(n, z, c, reason="test")
+        pods = [Pod(name=f"p{i}", labels={"app": "w"},
+                    requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=L.ZONE, max_skew=1)],
+                    preferred_node_affinity=[{
+                        "key": L.INSTANCE_FAMILY, "operator": "In",
+                        "values": ["m5"], "weight": 1}])
+                for i in range(6)]
+        out = solver.solve(pods, NodePool(name="default"))
+        assert not out.unschedulable
+        zones = sorted({l.zone for l in out.launches})
+        assert zones == ["zone-a", "zone-b", "zone-c"]
+        for l in out.launches:
+            if l.zone == "zone-a":
+                assert l.instance_type.startswith("m5.")
+            else:
+                assert not l.instance_type.startswith("m5.")
+
+    def test_preference_too_small_size_dropped(self):
+        # preferring a size whose types can't fit the pod must not strand it
+        cat = encode_catalog(small_catalog())
+        largest_large = max(cat.allocatable[i, 0]
+                            for i, n in enumerate(cat.names)
+                            if n.endswith(".large"))
+        pods = mk_pods(1, str(int(largest_large) + 2), "4Gi", "big",
+                       preferred_node_affinity=[{
+                           "key": L.INSTANCE_SIZE, "operator": "In",
+                           "values": ["large"], "weight": 1}])
+        enc = encode_pods(pods, cat)
+        assert enc.compat_hard is None  # infeasible preference never applied
+        h = solve_host(cat, enc)
+        assert not h.unschedulable
