@@ -53,12 +53,10 @@ class Provisioner:
         if not pending:
             return self.requeue
         remaining: List[Pod] = pending
-        spread_occupancy = self._cluster_occupancy(now)
         for pool in self.store.nodepools_by_weight():
             if not remaining:
                 break
-            remaining = self._provision_pool(pool, remaining, now,
-                                             spread_occupancy)
+            remaining = self._provision_pool(pool, remaining, now)
         self.stats["unschedulable"] = len(remaining)
         PODS_UNSCHEDULABLE.set(len(remaining))
         for p in remaining:
@@ -97,11 +95,14 @@ class Provisioner:
         return out
 
     # --- per-pool pass ---
-    def _provision_pool(self, pool: NodePool, pods: List[Pod], now: float,
-                        spread_occupancy=None) -> List[Pod]:
+    def _provision_pool(self, pool: NodePool, pods: List[Pod],
+                        now: float) -> List[Pod]:
         node_class = self.store.nodeclasses.get(pool.node_class) or NodeClassSpec()
         if not node_class.ready:
             return pods  # NodeClass readiness gate (cloudprovider.go:102-111)
+        # fresh per pool: claims + nominations created by earlier pools this
+        # reconcile must count toward later pools' topology domains
+        spread_occupancy = self._cluster_occupancy(now)
         cat = self.solver.tensors(node_class)
         # live + in-flight claims of this pool absorb pods first (real-node
         # headroom reuse; reference simulates against cluster state the same
@@ -141,9 +142,15 @@ class Provisioner:
             headroom = Resources({k: v - usage.get(k, 0.0)
                                   for k, v in pool.limits.items()})
             if all(v > 0 for v in headroom.values()):
+                # the first solve's accepted launches aren't claims yet
+                # (they launch below), so their placements are synthesized
+                # into the occupancy the re-solve sees
+                occ2 = self._cluster_occupancy(now) + [
+                    (l.zone, [by_key[k] for k in l.pod_keys if k in by_key])
+                    for l in launches]
                 out2 = self.solver.solve(over_limit_pods, pool, node_class,
                                          capacity_cap=headroom,
-                                         spread_occupancy=spread_occupancy)
+                                         spread_occupancy=occ2)
                 by_key2 = {f"{p.namespace}/{p.name}": p for p in over_limit_pods}
                 by_key.update(by_key2)
                 l2, over_limit_pods, usage = self._filter_by_limits(

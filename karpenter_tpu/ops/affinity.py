@@ -1,0 +1,357 @@
+"""Zone-level required pod (anti-)affinity as allow_zone mask surgery.
+
+The reference's core scheduler evaluates full k8s inter-pod (anti-)affinity
+inside its per-node simulation loop (website/content/en/docs/concepts/
+scheduling.md — "podAffinity/podAntiAffinity"; hostname-level terms are
+handled by encode.build_conflicts + per-node caps). Zone-topology terms
+couple placements through the *zone* axis instead of the node axis, so the
+TPU-first lowering is a host-side pre-pass that rewrites each group's
+allow_zone mask before the kernels run — the kernels never see affinity,
+only zone masks:
+
+  Positive zone affinity (anti=False, required, topology_key=zone):
+    - zones already hosting a matching resident pod restrict allow_zone
+      (k8s: the pod may only land in a topology domain with a match);
+    - when the only matches arrive in the same solve (other incoming
+      groups), the group and its targets are co-pinned to one common
+      feasible zone — sound (constraint guaranteed) though narrower than
+      k8s's sequential scheduler, which could use several zones;
+    - a self-matching group with no other match anywhere bootstraps pinned
+      to a single zone: k8s's first-pod special case places pod 1 anywhere
+      and every later pod must join its domain, which at group granularity
+      is exactly "all in one zone";
+    - no match anywhere and no self-match → unschedulable (k8s rejects).
+
+  Zone anti-affinity (anti=True, required, topology_key=zone):
+    - zones hosting a conflicting resident are removed (both directions:
+      the resident's own zone-anti terms repel the group symmetrically,
+      matching k8s's symmetric enforcement);
+    - mutually-conflicting incoming groups are greedily pinned to disjoint
+      zones in group (FFD) order — disjoint masks are the only way a
+      deferred-zone solver can *guarantee* the constraint;
+    - a self-conflicting group (own selector matches own labels — max one
+      pod per zone) splits into one-pod-per-zone subgroups across its
+      feasible zones; excess pods become an all-False-zone subgroup, which
+      every backend reports unschedulable.
+
+Runs before split_spread_groups (spread then balances within the surviving
+zones). Group splits here reference the SAME PodGroup object from multiple
+rows; facade._decode draws disjoint pod slices per row by sharing one
+cursor per PodGroup.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models import labels as L
+from ..models.pod import Pod, PodAffinityTerm
+from .encode import (CatalogTensors, EncodedPods, build_conflicts,
+                     feasible_zones)
+
+Occupancy = Sequence[Tuple[Optional[str], Sequence[Pod]]]
+
+
+def _zone_terms(rep: Pod, anti: bool) -> List[PodAffinityTerm]:
+    return [t for t in rep.affinity_terms
+            if t.anti == anti and t.required and t.topology_key == L.ZONE]
+
+
+def _selects(term: PodAffinityTerm, ns_ok: bool, labels: Dict[str, str]) -> bool:
+    return ns_ok and all(labels.get(k) == v
+                         for k, v in term.label_selector.items())
+
+
+
+
+def apply_zone_affinity(enc: EncodedPods, cat: CatalogTensors,
+                        occupancy: Optional[Occupancy] = None) -> EncodedPods:
+    """Rewrite allow_zone for zone-topology (anti-)affinity; split
+    self-conflicting groups. Returns enc unchanged when no group carries
+    zone terms (the common fast path)."""
+    G = enc.G
+    pos = [_zone_terms(g.representative, anti=False) for g in enc.groups]
+    neg = [_zone_terms(g.representative, anti=True) for g in enc.groups]
+    # residents' own zone-anti terms repel groups even when the group has
+    # no terms of its own, so the fast path must also scan occupancy
+    # (once per pod — this runs every solve)
+    resident_anti = []
+    for zone, pods_on in (occupancy or []):
+        if zone not in cat.zones:
+            continue
+        for p in pods_on:
+            ts = _zone_terms(p, anti=True)
+            if ts:
+                resident_anti.append((zone, p, ts))
+    if not any(pos) and not any(neg) and not resident_anti:
+        return enc
+
+    allow = enc.allow_zone.copy()
+    # affinity decisions are HARD: they must survive the facade's
+    # preferred-affinity relaxation, so the zone_hard rows get the same
+    # surgery as the working rows
+    allow_hard = enc.zone_hard.copy() if enc.zone_hard is not None else None
+    zidx = {z: i for i, z in enumerate(cat.zones)}
+
+    def set_row(i: int, mask: np.ndarray) -> None:
+        allow[i] = mask
+        if allow_hard is not None:
+            allow_hard[i] = mask
+
+    def and_row(i: int, mask) -> None:
+        allow[i] = allow[i] & mask
+        if allow_hard is not None:
+            allow_hard[i] = allow_hard[i] & mask
+
+    # --- resident matches per group ---------------------------------------
+    # pos_resident[i][k]: bool [Z] zones holding a match for term k (or None
+    # when no resident matches that term anywhere)
+    pos_resident: List[List[Optional[np.ndarray]]] = [
+        [None] * len(ts) for ts in pos]
+    anti_resident = np.zeros((G, cat.Z), bool)
+    for zone, pods_on in (occupancy or []):
+        zi = zidx.get(zone or "")
+        if zi is None or not pods_on:
+            continue
+        for i in range(G):
+            rep = enc.groups[i].representative
+            for k, t in enumerate(pos[i]):
+                if any(_selects(t, p.namespace == rep.namespace, p.labels)
+                       for p in pods_on):
+                    if pos_resident[i][k] is None:
+                        pos_resident[i][k] = np.zeros(cat.Z, bool)
+                    pos_resident[i][k][zi] = True
+            for t in neg[i]:
+                if any(_selects(t, p.namespace == rep.namespace, p.labels)
+                       for p in pods_on):
+                    anti_resident[i, zi] = True
+    for zone, p, p_terms in resident_anti:
+        zi = zidx[zone]
+        for i in range(G):
+            rep = enc.groups[i].representative
+            if any(_selects(t, p.namespace == rep.namespace, rep.labels)
+                   for t in p_terms):
+                anti_resident[i, zi] = True
+
+    # --- positive terms ----------------------------------------------------
+    # union-find for co-pin clusters (group ↔ incoming targets)
+    parent = list(range(G))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: int, b: int) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    must_pin = np.zeros(G, bool)   # group belongs to a co-pin cluster
+    initiator = np.zeros(G, bool)  # group carries the positive term
+    for i in range(G):
+        if not pos[i]:
+            continue
+        rep = enc.groups[i].representative
+        for k, t in enumerate(pos[i]):
+            if pos_resident[i][k] is not None:
+                and_row(i, pos_resident[i][k])
+                continue
+            incoming = [j for j in range(G) if j != i and _selects(
+                t, enc.groups[j].representative.namespace == rep.namespace,
+                enc.groups[j].representative.labels)]
+            self_match = _selects(t, True, rep.labels)
+            if incoming:
+                must_pin[i] = initiator[i] = True
+                for j in incoming:
+                    must_pin[j] = True
+                    union(i, j)
+            elif self_match:
+                # bootstrap: group colocates with itself in one zone
+                must_pin[i] = initiator[i] = True
+            else:
+                # no match anywhere → unschedulable
+                set_row(i, np.zeros(cat.Z, bool))
+
+    # --- anti terms: resident bans ------------------------------------------
+    allow &= ~anti_resident
+    if allow_hard is not None:
+        allow_hard &= ~anti_resident
+
+    # --- co-pin clusters to one common feasible zone -------------------------
+    if must_pin.any():
+        clusters: Dict[int, List[int]] = {}
+        for i in np.flatnonzero(must_pin):
+            clusters.setdefault(find(int(i)), []).append(int(i))
+        for members in clusters.values():
+            common = np.ones(cat.Z, bool)
+            for i in members:
+                common &= feasible_zones(enc, cat, i, allow[i])
+            zs = np.flatnonzero(common)
+            if len(zs):
+                pin = np.zeros(cat.Z, bool)
+                pin[zs[0]] = True
+                for i in members:
+                    set_row(i, pin.copy())
+            else:
+                # no zone serves the whole cluster: the initiating groups
+                # cannot satisfy their term; targets keep their own masks
+                for i in members:
+                    if initiator[i]:
+                        set_row(i, np.zeros(cat.Z, bool))
+
+    # --- anti terms: cross-group disjointness + self splits ------------------
+    self_anti = np.zeros(G, bool)
+    conflict = np.zeros((G, G), bool)
+    for i in range(G):
+        rep = enc.groups[i].representative
+        if any(_selects(t, True, rep.labels) for t in neg[i]):
+            self_anti[i] = True
+        for j in range(i + 1, G):
+            rj = enc.groups[j].representative
+            same_ns = rep.namespace == rj.namespace
+            if (any(_selects(t, same_ns, rj.labels) for t in neg[i])
+                    or any(_selects(t, same_ns, rep.labels) for t in neg[j])):
+                conflict[i, j] = conflict[j, i] = True
+
+    # zones each group will occupy (for the greedy disjoint partition);
+    # rows [G] of Optional[bool [Z]]
+    claimed: List[Optional[np.ndarray]] = [None] * G
+    # groups the positive pass (or resident restrictions) already pinned to
+    # a single zone claim it up front, so the greedy routes their conflict
+    # partners around them regardless of processing order. Two conflicting
+    # groups both pre-pinned to the SAME zone cannot coexist — the later
+    # one goes unschedulable rather than silently violating the term.
+    for j in range(G):
+        if not conflict[j].any() or allow[j].sum() != 1:
+            continue
+        partners = np.flatnonzero(conflict[j])
+        taken = any(claimed[p] is not None
+                    and bool((claimed[p] & allow[j]).any())
+                    for p in partners)
+        if taken:
+            set_row(j, np.zeros(cat.Z, bool))
+            claimed[j] = np.zeros(cat.Z, bool)
+        else:
+            claimed[j] = allow[j].copy()
+    split_zones: Dict[int, List[int]] = {}
+    for i in range(G):
+        partners = np.flatnonzero(conflict[i])
+        if not len(partners) and not self_anti[i]:
+            continue
+        if claimed[i] is not None and not self_anti[i]:
+            continue  # pre-pinned; partners avoid its zone instead
+        eff = allow[i].copy()
+        for j in partners:
+            if claimed[j] is not None:
+                eff &= ~claimed[j]
+        feas = feasible_zones(enc, cat, i, eff)
+        zs = np.flatnonzero(feas)
+        if self_anti[i]:
+            use = zs[: int(enc.counts[i])]
+            split_zones[i] = use.tolist()
+            claim = np.zeros(cat.Z, bool)
+            claim[use] = True
+            claimed[i] = claim
+            # allow stays; the split below pins each subgroup
+        elif len(partners):
+            if len(zs) == 0:
+                set_row(i, np.zeros(cat.Z, bool))
+                claimed[i] = np.zeros(cat.Z, bool)
+            else:
+                pin = np.zeros(cat.Z, bool)
+                pin[zs[0]] = True
+                set_row(i, pin)
+                claimed[i] = pin
+
+    zc = conflict if conflict.any() else None
+    if not split_zones:
+        return _rebuild(enc, allow, allow_hard=allow_hard, zone_conflict=zc)
+
+    # --- expand self-anti groups into one-pod-per-zone subgroups -------------
+    rows: List[Tuple[int, int, np.ndarray]] = []  # (orig idx, count, zone row)
+    for i in range(G):
+        if i not in split_zones:
+            rows.append((i, int(enc.counts[i]), allow[i]))
+            continue
+        used = split_zones[i]
+        for z in used:
+            row = np.zeros(cat.Z, bool)
+            row[z] = True
+            rows.append((i, 1, row))
+        excess = int(enc.counts[i]) - len(used)
+        if excess > 0:
+            rows.append((i, excess, np.zeros(cat.Z, bool)))
+    return _rebuild(enc, allow, rows, allow_hard=allow_hard, zone_conflict=zc,
+                    self_anti=self_anti)
+
+
+def _rebuild(enc: EncodedPods, allow: np.ndarray,
+             rows: Optional[List[Tuple[int, int, np.ndarray]]] = None,
+             allow_hard: Optional[np.ndarray] = None,
+             zone_conflict: Optional[np.ndarray] = None,
+             self_anti: Optional[np.ndarray] = None) -> EncodedPods:
+    """New EncodedPods with rewritten allow_zone (+ its hard rows and the
+    zone-conflict matrix); optionally re-rowed (orig_idx, count, zone_row)
+    for self-anti group splits."""
+    if rows is None:
+        return EncodedPods(
+            groups=enc.groups, requests=enc.requests, counts=enc.counts,
+            compat=enc.compat, allow_zone=allow, allow_cap=enc.allow_cap,
+            max_per_node=enc.max_per_node, spread_zone=enc.spread_zone,
+            conflict=enc.conflict, spread_soft=enc.spread_soft,
+            compat_hard=enc.compat_hard, zone_hard=allow_hard,
+            cap_hard=enc.cap_hard, zone_conflict=zone_conflict)
+    groups = [enc.groups[i] for i, _, _ in rows]
+    n = len(rows)
+    Z = allow.shape[1]
+    orig = [i for i, _, _ in rows]
+    zc = None
+    if zone_conflict is not None or (self_anti is not None and self_anti.any()):
+        base = (zone_conflict if zone_conflict is not None
+                else np.zeros((enc.G, enc.G), bool))
+        if self_anti is not None:
+            # subgroup rows of one self-anti group conflict with each other
+            base = base.copy()
+            base[np.diag_indices(enc.G)] = self_anti
+        o = np.asarray(orig)
+        zc = base[np.ix_(o, o)].copy()
+        np.fill_diagonal(zc, False)
+        if not zc.any():
+            zc = None
+    # a split row's single-zone pin is a hard decision; unsplit rows keep
+    # their hard row
+    hard_rows = None
+    if allow_hard is not None:
+        split = {i for i, _, _ in rows if self_anti is not None
+                 and i < len(self_anti) and self_anti[i]}
+        hard_rows = np.array(
+            [r if i in split else allow_hard[i] for i, _, r in rows],
+            bool).reshape(n, Z)
+    return EncodedPods(
+        groups=groups,
+        requests=np.array([enc.requests[i] for i, _, _ in rows],
+                          np.float32).reshape(n, -1),
+        counts=np.array([c for _, c, _ in rows], np.int32),
+        compat=np.array([enc.compat[i] for i, _, _ in rows],
+                        bool).reshape(n, -1),
+        allow_zone=np.array([r for _, _, r in rows], bool).reshape(n, Z),
+        allow_cap=np.array([enc.allow_cap[i] for i, _, _ in rows],
+                           bool).reshape(n, -1),
+        max_per_node=np.array([enc.max_per_node[i] for i, _, _ in rows],
+                              np.int32),
+        spread_zone=np.array([enc.spread_zone[i] for i, _, _ in rows], bool),
+        conflict=build_conflicts(groups),
+        spread_soft=(np.array([enc.spread_soft[i] for i, _, _ in rows], bool)
+                     if enc.spread_soft is not None else None),
+        compat_hard=(np.array([enc.compat_hard[i] for i, _, _ in rows],
+                              bool).reshape(n, -1)
+                     if enc.compat_hard is not None else None),
+        zone_hard=hard_rows,
+        cap_hard=(np.array([enc.cap_hard[i] for i, _, _ in rows],
+                           bool).reshape(n, -1)
+                  if enc.cap_hard is not None else None),
+        zone_conflict=zc)

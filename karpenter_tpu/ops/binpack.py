@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .encode import (CatalogTensors, EncodedPods, align_resources,
-                     build_conflicts)
+                     build_conflicts, feasible_zones)
 
 BIG = 10**9
 
@@ -197,11 +197,13 @@ def split_spread_groups(enc: EncodedPods, cat: CatalogTensors,
         return enc
     rows = {"requests": [], "counts": [], "compat": [], "allow_zone": [],
             "allow_cap": [], "max_per_node": [], "spread_zone": [],
-            "compat_hard": []}
+            "compat_hard": [], "zone_hard": [], "cap_hard": []}
     groups = []
+    orig: List[int] = []  # original group index per output row
 
-    def push(i, count, zone_row):
+    def push(i, count, zone_row, pinned=False):
         groups.append(enc.groups[i])
+        orig.append(i)
         rows["requests"].append(enc.requests[i])
         rows["counts"].append(count)
         rows["compat"].append(enc.compat[i])
@@ -211,6 +213,12 @@ def split_spread_groups(enc: EncodedPods, cat: CatalogTensors,
         rows["spread_zone"].append(False)
         rows["compat_hard"].append(
             enc.compat[i] if enc.compat_hard is None else enc.compat_hard[i])
+        # a zone-pinned subgroup's pin IS hard (relaxing a soft zone
+        # preference must not widen it); unpinned rows keep their hard row
+        rows["zone_hard"].append(
+            zone_row if pinned or enc.zone_hard is None else enc.zone_hard[i])
+        rows["cap_hard"].append(
+            enc.allow_cap[i] if enc.cap_hard is None else enc.cap_hard[i])
 
     for i in range(enc.G):
         if not enc.spread_zone[i]:
@@ -219,13 +227,13 @@ def split_spread_groups(enc: EncodedPods, cat: CatalogTensors,
         zones = np.flatnonzero(enc.allow_zone[i])
         soft = enc.spread_soft is not None and bool(enc.spread_soft[i])
         if soft:
-            # ScheduleAnyway: pin only to zones where the group actually has
-            # an available compatible offering — an infeasible zone must
-            # fall back to the others, never to unschedulable
-            feasible = np.array(
-                [(cat.available[:, z, :] & enc.compat[i][:, None]
-                  & enc.allow_cap[i][None, :]).any() for z in zones], bool)
-            zones = zones[feasible]
+            # ScheduleAnyway: pin only to zones where the group actually
+            # has an available, compatible, FITTING offering — an
+            # infeasible zone must fall back to the others, never to
+            # unschedulable. Judged on the HARD type/captype masks: a soft
+            # preference must not steer (or collapse) the split.
+            feas = feasible_zones(enc, cat, i, enc.allow_zone[i])
+            zones = zones[feas[zones]]
         if len(zones) == 0:
             push(i, int(enc.counts[i]), enc.allow_zone[i])
             continue
@@ -243,22 +251,35 @@ def split_spread_groups(enc: EncodedPods, cat: CatalogTensors,
                 continue
             row = np.zeros(cat.Z, bool)
             row[z] = True
-            push(i, cnt, row)
+            push(i, cnt, row, pinned=True)
         if n_unassignable:
-            push(i, n_unassignable, np.zeros(cat.Z, bool))
+            push(i, n_unassignable, np.zeros(cat.Z, bool), pinned=True)
 
+    n = len(groups)
+    zone_conflict = None
+    if enc.zone_conflict is not None:
+        o = np.asarray(orig)
+        zone_conflict = enc.zone_conflict[np.ix_(o, o)].copy()
+        np.fill_diagonal(zone_conflict, False)
     return EncodedPods(groups=groups,
-              requests=np.array(rows["requests"], np.float32).reshape(len(groups), -1),
+              requests=np.array(rows["requests"], np.float32).reshape(n, -1),
               counts=np.array(rows["counts"], np.int32),
-              compat=np.array(rows["compat"], bool).reshape(len(groups), -1),
-              allow_zone=np.array(rows["allow_zone"], bool).reshape(len(groups), -1),
-              allow_cap=np.array(rows["allow_cap"], bool).reshape(len(groups), -1),
+              compat=np.array(rows["compat"], bool).reshape(n, -1),
+              allow_zone=np.array(rows["allow_zone"], bool).reshape(n, -1),
+              allow_cap=np.array(rows["allow_cap"], bool).reshape(n, -1),
               max_per_node=np.array(rows["max_per_node"], np.int32),
               spread_zone=np.array(rows["spread_zone"], bool),
               conflict=build_conflicts(groups),
               compat_hard=(
-                  np.array(rows["compat_hard"], bool).reshape(len(groups), -1)
-                  if enc.compat_hard is not None else None))
+                  np.array(rows["compat_hard"], bool).reshape(n, -1)
+                  if enc.compat_hard is not None else None),
+              zone_hard=(
+                  np.array(rows["zone_hard"], bool).reshape(n, -1)
+                  if enc.zone_hard is not None else None),
+              cap_hard=(
+                  np.array(rows["cap_hard"], bool).reshape(n, -1)
+                  if enc.cap_hard is not None else None),
+              zone_conflict=zone_conflict)
 
 
 EPS = np.float32(1e-4)  # f32 division slack; shared with the device kernel
@@ -433,4 +454,24 @@ def validate_solution(cat: CatalogTensors, enc: EncodedPods,
         got = placed_per_group.get(g, 0) + result.unschedulable.get(g, 0)
         if got != want:
             errors.append(f"group {g}: {got} accounted != {want} pods")
+    if enc.zone_conflict is not None:
+        # zone anti-affinity: any node hosting group i must have a zone mask
+        # disjoint from every node hosting a zone-conflicting group j
+        # (deferred masks — overlap means the launch step COULD violate)
+        hosts: Dict[int, List[int]] = {}
+        for idx, n in enumerate(result.nodes):
+            for g, c in n.pods_by_group.items():
+                if c > 0:
+                    hosts.setdefault(g, []).append(idx)
+        for i in hosts:
+            for j in hosts:
+                if j <= i or not enc.zone_conflict[i, j]:
+                    continue
+                for a in hosts[i]:
+                    for b in hosts[j]:
+                        if (result.nodes[a].zone_mask
+                                & result.nodes[b].zone_mask).any():
+                            errors.append(
+                                f"nodes {a},{b}: zone-conflicting groups "
+                                f"{i},{j} may share a zone")
     return errors

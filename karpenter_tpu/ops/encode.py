@@ -198,6 +198,17 @@ class EncodedPods:
     # feasible at encode time; the facade falls back to this row so a soft
     # preference never blocks scheduling.
     compat_hard: Optional[np.ndarray] = None
+    # bool [G, Z] / [G, C] (None = identical to allow_zone / allow_cap):
+    # the offering-axis masks before preferred narrowing — zone and
+    # capacity-type preferences narrow these axes the way type preferences
+    # narrow compat, with the same hard-row fallback.
+    zone_hard: Optional[np.ndarray] = None
+    cap_hard: Optional[np.ndarray] = None
+    # symmetric bool [G, G] (None = none anywhere): groups that may not
+    # share a ZONE (zone-topology anti-affinity; set by
+    # affinity.apply_zone_affinity, consumed by validate_solution — the
+    # solvers themselves rely on the pre-pass's disjoint allow_zone masks)
+    zone_conflict: Optional[np.ndarray] = None
 
     @property
     def G(self) -> int:
@@ -326,6 +337,8 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
 
     spread_soft = np.zeros(G, bool)
     hard = np.ones((G, cat.T), bool)
+    hard_z = np.ones((G, cat.Z), bool)
+    hard_c = np.ones((G, cat.C), bool)
 
     for i, g in enumerate(groups):
         reqs = g.representative.scheduling_requirements()
@@ -335,11 +348,13 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
         allow_zone[i] = _axis_allow(reqs, L.ZONE, cat.zones)
         allow_cap[i] = _axis_allow(reqs, L.CAPACITY_TYPE, cat.captypes)
         hard[i] = compat[i]
+        hard_z[i] = allow_zone[i]
+        hard_c[i] = allow_cap[i]
         narrowed = _apply_preferred(g.representative, compat[i],
                                     allow_zone[i], allow_cap[i],
                                     requests[i], cat)
         if narrowed is not None:
-            compat[i] = narrowed
+            compat[i], allow_zone[i], allow_cap[i] = narrowed
         if g.representative.has_self_anti_affinity():
             max_per_node[i] = 1
         any_hard_zone = False
@@ -364,35 +379,71 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
                        compat=compat, allow_zone=allow_zone, allow_cap=allow_cap,
                        max_per_node=max_per_node, spread_zone=spread_zone,
                        conflict=build_conflicts(groups), spread_soft=spread_soft,
-                       compat_hard=hard if (hard != compat).any() else None)
+                       compat_hard=hard if (hard != compat).any() else None,
+                       zone_hard=hard_z if (hard_z != allow_zone).any() else None,
+                       cap_hard=hard_c if (hard_c != allow_cap).any() else None)
 
 
 def _apply_preferred(rep: Pod, compat_row: np.ndarray, zone_row: np.ndarray,
                      cap_row: np.ndarray, req: np.ndarray,
-                     cat: CatalogTensors) -> Optional[np.ndarray]:
-    """Narrow a group's type mask to its preferred node-affinity terms,
-    greedily in descending weight, keeping each narrowing only while ≥1
-    available offering that FITS the pod survives — 'prefer, never block'.
-    (k8s scores preferences per node; against a catalog the analogue is
-    restricting the candidate types when the restriction is satisfiable.)
-    Returns the narrowed row, or None if no preference applied."""
+                     cat: CatalogTensors,
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Narrow a group's (type, zone, captype) masks to its preferred
+    node-affinity terms, greedily in descending weight, keeping each
+    narrowing only while ≥1 available offering that FITS the pod survives —
+    'prefer, never block'. (k8s scores preferences per node; against a
+    catalog the analogue is restricting the candidate axes when the
+    restriction is satisfiable.) Zone-key preferences are skipped for pods
+    carrying a zone topology-spread constraint: spread domains come from
+    hard filters only (k8s likewise computes eligible domains before
+    scoring). Returns (compat, zone, cap) rows, or None if no preference
+    applied."""
     if not rep.preferred_node_affinity:
         return None
     fits = (align_resources(cat.allocatable, len(req))
             >= req[None, :] - 1e-6).all(axis=1)
-    cur = compat_row
+    cur_t, cur_z, cur_c = compat_row, zone_row, cap_row
+    has_zone_spread = any(t.topology_key == L.ZONE
+                          for t in rep.topology_spread)
     terms = sorted(rep.preferred_node_affinity,
                    key=lambda t: -t.get("weight", 1))
+    changed = False
     for term in terms:
         r = Requirements()
         r.add(Requirement(term["key"], Operator(term["operator"]),
                           tuple(term.get("values", ()))))
-        cand = cur & compat_mask(r, cat)
-        feasible = (cat.available & (cand & fits)[:, None, None]
-                    & zone_row[None, :, None] & cap_row[None, None, :]).any()
+        cand_t, cand_z, cand_c = cur_t, cur_z, cur_c
+        if term["key"] == L.ZONE:
+            if has_zone_spread:
+                continue
+            cand_z = cur_z & _axis_allow(r, L.ZONE, cat.zones)
+        elif term["key"] == L.CAPACITY_TYPE:
+            cand_c = cur_c & _axis_allow(r, L.CAPACITY_TYPE, cat.captypes)
+        else:
+            cand_t = cur_t & compat_mask(r, cat)
+        feasible = (cat.available & (cand_t & fits)[:, None, None]
+                    & cand_z[None, :, None] & cand_c[None, None, :]).any()
         if feasible:
-            cur = cand
-    return cur
+            cur_t, cur_z, cur_c = cand_t, cand_z, cand_c
+            changed = True
+    return (cur_t, cur_z, cur_c) if changed else None
+
+
+def feasible_zones(enc: EncodedPods, cat: CatalogTensors, i: int,
+                   zone_mask: np.ndarray) -> np.ndarray:
+    """bool [Z]: zones in zone_mask where group i has ≥1 available,
+    compatible, FITTING offering — judged on the HARD type/captype masks,
+    so a soft node-affinity preference can neither steer a spread split
+    nor fail a required zone-affinity pin (the facade relaxes infeasible
+    preferences afterwards)."""
+    alloc = align_resources(cat.allocatable, enc.requests.shape[1])
+    fits = (alloc >= enc.requests[i][None, :] - 1e-6).all(axis=1)
+    comp = enc.compat[i] if enc.compat_hard is None else enc.compat_hard[i]
+    cap = enc.allow_cap[i] if enc.cap_hard is None else enc.cap_hard[i]
+    ok_t = comp & fits
+    per_zone = (cat.available & ok_t[:, None, None]
+                & cap[None, None, :]).any(axis=(0, 2))
+    return per_zone & zone_mask
 
 
 def align_resources(alloc: np.ndarray, R: int) -> np.ndarray:

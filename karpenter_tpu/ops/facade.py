@@ -27,6 +27,7 @@ from ..models.nodepool import NodeClassSpec, NodePool
 from ..models.pod import Pod
 from ..models.requirements import Requirements
 from ..models.resources import Resources
+from .affinity import apply_zone_affinity
 from .binpack import (SolveResult, SpreadConstraintCounts, VirtualNode,
                       solve_host, split_spread_groups, validate_solution)
 from .encode import (CatalogTensors, EncodedPods, align_resources,
@@ -135,6 +136,7 @@ class Solver:
         dropped = [_pod_key(p) for p in pods if _pod_key(p) not in enc_keys]
         occupancy = (spread_occupancy if spread_occupancy is not None
                      else self._occupancy_from_existing(existing, existing_pods, cat))
+        enc = apply_zone_affinity(enc, cat, occupancy)
         enc = split_spread_groups(
             enc, cat, self._spread_constraints(enc, cat, occupancy))
         if enc.G == 0:
@@ -248,17 +250,24 @@ class Solver:
     @staticmethod
     def _relax_infeasible_preferences(enc: EncodedPods,
                                       cat: CatalogTensors) -> None:
-        """Preferred node affinity must never block: after zone-split
-        pinning and NodePool-limit caps have further narrowed the problem,
-        any group whose preference-narrowed type mask no longer reaches an
-        available, fitting offering falls back to its hard mask (the pre-
-        preference row). k8s drops unsatisfiable preferences the same way —
-        they only score, never filter."""
-        if enc.compat_hard is None:
+        """Preferred node affinity must never block: after zone-affinity
+        surgery, zone-split pinning, and NodePool-limit caps have further
+        narrowed the problem, any group whose preference-narrowed
+        (type, zone, captype) masks no longer reach an available, fitting
+        offering falls back to its hard rows (the pre-preference masks, as
+        rewritten by the hard affinity passes). k8s drops unsatisfiable
+        preferences the same way — they only score, never filter."""
+        if (enc.compat_hard is None and enc.zone_hard is None
+                and enc.cap_hard is None):
             return
         alloc = align_resources(cat.allocatable, enc.requests.shape[1])
         for i in range(enc.G):
-            if (enc.compat[i] == enc.compat_hard[i]).all():
+            ch = enc.compat[i] if enc.compat_hard is None else enc.compat_hard[i]
+            zh = enc.allow_zone[i] if enc.zone_hard is None else enc.zone_hard[i]
+            cch = enc.allow_cap[i] if enc.cap_hard is None else enc.cap_hard[i]
+            if ((enc.compat[i] == ch).all()
+                    and (enc.allow_zone[i] == zh).all()
+                    and (enc.allow_cap[i] == cch).all()):
                 continue
             fits = (alloc >= enc.requests[i][None, :] - 1e-6).all(axis=1)
             ok = (cat.available
@@ -266,7 +275,9 @@ class Solver:
                   & enc.allow_zone[i][None, :, None]
                   & enc.allow_cap[i][None, None, :]).any()
             if not ok:
-                enc.compat[i] = enc.compat_hard[i]
+                enc.compat[i] = ch
+                enc.allow_zone[i] = zh
+                enc.allow_cap[i] = cch
 
     @staticmethod
     def _apply_resident_bans(enc: EncodedPods,
@@ -326,8 +337,18 @@ class Solver:
     def _decode(self, cat: CatalogTensors, enc: EncodedPods,
                 result: SolveResult, nodepool: NodePool,
                 dropped: List[str]) -> SolveOutput:
-        # per-group pod cursors for deterministic nomination
-        cursors = [0] * enc.G
+        # Per-group pod cursors for deterministic nomination. Keyed by the
+        # PodGroup object, not the row index: split_spread_groups emits
+        # multiple rows referencing ONE PodGroup, and those rows must draw
+        # disjoint pod slices from its list.
+        cursors: Dict[int, int] = {}
+
+        def take_pods(g: int, cnt: int) -> List[Pod]:
+            grp = enc.groups[g]
+            k = id(grp)
+            at = cursors.get(k, 0)
+            cursors[k] = at + cnt
+            return grp.pods[at: at + cnt]
         launches: List[NodeLaunch] = []
         existing_placements: Dict[str, List[str]] = {}
         li = 0
@@ -335,9 +356,7 @@ class Solver:
             keys = []
             reqs = Resources()
             for g, cnt in sorted(node.pods_by_group.items()):
-                grp = enc.groups[g]
-                take = grp.pods[cursors[g]: cursors[g] + cnt]
-                cursors[g] += cnt
+                take = take_pods(g, cnt)
                 keys.extend(_pod_key(p) for p in take)
                 for p in take:
                     reqs = reqs.add(p.requests)
@@ -361,10 +380,7 @@ class Solver:
                 pod_keys=keys, requests=reqs, labels=labels))
         unschedulable = list(dropped)
         for g, cnt in result.unschedulable.items():
-            grp = enc.groups[g]
-            take = grp.pods[cursors[g]: cursors[g] + cnt]
-            cursors[g] += cnt
-            unschedulable.extend(_pod_key(p) for p in take)
+            unschedulable.extend(_pod_key(p) for p in take_pods(g, cnt))
         return SolveOutput(launches=launches,
                            existing_placements=existing_placements,
                            unschedulable=unschedulable)

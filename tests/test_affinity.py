@@ -1,0 +1,375 @@
+"""Zone-level required pod (anti-)affinity — the allow_zone pre-pass.
+
+Reference behavior: the core scheduler's inter-pod affinity handling
+(scheduling.md); hostname-level terms are covered in test_solver.py's
+cross-group anti-affinity suites.
+"""
+
+import numpy as np
+
+from karpenter_tpu.catalog import CatalogProvider, small_catalog
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.ops.affinity import apply_zone_affinity
+from karpenter_tpu.ops.binpack import solve_host, validate_solution
+from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+from karpenter_tpu.ops.facade import Solver
+
+
+def pod(name, labels=None, terms=(), cpu="1", mem="1Gi"):
+    return Pod(name=name, labels=labels or {},
+               requests=Resources.parse({"cpu": cpu, "memory": mem}),
+               affinity_terms=list(terms))
+
+
+def zone_term(selector, anti=False, required=True):
+    return PodAffinityTerm(topology_key=L.ZONE, label_selector=selector,
+                           anti=anti, required=required)
+
+
+class TestZoneAntiAffinity:
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog())
+
+    def test_cross_group_disjoint_zones(self):
+        a = [pod(f"a{i}", {"app": "a"}, [zone_term({"app": "b"}, anti=True)])
+             for i in range(4)]
+        b = [pod(f"b{i}", {"app": "b"}) for i in range(4)]
+        enc = apply_zone_affinity(encode_pods(a + b, self.cat), self.cat)
+        res = solve_host(self.cat, enc)
+        assert not validate_solution(self.cat, enc, res)
+        assert not res.unschedulable
+        za = set()
+        zb = set()
+        for n in res.nodes:
+            zs = set(np.flatnonzero(n.zone_mask).tolist())
+            for g in n.pods_by_group:
+                if enc.groups[g].representative.labels["app"] == "a":
+                    za |= zs
+                else:
+                    zb |= zs
+        assert not (za & zb), (za, zb)
+
+    def test_self_zone_anti_splits_one_per_zone(self):
+        pods = [pod(f"p{i}", {"app": "solo"},
+                    [zone_term({"app": "solo"}, anti=True)])
+                for i in range(3)]
+        enc = apply_zone_affinity(encode_pods(pods, self.cat), self.cat)
+        assert enc.G == 3
+        assert all(enc.counts[i] == 1 for i in range(3))
+        # each pinned to a distinct zone
+        zs = [tuple(np.flatnonzero(enc.allow_zone[i]).tolist())
+              for i in range(3)]
+        assert len(set(zs)) == 3 and all(len(z) == 1 for z in zs)
+        res = solve_host(self.cat, enc)
+        assert not res.unschedulable
+
+    def test_self_zone_anti_excess_unschedulable(self):
+        pods = [pod(f"p{i}", {"app": "solo"},
+                    [zone_term({"app": "solo"}, anti=True)])
+                for i in range(5)]  # only 3 zones in small_catalog
+        enc = apply_zone_affinity(encode_pods(pods, self.cat), self.cat)
+        res = solve_host(self.cat, enc)
+        assert sum(res.unschedulable.values()) == 2
+
+    def test_resident_zone_banned_both_directions(self):
+        # group's own anti term vs a resident
+        mine = [pod("m0", {"app": "x"}, [zone_term({"app": "y"}, anti=True)])]
+        occupancy = [("zone-a", [Pod(name="r", labels={"app": "y"})])]
+        enc = apply_zone_affinity(encode_pods(mine, self.cat), self.cat,
+                                  occupancy)
+        assert not enc.allow_zone[0][0] and enc.allow_zone[0][1:].all()
+        # resident's anti term repels the incoming group symmetrically
+        resident = Pod(name="r", labels={"app": "y"},
+                       affinity_terms=[zone_term({"app": "x"}, anti=True)])
+        mine2 = [pod("m1", {"app": "x"})]
+        enc2 = apply_zone_affinity(encode_pods(mine2, self.cat), self.cat,
+                                   [("zone-b", [resident])])
+        assert not enc2.allow_zone[0][1]
+        assert enc2.allow_zone[0][0] and enc2.allow_zone[0][2]
+
+    def test_namespace_scoping(self):
+        a = [pod("a0", {"app": "a"}, [zone_term({"app": "b"}, anti=True)])]
+        b = [Pod(name="b0", namespace="other", labels={"app": "b"},
+                 requests=Resources.parse({"cpu": "1"}))]
+        enc = apply_zone_affinity(encode_pods(a + b, self.cat), self.cat)
+        # different namespace → no conflict → no pinning
+        assert enc.allow_zone.all()
+
+
+class TestZonePositiveAffinity:
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog())
+
+    def test_resident_match_restricts_zone(self):
+        web = [pod("w0", {"app": "cache"}, [zone_term({"app": "db"})])]
+        occupancy = [("zone-b", [Pod(name="db", labels={"app": "db"})])]
+        enc = apply_zone_affinity(encode_pods(web, self.cat), self.cat,
+                                  occupancy)
+        assert np.flatnonzero(enc.allow_zone[0]).tolist() == [1]
+
+    def test_incoming_groups_co_pinned(self):
+        a = [pod(f"a{i}", {"app": "front"}, [zone_term({"app": "back"})],
+                 cpu="2") for i in range(3)]
+        b = [pod(f"b{i}", {"app": "back"}) for i in range(3)]
+        enc = apply_zone_affinity(encode_pods(a + b, self.cat), self.cat)
+        res = solve_host(self.cat, enc)
+        assert not res.unschedulable
+        zones = set()
+        for n in res.nodes:
+            zones |= set(np.flatnonzero(n.zone_mask).tolist())
+        assert len(zones) == 1  # everything in one common zone
+
+    def test_self_match_bootstrap_single_zone(self):
+        pods = [pod(f"p{i}", {"app": "ring"}, [zone_term({"app": "ring"})])
+                for i in range(4)]
+        enc = apply_zone_affinity(encode_pods(pods, self.cat), self.cat)
+        assert enc.allow_zone[0].sum() == 1
+        res = solve_host(self.cat, enc)
+        assert not res.unschedulable
+
+    def test_no_match_anywhere_unschedulable(self):
+        pods = [pod("p0", {"app": "x"}, [zone_term({"app": "nothing"})])]
+        enc = apply_zone_affinity(encode_pods(pods, self.cat), self.cat)
+        assert not enc.allow_zone[0].any()
+        res = solve_host(self.cat, enc)
+        assert sum(res.unschedulable.values()) == 1
+
+    def test_facade_end_to_end_disjoint_nomination(self):
+        solver = Solver(CatalogProvider(lambda: small_catalog()),
+                        backend="host")
+        a = [pod(f"a{i}", {"app": "a"}, [zone_term({"app": "b"}, anti=True)])
+             for i in range(2)]
+        b = [pod(f"b{i}", {"app": "b"}) for i in range(2)]
+        out = solver.solve(a + b, NodePool(name="np"))
+        assert not out.unschedulable
+        za = {l.zone for l in out.launches
+              if any(k.endswith(("a0", "a1")) for k in l.pod_keys)}
+        zb = {l.zone for l in out.launches
+              if any(k.endswith(("b0", "b1")) for k in l.pod_keys)}
+        assert za and zb and not (za & zb)
+        keys = [k for l in out.launches for k in l.pod_keys]
+        assert len(keys) == len(set(keys)) == 4
+
+    def test_no_terms_fast_path_returns_same_enc(self):
+        enc = encode_pods([pod("p0"), pod("p1", {"x": "y"})], self.cat)
+        assert apply_zone_affinity(enc, self.cat) is enc
+
+
+class TestAffinityInteractions:
+    def test_anti_greedy_respects_positive_pins(self):
+        """Groups a (anti b) processed before b must not steal the zone b
+        was co-pinned to by a positive-affinity cluster (e2e-found bug)."""
+        cat = encode_catalog(small_catalog())
+        a = [pod(f"a{i}", {"app": "a"}, [zone_term({"app": "b"}, anti=True)])
+             for i in range(2)]
+        b = [pod(f"b{i}", {"app": "b"}) for i in range(2)]
+        c = [pod(f"c{i}", {"app": "c"}, [zone_term({"app": "b"})])
+             for i in range(2)]
+        enc = apply_zone_affinity(encode_pods(a + b + c, cat), cat)
+        res = solve_host(cat, enc)
+        assert not res.unschedulable
+        zone_of = {}
+        for n in res.nodes:
+            zs = frozenset(np.flatnonzero(n.zone_mask).tolist())
+            for g in n.pods_by_group:
+                app = enc.groups[g].representative.labels["app"]
+                zone_of.setdefault(app, set()).update(zs)
+        assert not (zone_of["a"] & zone_of["b"])
+        assert zone_of["c"] <= zone_of["b"]
+
+    def test_both_pre_pinned_same_zone_one_unschedulable(self):
+        """Review finding: two groups node-selected to the same single zone
+        with required zone anti-affinity between them must not silently
+        colocate — the later group goes unschedulable."""
+        cat = encode_catalog(small_catalog())
+        sel = {L.ZONE: "zone-a"}
+        a = [Pod(name="a0", labels={"app": "a"}, node_selector=dict(sel),
+                 requests=Resources.parse({"cpu": "1"}),
+                 affinity_terms=[zone_term({"app": "b"}, anti=True)])]
+        b = [Pod(name="b0", labels={"app": "b"}, node_selector=dict(sel),
+                 requests=Resources.parse({"cpu": "1"}))]
+        enc = apply_zone_affinity(encode_pods(a + b, cat), cat)
+        res = solve_host(cat, enc)
+        assert not validate_solution(cat, enc, res)
+        assert sum(res.unschedulable.values()) == 1
+
+    def test_validate_solution_flags_zone_conflict(self):
+        cat = encode_catalog(small_catalog())
+        a = [pod("a0", {"app": "a"}, [zone_term({"app": "b"}, anti=True)])]
+        b = [pod("b0", {"app": "b"})]
+        enc = apply_zone_affinity(encode_pods(a + b, cat), cat)
+        assert enc.zone_conflict is not None
+        res = solve_host(cat, enc)
+        assert not validate_solution(cat, enc, res)
+        # force both groups' nodes into overlapping zones → audit must flag
+        for n in res.nodes:
+            n.zone_mask = np.ones(cat.Z, bool)
+        errs = validate_solution(cat, enc, res)
+        assert any("zone-conflicting" in e for e in errs), errs
+
+    def test_soft_preference_never_blocks_zone_anti(self):
+        """Review finding: a preferred family only available in the banned
+        zone must be dropped, not make the pod unschedulable."""
+        from karpenter_tpu.catalog import CatalogProvider
+        types = small_catalog()
+        prov = CatalogProvider(lambda: types)
+        solver = Solver(prov, backend="host")
+        cat0 = solver.tensors()
+        # m5 family available only in zone-a
+        for n in cat0.names:
+            if n.startswith("m5."):
+                for z in cat0.zones[1:]:
+                    for c in cat0.captypes:
+                        prov.unavailable.mark_unavailable(n, z, c,
+                                                          reason="test")
+        p = Pod(name="w0", labels={"app": "w"},
+                requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                preferred_node_affinity=[{
+                    "key": L.INSTANCE_FAMILY, "operator": "In",
+                    "values": ["m5"], "weight": 1}],
+                affinity_terms=[zone_term({"app": "resident"}, anti=True)])
+        resident = Pod(name="r", labels={"app": "resident"})
+        out = solver.solve([p], NodePool(name="np"),
+                           spread_occupancy=[("zone-a", [resident])])
+        assert not out.unschedulable
+        assert out.launches[0].zone != "zone-a"
+        assert not out.launches[0].instance_type.startswith("m5.")
+
+
+class TestOfferingAxisPreferences:
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog())
+
+    def test_zone_preference_narrows(self):
+        p = Pod(name="p0", requests=Resources.parse({"cpu": "1"}),
+                preferred_node_affinity=[{
+                    "key": L.ZONE, "operator": "In",
+                    "values": ["zone-b"], "weight": 1}])
+        enc = encode_pods([p], self.cat)
+        assert np.flatnonzero(enc.allow_zone[0]).tolist() == [1]
+        assert enc.zone_hard is not None and enc.zone_hard[0].all()
+
+    def test_captype_preference_narrows(self):
+        p = Pod(name="p0", requests=Resources.parse({"cpu": "1"}),
+                preferred_node_affinity=[{
+                    "key": L.CAPACITY_TYPE, "operator": "In",
+                    "values": ["spot"], "weight": 1}])
+        enc = encode_pods([p], self.cat)
+        assert enc.allow_cap[0].sum() == 1
+        assert enc.cap_hard is not None and enc.cap_hard[0].all()
+        res = solve_host(self.cat, enc)
+        assert not res.unschedulable
+        assert res.launches[0][2] == list(self.cat.captypes).index("spot")
+
+    def test_zone_preference_skipped_under_zone_spread(self):
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+        p = [Pod(name=f"p{i}", labels={"app": "s"},
+                 requests=Resources.parse({"cpu": "1"}),
+                 topology_spread=[TopologySpreadConstraint(
+                     topology_key=L.ZONE)],
+                 preferred_node_affinity=[{
+                     "key": L.ZONE, "operator": "In",
+                     "values": ["zone-a"], "weight": 1}])
+             for i in range(3)]
+        enc = encode_pods(p, self.cat)
+        # spread wins: the preference must not narrow the domain set
+        assert enc.allow_zone[0].all()
+
+    def test_infeasible_zone_preference_dropped(self):
+        p = Pod(name="p0", requests=Resources.parse({"cpu": "1"}),
+                preferred_node_affinity=[{
+                    "key": L.ZONE, "operator": "In",
+                    "values": ["zone-nope"], "weight": 1}])
+        enc = encode_pods([p], self.cat)
+        assert enc.allow_zone[0].all()
+        res = solve_host(self.cat, enc)
+        assert not res.unschedulable
+
+
+class TestCrossPoolSpreadOccupancy:
+    def test_spread_counts_see_earlier_pool_placements(self):
+        """Review finding: occupancy computed once per reconcile made a
+        later pool blind to claims the earlier pool just created — skew
+        could exceed maxSkew across pools."""
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+        from karpenter_tpu.sim import make_sim
+        from karpenter_tpu.models.nodepool import NodePool
+        from collections import Counter
+
+        heavy = NodePool(name="heavy", weight=10,
+                         limits=Resources.parse({"cpu": "20"}))
+        sim = make_sim(nodepool=heavy)
+        sim.store.add_nodepool(NodePool(name="light", weight=1))
+        pods = [Pod(name=f"s{i}", labels={"app": "s"},
+                    requests=Resources.parse({"cpu": "4", "memory": "1Gi"}),
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=L.ZONE, max_skew=1,
+                        label_selector={"app": "s"})])
+                for i in range(9)]
+        for p in pods:
+            sim.store.add_pod(p)
+        sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=900)
+        zones = Counter(
+            sim.store.nodes[p.node_name].labels.get(L.ZONE)
+            for p in sim.store.pods.values())
+        assert max(zones.values()) - min(zones.values()) <= 1, zones
+
+
+class TestSoftSpreadHardMasks:
+    def test_preferred_captype_does_not_collapse_soft_spread(self):
+        """Review finding: preferred capacity-type=reserved (only in one
+        zone) must not pin a ScheduleAnyway spread entirely to that zone."""
+        from karpenter_tpu.catalog import CatalogProvider
+        from karpenter_tpu.models.instancetype import Offering
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+        types = small_catalog()
+        # reserved offerings exist only in zone-a
+        for t in types:
+            t.offerings = [o for o in t.offerings
+                           if o.capacity_type != "reserved"]
+        types[0].offerings.append(Offering(
+            zone="zone-a", capacity_type="reserved", price=0.0,
+            available=True, reservation_capacity=10, reservation_id="r-1"))
+        solver = Solver(CatalogProvider(lambda: types), backend="host")
+        pods = [Pod(name=f"p{i}", labels={"app": "w"},
+                    requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=L.ZONE, max_skew=1,
+                        when_unsatisfiable="ScheduleAnyway",
+                        label_selector={"app": "w"})],
+                    preferred_node_affinity=[{
+                        "key": L.CAPACITY_TYPE, "operator": "In",
+                        "values": ["reserved"], "weight": 1}])
+                for i in range(4)]
+        out = solver.solve(pods, NodePool(name="np"))
+        assert not out.unschedulable
+        zones = {l.zone for l in out.launches}
+        assert len(zones) >= 2, zones  # spread survives the preference
+
+    def test_soft_spread_skips_zone_where_nothing_fits(self):
+        """Review finding: a zone whose compatible types are too small must
+        be excluded from a ScheduleAnyway split (fits test)."""
+        from karpenter_tpu.catalog import CatalogProvider
+        from karpenter_tpu.models.pod import TopologySpreadConstraint
+        types = small_catalog()
+        biggest = max(float(t.capacity.get("cpu", 0)) for t in types)
+        # zone-b keeps only small types: drop every offering of big types
+        for t in types:
+            if float(t.capacity.get("cpu", 0)) > 4:
+                t.offerings = [o for o in t.offerings if o.zone != "zone-b"]
+        solver = Solver(CatalogProvider(lambda: types), backend="host")
+        pods = [Pod(name=f"p{i}", labels={"app": "big"},
+                    requests=Resources.parse({"cpu": "6", "memory": "1Gi"}),
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=L.ZONE, max_skew=1,
+                        when_unsatisfiable="ScheduleAnyway",
+                        label_selector={"app": "big"})])
+                for i in range(4)]
+        out = solver.solve(pods, NodePool(name="np"))
+        assert not out.unschedulable, out.unschedulable
+        assert all(l.zone != "zone-b" for l in out.launches)

@@ -712,3 +712,28 @@ class TestSoftConstraintReviewFixes:
         assert enc.compat_hard is None  # infeasible preference never applied
         h = solve_host(cat, enc)
         assert not h.unschedulable
+
+
+class TestDecodeNomination:
+    """Regression: split groups (spread/affinity) share one PodGroup across
+    rows — _decode must draw disjoint pod slices per row, not restart the
+    cursor at every row index."""
+
+    def test_spread_split_nominates_disjoint_pods(self):
+        from karpenter_tpu.catalog import CatalogProvider
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.ops.facade import Solver
+        solver = Solver(CatalogProvider(lambda: small_catalog()),
+                        backend="host")
+        pods = [Pod(name=f"p{i}", labels={"app": "web"},
+                    requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                    topology_spread=[TopologySpreadConstraint(
+                        topology_key=L.ZONE, max_skew=1)])
+                for i in range(6)]
+        out = solver.solve(pods, NodePool(name="np"))
+        keys = [k for l in out.launches for k in l.pod_keys]
+        keys += [k for ks in out.existing_placements.values() for k in ks]
+        keys += out.unschedulable
+        assert len(keys) == 6
+        assert len(set(keys)) == 6, keys
+        assert len({l.zone for l in out.launches}) == 3
