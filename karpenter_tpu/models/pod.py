@@ -90,6 +90,32 @@ class PodAffinityTerm:
     required: bool = True
 
 
+def term_selects(term: PodAffinityTerm, same_ns: bool,
+                 labels: Dict[str, str]) -> bool:
+    """THE pod-affinity selector match (k8s LabelSelector semantics over a
+    same-namespace gate). Single definition — every consumer (zone pre-pass,
+    co-location planner, conflict matrices, resident bans) must route
+    through here so selector semantics can never diverge."""
+    return same_ns and all(labels.get(k) == v
+                           for k, v in term.label_selector.items())
+
+
+def required_anti_terms(p: "Pod", topology_key: str) -> List[PodAffinityTerm]:
+    return [t for t in p.affinity_terms
+            if t.anti and t.required and t.topology_key == topology_key]
+
+
+def anti_blocks(a: "Pod", b: "Pod", topology_key: str) -> bool:
+    """Required anti-affinity at `topology_key` forbids a and b sharing
+    that topology domain — symmetric (k8s enforces both directions),
+    same-namespace."""
+    same_ns = a.namespace == b.namespace
+    return (any(term_selects(t, same_ns, b.labels)
+                for t in required_anti_terms(a, topology_key))
+            or any(term_selects(t, same_ns, a.labels)
+                   for t in required_anti_terms(b, topology_key)))
+
+
 @dataclass
 class Pod:
     name: str

@@ -48,6 +48,7 @@ import numpy as np
 
 from ..models import labels as L
 from ..models.pod import Pod, PodAffinityTerm
+from ..models.pod import term_selects as _selects
 from .encode import (CatalogTensors, EncodedPods, build_conflicts,
                      feasible_zones)
 
@@ -57,11 +58,6 @@ Occupancy = Sequence[Tuple[Optional[str], Sequence[Pod]]]
 def _zone_terms(rep: Pod, anti: bool) -> List[PodAffinityTerm]:
     return [t for t in rep.affinity_terms
             if t.anti == anti and t.required and t.topology_key == L.ZONE]
-
-
-def _selects(term: PodAffinityTerm, ns_ok: bool, labels: Dict[str, str]) -> bool:
-    return ns_ok and all(labels.get(k) == v
-                         for k, v in term.label_selector.items())
 
 
 
@@ -191,6 +187,13 @@ def apply_zone_affinity(enc: EncodedPods, cat: CatalogTensors,
             for i in members:
                 common &= feasible_zones(enc, cat, i, allow[i])
             zs = np.flatnonzero(common)
+            if not len(zs) and allow_hard is not None:
+                # a soft zone preference must never fail a required
+                # affinity: retry the intersection on the hard rows
+                common = np.ones(cat.Z, bool)
+                for i in members:
+                    common &= feasible_zones(enc, cat, i, allow_hard[i])
+                zs = np.flatnonzero(common)
             if len(zs):
                 pin = np.zeros(cat.Z, bool)
                 pin[zs[0]] = True
@@ -225,18 +228,21 @@ def apply_zone_affinity(enc: EncodedPods, cat: CatalogTensors,
     # partners around them regardless of processing order. Two conflicting
     # groups both pre-pinned to the SAME zone cannot coexist — the later
     # one goes unschedulable rather than silently violating the term.
+    # Pinned-ness is judged on the HARD row: a soft zone preference that
+    # narrowed allow to one zone is not a pin — it can be relaxed.
     for j in range(G):
-        if not conflict[j].any() or allow[j].sum() != 1:
+        hard_j = allow[j] if allow_hard is None else allow_hard[j]
+        if not conflict[j].any() or hard_j.sum() != 1:
             continue
         partners = np.flatnonzero(conflict[j])
         taken = any(claimed[p] is not None
-                    and bool((claimed[p] & allow[j]).any())
+                    and bool((claimed[p] & hard_j).any())
                     for p in partners)
         if taken:
             set_row(j, np.zeros(cat.Z, bool))
             claimed[j] = np.zeros(cat.Z, bool)
         else:
-            claimed[j] = allow[j].copy()
+            claimed[j] = hard_j.copy()
     split_zones: Dict[int, List[int]] = {}
     for i in range(G):
         partners = np.flatnonzero(conflict[i])
@@ -244,12 +250,22 @@ def apply_zone_affinity(enc: EncodedPods, cat: CatalogTensors,
             continue
         if claimed[i] is not None and not self_anti[i]:
             continue  # pre-pinned; partners avoid its zone instead
-        eff = allow[i].copy()
-        for j in partners:
-            if claimed[j] is not None:
-                eff &= ~claimed[j]
-        feas = feasible_zones(enc, cat, i, eff)
-        zs = np.flatnonzero(feas)
+
+        def _feas(base: np.ndarray) -> np.ndarray:
+            eff = base.copy()
+            for j in partners:
+                if claimed[j] is not None:
+                    eff &= ~claimed[j]
+            return np.flatnonzero(feasible_zones(enc, cat, i, eff))
+
+        zs = _feas(allow[i])
+        need = int(enc.counts[i]) if self_anti[i] else 1
+        if len(zs) < need and allow_hard is not None and (
+                allow_hard[i] != allow[i]).any():
+            # soft preference starves the pin/split: widen to the hard
+            # row, keeping preferred zones first (prefer, never block)
+            zs = np.concatenate(
+                [zs, np.setdiff1d(_feas(allow_hard[i]), zs)])
         if self_anti[i]:
             use = zs[: int(enc.counts[i])]
             split_zones[i] = use.tolist()

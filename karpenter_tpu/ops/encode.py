@@ -29,7 +29,7 @@ import numpy as np
 
 from ..models import labels as L
 from ..models.instancetype import InstanceType
-from ..models.pod import Pod, Taint, tolerates_all
+from ..models.pod import Pod, Taint, term_selects, tolerates_all
 from ..models.requirements import (Operator, Requirement, Requirements,
                                    ValueSet, _tolerates_absence)
 from ..models.resources import Resources, num_resources, resource_axis
@@ -235,13 +235,9 @@ def build_conflicts(groups: List[PodGroup]) -> Optional[np.ndarray]:
         ri = groups[i].representative
         for j in range(i + 1, G):
             rj = groups[j].representative
-            if ri.namespace != rj.namespace:
-                continue
-            hit = (any(all(rj.labels.get(k) == v
-                           for k, v in t.label_selector.items())
-                       for t in anti[i])
-                   or any(all(ri.labels.get(k) == v
-                              for k, v in t.label_selector.items())
+            same_ns = ri.namespace == rj.namespace
+            hit = (any(term_selects(t, same_ns, rj.labels) for t in anti[i])
+                   or any(term_selects(t, same_ns, ri.labels)
                           for t in anti[j]))
             if hit:
                 conflict[i, j] = conflict[j, i] = True

@@ -24,12 +24,14 @@ from ..models import labels as L
 from ..models.instancetype import InstanceType
 from ..models.nodeclaim import NodeClaim
 from ..models.nodepool import NodeClassSpec, NodePool
-from ..models.pod import Pod
+from ..models.pod import Pod, term_selects
 from ..models.requirements import Requirements
 from ..models.resources import Resources
 from .affinity import apply_zone_affinity
 from .binpack import (SolveResult, SpreadConstraintCounts, VirtualNode,
                       solve_host, split_spread_groups, validate_solution)
+from .colocate import (BundleNode, ColocationPlan, has_colocation,
+                       plan_colocation)
 from .encode import (CatalogTensors, EncodedPods, align_resources,
                      encode_catalog, encode_pods)
 
@@ -119,28 +121,77 @@ class Solver:
         cat = self.tensors(node_class)
         if cat.T == 0 or not pods:
             return SolveOutput([], {}, [_pod_key(p) for p in pods])
-        enc = encode_pods(pods, cat,
-                          extra_requirements=nodepool.requirements,
-                          taints=nodepool.taints + nodepool.startup_taints)
+        fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())
             fits_cap = np.array(
                 [all(t.capacity.get(k, 0.0) <= v + 1e-9
                      for k, v in capacity_cap.items())
                  for t in types], bool)
+        # required positive hostname affinity: the host-side co-location
+        # planner peels coupled pods off the tensor path (ops/colocate.py)
+        plan = None
+        bundle_occupancy: List[Tuple[Optional[str], List[Pod]]] = []
+        if has_colocation(pods):
+            # the planner writes resident placements into the nodes' cum /
+            # masks so the main solve sees consumed capacity — work on
+            # copies: callers (disruption) reuse their VirtualNodes across
+            # many solves in one reconcile
+            existing = [VirtualNode(
+                type_idx=vn.type_idx, zone_mask=vn.zone_mask.copy(),
+                cap_mask=vn.cap_mask.copy(), cum=vn.cum.copy(),
+                pods_by_group=dict(vn.pods_by_group),
+                prior_by_group=dict(vn.prior_by_group),
+                banned_groups=vn.banned_groups,
+                existing_name=vn.existing_name) for vn in (existing or [])]
+            existing_pods = dict(existing_pods or {})
+            plan = plan_colocation(
+                pods, cat, extra_requirements=nodepool.requirements,
+                taints=nodepool.taints + nodepool.startup_taints,
+                existing=existing, existing_pods=existing_pods,
+                type_cap=fits_cap)
+            for name, placed in plan.existing_placements.items():
+                # planner placements count as residents for the main solve's
+                # per-node caps and occupancy
+                existing_pods[name] = list(existing_pods.get(name, [])) + placed
+            # pin each bundle to its concrete zone NOW so bundle pods are
+            # visible to the zone-affinity pre-pass and topology-spread
+            # domain counts of the same solve (a deferred zone cannot feed
+            # either); launch keeps the cheapest offering within the pin
+            for b in plan.bundles:
+                zi = self._pin_bundle_zone(b, cat)
+                bundle_occupancy.append((cat.zones[zi], b.pods))
+            pods = plan.remaining
+            if not pods:
+                out = SolveOutput([], {}, [])
+                return self._merge_plan(out, plan, cat, nodepool)
+        enc = encode_pods(pods, cat,
+                          extra_requirements=nodepool.requirements,
+                          taints=nodepool.taints + nodepool.startup_taints)
+        if fits_cap is not None:
             enc.compat &= fits_cap[None, :]
             if enc.compat_hard is not None:
                 enc.compat_hard = enc.compat_hard & fits_cap[None, :]
         # pods dropped by the taint filter are unschedulable for this pool
         enc_keys = {_pod_key(p) for g in enc.groups for p in g.pods}
         dropped = [_pod_key(p) for p in pods if _pod_key(p) not in enc_keys]
-        occupancy = (spread_occupancy if spread_occupancy is not None
+        occupancy = (list(spread_occupancy) if spread_occupancy is not None
                      else self._occupancy_from_existing(existing, existing_pods, cat))
+        if plan is not None:
+            occupancy += bundle_occupancy
+            if spread_occupancy is not None:
+                # a caller-supplied cluster view predates the planner's
+                # resident placements — append them (new pods only; the
+                # resident pods themselves are already in the view)
+                occupancy += [
+                    (self._zone_of(name, existing, cat), placed)
+                    for name, placed in plan.existing_placements.items()]
         enc = apply_zone_affinity(enc, cat, occupancy)
         enc = split_spread_groups(
             enc, cat, self._spread_constraints(enc, cat, occupancy))
         if enc.G == 0:
-            return SolveOutput([], {}, dropped)
+            return self._merge_plan(SolveOutput([], {}, dropped), plan,
+                                    cat, nodepool)
         self._relax_infeasible_preferences(enc, cat)
 
         if existing and existing_pods:
@@ -180,7 +231,39 @@ class Solver:
         SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=self.backend)
         SOLVE_PODS.observe(float(enc.counts.sum()))
 
-        return self._decode(cat, enc, result, nodepool, dropped)
+        out = self._decode(cat, enc, result, nodepool, dropped)
+        return self._merge_plan(out, plan, cat, nodepool)
+
+    def _merge_plan(self, out: SolveOutput, plan: Optional[ColocationPlan],
+                    cat: CatalogTensors, nodepool: NodePool) -> SolveOutput:
+        """Fold the co-location planner's decisions into a SolveOutput:
+        bundle nodes become NodeLaunches (cheapest surviving offering +
+        price-sorted overrides, same launch contract as solver nodes)."""
+        if plan is None:
+            return out
+        for b in plan.bundles:
+            vn = VirtualNode(type_idx=b.type_idx, zone_mask=b.zone_mask,
+                             cap_mask=b.cap_mask, cum=b.cum)
+            masked = np.where(
+                b.zone_mask[:, None] & b.cap_mask[None, :]
+                & cat.available[b.type_idx],
+                cat.price[b.type_idx], np.inf)
+            zi, ci = np.unravel_index(np.argmin(masked), masked.shape)
+            reqs = Resources()
+            for p in b.pods:
+                reqs = reqs.add(p.requests)
+            out.launches.append(NodeLaunch(
+                instance_type=cat.names[b.type_idx], zone=cat.zones[int(zi)],
+                capacity_type=cat.captypes[int(ci)],
+                price=float(masked[zi, ci]),
+                overrides=self._overrides(cat, vn, b.group_compat),
+                pod_keys=[_pod_key(p) for p in b.pods], requests=reqs,
+                labels=self._node_labels(cat, vn, nodepool)))
+        for name, placed in plan.existing_placements.items():
+            keys = out.existing_placements.setdefault(name, [])
+            keys.extend(_pod_key(p) for p in placed)
+        out.unschedulable.extend(_pod_key(p) for p in plan.unschedulable)
+        return out
 
     @staticmethod
     def _spread_constraints(enc: EncodedPods, cat: CatalogTensors,
@@ -306,18 +389,40 @@ class Solver:
             for gi, grp in enumerate(enc.groups):
                 rep = grp.representative
                 for p, p_terms in res_anti:
-                    if p.namespace != rep.namespace:
-                        continue
-                    if any(all(p.labels.get(k) == v
-                               for k, v in t.label_selector.items())
+                    same_ns = p.namespace == rep.namespace
+                    if any(term_selects(t, same_ns, p.labels)
                            for t in hostname_anti[gi]) or \
-                       any(all(rep.labels.get(k) == v
-                               for k, v in t.label_selector.items())
+                       any(term_selects(t, same_ns, rep.labels)
                            for t in p_terms):
                         banned[gi] = True
                         break
             if banned.any():
                 vn.banned_groups = banned
+
+    @staticmethod
+    def _pin_bundle_zone(b: BundleNode, cat: CatalogTensors) -> int:
+        """Narrow a bundle's deferred zone mask to its cheapest available
+        zone; returns the zone index."""
+        masked = np.where(
+            b.zone_mask[:, None] & b.cap_mask[None, :]
+            & cat.available[b.type_idx],
+            cat.price[b.type_idx], np.inf)
+        if np.isinf(masked).all():  # offerings vanished mid-solve: keep mask
+            return int(np.flatnonzero(b.zone_mask)[0])
+        zi = int(np.unravel_index(np.argmin(masked), masked.shape)[0])
+        pin = np.zeros(cat.Z, bool)
+        pin[zi] = True
+        b.zone_mask = pin
+        return zi
+
+    @staticmethod
+    def _zone_of(name: str, existing: Optional[List[VirtualNode]],
+                 cat: CatalogTensors) -> Optional[str]:
+        for vn in existing or []:
+            if vn.existing_name == name:
+                zs = np.flatnonzero(vn.zone_mask)
+                return cat.zones[int(zs[0])] if len(zs) == 1 else None
+        return None
 
     @staticmethod
     def _occupancy_from_existing(existing: Optional[List[VirtualNode]],

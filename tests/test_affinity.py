@@ -210,6 +210,33 @@ class TestAffinityInteractions:
         errs = validate_solution(cat, enc, res)
         assert any("zone-conflicting" in e for e in errs), errs
 
+    def test_soft_zone_pref_not_treated_as_hard_pin(self):
+        """Review finding: a soft zone preference narrowing a group to one
+        zone must not pre-pin it — the conflicting hard-pinned group keeps
+        the zone and the soft group relaxes elsewhere."""
+        cat = encode_catalog(small_catalog())
+        a = [Pod(name="a0", labels={"app": "a"},
+                 node_selector={L.ZONE: "zone-a"},
+                 requests=Resources.parse({"cpu": "1"}),
+                 affinity_terms=[zone_term({"app": "b"}, anti=True)])]
+        b = [Pod(name="b0", labels={"app": "b"},
+                 requests=Resources.parse({"cpu": "1"}),
+                 preferred_node_affinity=[{
+                     "key": L.ZONE, "operator": "In",
+                     "values": ["zone-a"], "weight": 1}])]
+        for order in (a + b, b + a):
+            enc = apply_zone_affinity(encode_pods(order, cat), cat)
+            res = solve_host(cat, enc)
+            assert not res.unschedulable, order[0].name
+            zone_of = {}
+            for n in res.nodes:
+                zs = set(np.flatnonzero(n.zone_mask).tolist())
+                for g in n.pods_by_group:
+                    app = enc.groups[g].representative.labels["app"]
+                    zone_of.setdefault(app, set()).update(zs)
+            assert zone_of["a"] == {0}
+            assert 0 not in zone_of["b"], zone_of
+
     def test_soft_preference_never_blocks_zone_anti(self):
         """Review finding: a preferred family only available in the banned
         zone must be dropped, not make the pod unschedulable."""

@@ -1,0 +1,339 @@
+"""Hostname-level required positive pod affinity — the co-location planner.
+
+Reference behavior: the core scheduler's required podAffinity handling at
+topology_key=hostname (scheduling.md), including the first-pod bootstrap.
+Zone-level terms are covered in test_affinity.py.
+"""
+
+import numpy as np
+
+from karpenter_tpu.catalog import CatalogProvider, small_catalog
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.ops.binpack import VirtualNode
+from karpenter_tpu.ops.colocate import has_colocation, plan_colocation
+from karpenter_tpu.ops.encode import encode_catalog
+from karpenter_tpu.ops.facade import Solver
+
+
+def pod(name, labels=None, terms=(), cpu="1", mem="1Gi", ns="default"):
+    return Pod(name=name, namespace=ns, labels=labels or {},
+               requests=Resources.parse({"cpu": cpu, "memory": mem}),
+               affinity_terms=list(terms))
+
+
+def host_term(selector):
+    return PodAffinityTerm(topology_key=L.HOSTNAME, label_selector=selector)
+
+
+def solver():
+    return Solver(CatalogProvider(lambda: small_catalog()), backend="host")
+
+
+def all_keys(out):
+    keys = [k for l in out.launches for k in l.pod_keys]
+    keys += [k for ks in out.existing_placements.values() for k in ks]
+    keys += out.unschedulable
+    return keys
+
+
+class TestSelfColocation:
+    def test_self_match_packs_one_node(self):
+        s = solver()
+        pods = [pod(f"p{i}", {"app": "ring"}, [host_term({"app": "ring"})])
+                for i in range(4)]
+        out = s.solve(pods, NodePool(name="np"))
+        assert not out.unschedulable
+        assert len(out.launches) == 1
+        assert len(out.launches[0].pod_keys) == 4
+
+    def test_self_match_excess_unschedulable(self):
+        # more pods than any single type can hold → one full node, rest pend
+        s = solver()
+        cat = s.tensors()
+        max_cpu = int(cat.allocatable[:, 0].max())
+        pods = [pod(f"p{i}", {"app": "ring"}, [host_term({"app": "ring"})])
+                for i in range(max_cpu + 5)]
+        out = s.solve(pods, NodePool(name="np"))
+        assert len(out.launches) == 1
+        fit = len(out.launches[0].pod_keys)
+        assert fit >= 1
+        assert len(out.unschedulable) == max_cpu + 5 - fit
+        # the one-shot node prefers the max-slot type
+        assert fit == max(
+            int(cat.allocatable[i, 0]) for i in range(cat.T))
+
+
+class TestCrossGroupColocation:
+    def test_initiator_rides_with_target(self):
+        s = solver()
+        web = [pod(f"w{i}", {"app": "web"}, [host_term({"app": "cache"})])
+               for i in range(3)]
+        cache = [pod(f"c{i}", {"app": "cache"}) for i in range(2)]
+        out = s.solve(web + cache, NodePool(name="np"))
+        assert not out.unschedulable
+        # every node hosting a web pod also hosts a cache pod
+        for l in out.launches:
+            if any(k.endswith(("w0", "w1", "w2")) for k in l.pod_keys):
+                assert any(k.endswith(("c0", "c1")) for k in l.pod_keys), l.pod_keys
+        keys = all_keys(out)
+        assert len(keys) == len(set(keys)) == 5
+
+    def test_targets_exhausted_excess_unschedulable(self):
+        # each bundle node needs one cache pod; only one exists and the node
+        # can't hold every web pod → leftovers have no matching node
+        s = solver()
+        cat = s.tensors()
+        max_cpu = int(cat.allocatable[:, 0].max())
+        web = [pod(f"w{i}", {"app": "web"}, [host_term({"app": "cache"})])
+               for i in range(max_cpu + 4)]
+        cache = [pod("c0", {"app": "cache"})]
+        out = s.solve(web + cache, NodePool(name="np"))
+        assert len(out.launches) == 1
+        assert out.unschedulable  # web pods beyond the single bundle node
+
+    def test_no_match_anywhere_unschedulable(self):
+        s = solver()
+        pods = [pod("p0", {"app": "x"}, [host_term({"app": "missing"})])]
+        out = s.solve(pods, NodePool(name="np"))
+        assert out.unschedulable == ["default/p0"]
+        assert not out.launches
+
+    def test_namespace_scoped_matching(self):
+        s = solver()
+        web = [pod("w0", {"app": "web"}, [host_term({"app": "cache"})])]
+        cache = [pod("c0", {"app": "cache"}, ns="other")]
+        out = s.solve(web + cache, NodePool(name="np"))
+        # cross-namespace labels don't match → web unschedulable, cache fine
+        assert out.unschedulable == ["default/w0"]
+        placed = [k for l in out.launches for k in l.pod_keys]
+        assert placed == ["other/c0"]
+
+    def test_two_terms_need_both_targets(self):
+        s = solver()
+        app = [pod("a0", {"app": "app"},
+                   [host_term({"app": "db"}), host_term({"app": "cache"})])]
+        db = [pod("d0", {"app": "db"})]
+        cache = [pod("c0", {"app": "cache"})]
+        out = s.solve(app + db + cache, NodePool(name="np"))
+        assert not out.unschedulable
+        bundle = next(l for l in out.launches
+                      if "default/a0" in l.pod_keys)
+        assert "default/d0" in bundle.pod_keys
+        assert "default/c0" in bundle.pod_keys
+
+
+class TestResidentColocation:
+    def _existing(self, s, n_pods_cpu=2):
+        cat = s.tensors()
+        # commit a roomy existing node
+        t = int(np.argmax(cat.allocatable[:, 0]))
+        vn = VirtualNode(type_idx=t, zone_mask=np.ones(cat.Z, bool),
+                         cap_mask=np.ones(cat.C, bool),
+                         cum=np.zeros(len(cat.resources), np.float32),
+                         existing_name="node-1")
+        return cat, vn
+
+    def test_resident_match_places_on_node(self):
+        s = solver()
+        cat, vn = self._existing(s)
+        resident = Pod(name="db0", labels={"app": "db"})
+        web = [pod(f"w{i}", {"app": "web"}, [host_term({"app": "db"})])
+               for i in range(2)]
+        out = s.solve(web, NodePool(name="np"), existing=[vn],
+                      existing_pods={"node-1": [resident]})
+        assert not out.unschedulable
+        assert not out.launches
+        assert sorted(out.existing_placements["node-1"]) == [
+            "default/w0", "default/w1"]
+
+    def test_resident_full_no_target_unschedulable(self):
+        s = solver()
+        cat = s.tensors()
+        # tiny committed node: full after cum is set to its capacity
+        t = int(np.argmin(np.where(cat.allocatable[:, 0] > 0,
+                                   cat.allocatable[:, 0], np.inf)))
+        cum = cat.allocatable[t].copy()
+        vn = VirtualNode(type_idx=t, zone_mask=np.ones(cat.Z, bool),
+                         cap_mask=np.ones(cat.C, bool), cum=cum,
+                         existing_name="node-1")
+        resident = Pod(name="db0", labels={"app": "db"})
+        web = [pod("w0", {"app": "web"}, [host_term({"app": "db"})])]
+        out = s.solve(web, NodePool(name="np"), existing=[vn],
+                      existing_pods={"node-1": [resident]})
+        # the only matching node is full and no pending target exists
+        assert out.unschedulable == ["default/w0"]
+
+    def test_plan_mutates_existing_cum(self):
+        cat = encode_catalog(small_catalog())
+        t = int(np.argmax(cat.allocatable[:, 0]))
+        vn = VirtualNode(type_idx=t, zone_mask=np.ones(cat.Z, bool),
+                         cap_mask=np.ones(cat.C, bool),
+                         cum=np.zeros(len(cat.resources), np.float32),
+                         existing_name="node-1")
+        resident = Pod(name="db0", labels={"app": "db"})
+        web = [pod("w0", {"app": "web"}, [host_term({"app": "db"})],
+                   cpu="2", mem="2Gi")]
+        plan = plan_colocation(web, cat, existing=[vn],
+                               existing_pods={"node-1": [resident]})
+        assert plan.existing_placements["node-1"][0].name == "w0"
+        assert vn.cum[0] == 2.0  # the main solve sees the consumed capacity
+
+
+class TestPlannerUnit:
+    def test_fast_path_no_terms(self):
+        cat = encode_catalog(small_catalog())
+        pods = [pod("p0"), pod("p1")]
+        assert not has_colocation(pods)
+        plan = plan_colocation(pods, cat)
+        assert plan.remaining == pods
+        assert not plan.bundles and not plan.unschedulable
+
+    def test_uncoupled_pods_stay_on_tensor_path(self):
+        s = solver()
+        ring = [pod(f"r{i}", {"app": "ring"}, [host_term({"app": "ring"})])
+                for i in range(2)]
+        plain = [pod(f"q{i}", {"app": "plain"}, cpu="2") for i in range(5)]
+        out = s.solve(ring + plain, NodePool(name="np"))
+        assert not out.unschedulable
+        keys = all_keys(out)
+        assert len(keys) == len(set(keys)) == 7
+
+    def test_bundle_respects_target_only_resources(self):
+        """Review finding: a target pod's request in a resource dim the
+        initiator doesn't touch must still gate the bundle's type choice."""
+        from karpenter_tpu.catalog import GeneratorConfig, generate_catalog
+        types = [t for t in generate_catalog(GeneratorConfig(
+            zones=("zone-a",), families=["c5", "g5"]))]
+        s = Solver(CatalogProvider(lambda: types), backend="host")
+        web = [pod("w0", {"app": "web"}, [host_term({"app": "gpu"})])]
+        gpu = Pod(name="g0", labels={"app": "gpu"},
+                  requests=Resources.parse({"cpu": "1", "memory": "1Gi",
+                                            "accel/tpu": "1"}))
+        out = s.solve([gpu] + web, NodePool(name="np"))
+        if out.launches:
+            bundle = next((l for l in out.launches
+                           if "default/w0" in l.pod_keys), None)
+            if bundle is not None and "default/g0" in bundle.pod_keys:
+                t = next(t for t in types if t.name == bundle.instance_type)
+                assert t.allocatable().get("accel/tpu") >= 1, bundle.instance_type
+
+    def test_self_anti_caps_bundle_at_one_per_node(self):
+        """Review finding: positive affinity to a target plus required
+        self-anti-affinity (one-per-node sidecar) must not pack several
+        initiator pods onto one bundle node."""
+        from karpenter_tpu.models.pod import PodAffinityTerm
+        anti = PodAffinityTerm(topology_key=L.HOSTNAME,
+                               label_selector={"app": "sidecar"}, anti=True)
+        s = solver()
+        side = [pod(f"s{i}", {"app": "sidecar"},
+                    [host_term({"app": "db"}), anti]) for i in range(3)]
+        db = [pod(f"d{i}", {"app": "db"}) for i in range(3)]
+        out = s.solve(side + db, NodePool(name="np"))
+        for l in out.launches:
+            n_side = sum(1 for k in l.pod_keys if "/s" in k)
+            assert n_side <= 1, l.pod_keys
+
+    def test_resident_anti_repels_despite_match(self):
+        """Review finding: a node hosting the affinity match AND a pod the
+        group's anti-affinity selects must be skipped, not filled."""
+        from karpenter_tpu.models.pod import PodAffinityTerm
+        s = solver()
+        cat = s.tensors()
+        t = int(np.argmax(cat.allocatable[:, 0]))
+        vn = VirtualNode(type_idx=t, zone_mask=np.ones(cat.Z, bool),
+                         cap_mask=np.ones(cat.C, bool),
+                         cum=np.zeros(len(cat.resources), np.float32),
+                         existing_name="node-1")
+        residents = [Pod(name="db0", labels={"app": "db"}),
+                     Pod(name="noisy", labels={"app": "noisy"})]
+        anti = PodAffinityTerm(topology_key=L.HOSTNAME,
+                               label_selector={"app": "noisy"}, anti=True)
+        web = [pod("w0", {"app": "web"}, [host_term({"app": "db"}), anti])]
+        out = s.solve(web, NodePool(name="np"), existing=[vn],
+                      existing_pods={"node-1": residents})
+        assert "node-1" not in out.existing_placements
+        assert out.unschedulable == ["default/w0"]  # only match is repelled
+
+    def test_consumed_target_own_terms_validated(self):
+        """Review finding: a target with its OWN required positive term must
+        not be consumed into a bundle that doesn't satisfy it."""
+        s = solver()
+        # a requires b; b requires c (a resident nowhere) → b unusable as
+        # a's target unless c rides along; c is absent → both unschedulable
+        a = [pod("a0", {"app": "a"}, [host_term({"app": "b"})])]
+        b = [pod("b0", {"app": "b"}, [host_term({"app": "c"})])]
+        out = s.solve(a + b, NodePool(name="np"))
+        assert sorted(out.unschedulable) == ["default/a0", "default/b0"]
+        # chain closes when c exists: one bundle hosts all three
+        c = [pod("c0", {"app": "c"})]
+        out2 = s.solve(a + b + c, NodePool(name="np"))
+        assert not out2.unschedulable
+
+    def test_later_initiator_joins_opened_bundle(self):
+        """A bigger group b (processed first, FFD) bundles with c; a's
+        target b is then fully consumed — a must join b's node, not pend."""
+        s = solver()
+        b = [pod("b0", {"app": "b"}, [host_term({"app": "c"})],
+                 cpu="4", mem="4Gi")]
+        c = [pod("c0", {"app": "c"})]
+        a = [pod("a0", {"app": "a"}, [host_term({"app": "b"})])]
+        out = s.solve(a + b + c, NodePool(name="np"))
+        assert not out.unschedulable
+        bundle = next(l for l in out.launches if "default/b0" in l.pod_keys)
+        assert "default/a0" in bundle.pod_keys
+
+    def test_bundle_visible_to_zone_anti_affinity(self):
+        """Review finding: a required zone anti-affinity term against pods
+        the planner consumed into a bundle must still hold — bundle zones
+        pin early and feed the zone pre-pass as occupancy."""
+        from karpenter_tpu.models.pod import PodAffinityTerm
+        zone_anti = PodAffinityTerm(topology_key=L.ZONE,
+                                    label_selector={"app": "b"}, anti=True)
+        s = solver()
+        b = [pod("b0", {"app": "b"}, [host_term({"app": "c"})])]
+        c = [pod("c0", {"app": "c"})]
+        a = [pod("a0", {"app": "a"}, [zone_anti])]
+        out = s.solve(a + b + c, NodePool(name="np"))
+        assert not out.unschedulable
+        bundle = next(l for l in out.launches if "default/b0" in l.pod_keys)
+        a_launch = next(l for l in out.launches if "default/a0" in l.pod_keys)
+        assert a_launch.zone != bundle.zone, (a_launch.zone, bundle.zone)
+
+    def test_solve_does_not_mutate_caller_nodes(self):
+        """Review finding: the planner's resident placements must not leak
+        into the caller's VirtualNodes (disruption reuses them per solve)."""
+        s = solver()
+        cat = s.tensors()
+        t = int(np.argmax(cat.allocatable[:, 0]))
+        vn = VirtualNode(type_idx=t, zone_mask=np.ones(cat.Z, bool),
+                         cap_mask=np.ones(cat.C, bool),
+                         cum=np.zeros(len(cat.resources), np.float32),
+                         existing_name="node-1")
+        resident = Pod(name="db0", labels={"app": "db"})
+        web = [pod("w0", {"app": "web"}, [host_term({"app": "db"})])]
+        out = s.solve(web, NodePool(name="np"), existing=[vn],
+                      existing_pods={"node-1": [resident]})
+        assert out.existing_placements["node-1"] == ["default/w0"]
+        assert vn.cum.sum() == 0.0, vn.cum
+        assert vn.zone_mask.all() and vn.cap_mask.all()
+
+    def test_mixed_backends_agree(self):
+        import karpenter_tpu.ops.native as native
+        if not native.available():
+            return
+        web = [pod(f"w{i}", {"app": "web"}, [host_term({"app": "cache"})])
+               for i in range(3)]
+        cache = [pod(f"c{i}", {"app": "cache"}) for i in range(2)]
+        plain = [pod(f"q{i}", cpu="2") for i in range(4)]
+        outs = {}
+        for backend in ("host", "native"):
+            s = Solver(CatalogProvider(lambda: small_catalog()),
+                       backend=backend)
+            out = s.solve(web + cache + plain, NodePool(name="np"))
+            outs[backend] = sorted(
+                (l.instance_type, tuple(sorted(l.pod_keys)))
+                for l in out.launches)
+        assert outs["host"] == outs["native"]
