@@ -22,7 +22,20 @@ from ..models.nodeclaim import Node
 from ..models.resources import Resources
 from ..utils.clock import Clock, RealClock
 from .provider import (CloudError, Instance, InsufficientCapacityError,
-                       LaunchRequest, NotFoundError, RateLimitedError)
+                       LaunchRequest, NetworkGroup, NodeProfile,
+                       NotFoundError, RateLimitedError, UnauthorizedError)
+
+
+def default_network_groups() -> List[NetworkGroup]:
+    return [
+        NetworkGroup(id="ng-default", name="default",
+                     tags={"karpenter.tpu/discovery": "my-cluster"}),
+        NetworkGroup(id="ng-nodes", name="cluster-nodes",
+                     tags={"karpenter.tpu/discovery": "my-cluster",
+                           "role": "node"}),
+        NetworkGroup(id="ng-restricted", name="restricted",
+                     tags={"env": "prod"}),
+    ]
 
 _ids = itertools.count(1)
 
@@ -77,6 +90,9 @@ class FakeCloud:
         self.unhealthy: set = set()  # instance ids with a dead kubelet
         from .image import default_images
         self.images = default_images(self.clock.now())
+        self.network_groups: Dict[str, NetworkGroup] = {
+            g.id: g for g in default_network_groups()}
+        self.profiles: Dict[str, NodeProfile] = {}
 
     # --- capacity pool control (tests / chaos) ---
     def set_capacity(self, instance_type: str, zone: str, capacity_type: str,
@@ -106,6 +122,14 @@ class FakeCloud:
         return out
 
     def _launch_one(self, req: LaunchRequest) -> "Instance | CloudError":
+        # authorization/validity gates before capacity (reference: RunInstances
+        # rejects unknown SGs / instance profiles before placement)
+        for ng in req.network_groups:
+            if ng not in self.network_groups:
+                return NotFoundError(f"network group {ng} not found")
+        if req.profile and req.profile not in self.profiles:
+            return UnauthorizedError(
+                f"node profile {req.profile} does not exist")
         exhausted = []
         # lowest-price strategy over the override list
         for ov in sorted(req.overrides, key=lambda o: o.price):
@@ -124,7 +148,9 @@ class FakeCloud:
                 image_id=req.image_id, state="pending",
                 launch_time=self.clock.now(), tags=dict(req.tags),
                 price=ov.price, nodeclaim=req.nodeclaim_name,
-                reservation_id=ov.reservation_id)
+                reservation_id=ov.reservation_id,
+                network_groups=list(req.network_groups),
+                profile=req.profile)
             self.instances[inst.id] = inst
             return inst
         return InsufficientCapacityError(exhausted or
@@ -147,6 +173,27 @@ class FakeCloud:
     def describe_images(self):
         """DescribeImages analog — the image provider's backend."""
         return list(self.images)
+
+    def describe_network_groups(self) -> List[NetworkGroup]:
+        """DescribeSecurityGroups analog — the netgroup resolver's backend."""
+        return list(self.network_groups.values())
+
+    # --- node profile API (IAM CreateInstanceProfile/Delete analog) ---
+    def create_profile(self, name: str, role: str) -> NodeProfile:
+        if name in self.profiles:
+            from .provider import AlreadyExistsError
+            raise AlreadyExistsError(name)
+        p = NodeProfile(name=name, role=role, created_at=self.clock.now())
+        self.profiles[name] = p
+        return p
+
+    def delete_profile(self, name: str) -> None:
+        if name not in self.profiles:
+            raise NotFoundError(name)
+        del self.profiles[name]
+
+    def describe_profiles(self) -> List[NodeProfile]:
+        return list(self.profiles.values())
 
     def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]:
         self.api_calls["describe"] += 1

@@ -30,6 +30,12 @@ class LaunchRequest:
     image_id: str = "img-default"
     user_data: str = ""
     tags: Dict[str, str] = field(default_factory=dict)
+    # network groups attached to the instance's interfaces (the security-
+    # group analog; reference: launch templates carry the NodeClass's
+    # resolved SGs) and the identity profile it boots with (the IAM
+    # instance-profile analog, reference spec.role/spec.instanceProfile)
+    network_groups: List[str] = field(default_factory=list)
+    profile: str = ""
 
 
 @dataclass
@@ -45,10 +51,34 @@ class Instance:
     price: float = 0.0
     nodeclaim: str = ""
     reservation_id: Optional[str] = None
+    network_groups: List[str] = field(default_factory=list)
+    profile: str = ""
 
     @property
     def provider_id(self) -> str:
         return f"tpu:///{self.zone}/{self.id}"
+
+
+@dataclass
+class NetworkGroup:
+    """Security-group analog (reference pkg/providers/securitygroup):
+    a named firewall/connectivity group instances attach to, discovered by
+    id/name/tag selector terms."""
+
+    id: str
+    name: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeProfile:
+    """IAM instance-profile analog (reference pkg/providers/
+    instanceprofile): a managed identity binding a role to instances."""
+
+    name: str
+    role: str
+    created_at: float = 0.0
+    tags: Dict[str, str] = field(default_factory=dict)
 
 
 # --- error taxonomy (reference pkg/errors/errors.go:68-227) ---

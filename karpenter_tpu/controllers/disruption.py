@@ -160,7 +160,8 @@ class DisruptionController:
     def _is_drifted(self, v: NodeView, node_class) -> bool:
         """Drift reasons (reference drift.go:35-76): static nodeclass-hash
         mismatch; node image no longer in the resolved image set; node zone
-        no longer in the resolved zones."""
+        no longer in the resolved zones; node network-group set diverged
+        from the resolved set (the security-group drift reason)."""
         if node_class is None:
             return False
         stamped = v.claim.annotations.get("karpenter.tpu/nodeclass-hash")
@@ -171,6 +172,10 @@ class DisruptionController:
             return True
         if (node_class.resolved_zones and v.claim.zone
                 and v.claim.zone not in node_class.resolved_zones):
+            return True
+        if (node_class.resolved_network_groups and v.claim.network_groups
+                and set(v.claim.network_groups)
+                != set(node_class.resolved_network_groups)):
             return True
         return False
 

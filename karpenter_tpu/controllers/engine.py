@@ -26,6 +26,10 @@ class Engine:
     clock: object
     controllers: List[Controller] = field(default_factory=list)
     hooks: List[Callable[[float], None]] = field(default_factory=list)
+    # optional utils.leaderelection.Elector: controllers reconcile only
+    # while this replica holds the lease (hooks still run — they model the
+    # environment, not the controller plane)
+    elector: Optional[object] = None
     _next_run: Dict[str, float] = field(default_factory=dict)
 
     def add(self, *controllers: Controller) -> "Engine":
@@ -41,6 +45,12 @@ class Engine:
         now = self.clock.now()
         for fn in self.hooks:
             fn(now)
+        if self.elector is not None:
+            if now >= self._next_run.get(self.elector.name, 0.0):
+                self._next_run[self.elector.name] = (
+                    now + max(0.0, self.elector.reconcile(now)))
+            if not self.elector.is_leader():
+                return
         for c in self.controllers:
             if now >= self._next_run.get(c.name, 0.0):
                 requeue = c.reconcile(now)

@@ -228,7 +228,9 @@ class Provisioner:
                 image_id=(node_class.resolved_images[0]
                           if node_class.resolved_images else "img-default"),
                 user_data=self._user_data(pool, node_class, launch),
-                tags={**node_class.tags, "karpenter.tpu/nodepool": pool.name}))
+                tags={**node_class.tags, "karpenter.tpu/nodepool": pool.name},
+                network_groups=list(node_class.resolved_network_groups),
+                profile=node_class.resolved_profile))
         results = self.cloud.create_fleet(requests)
 
         launched: List[NodeClaim] = []
@@ -243,6 +245,8 @@ class Provisioner:
                 claim.price = res.price
                 claim.launched_at = now
                 claim.image_id = res.image_id
+                claim.network_groups = list(res.network_groups)
+                claim.profile = res.profile
                 itype = next((t for t in self.catalog.list(node_class)
                               if t.name == res.instance_type), None)
                 if itype is not None:

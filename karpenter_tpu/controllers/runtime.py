@@ -25,6 +25,11 @@ class Runtime:
     clock: object = field(default_factory=RealClock)
     controllers: List[object] = field(default_factory=list)
     metrics_port: int = 0  # 0 = no endpoint
+    # optional utils.leaderelection.Elector: controllers reconcile only
+    # while this replica leads; the standby keeps serving metrics and
+    # retrying the lease (reference: controller-runtime leader election,
+    # 2-replica Helm chart)
+    elector: Optional[object] = None
     _stop: Optional[asyncio.Event] = None
     _server: object = None
 
@@ -32,8 +37,33 @@ class Runtime:
         self.controllers.extend(controllers)
         return self
 
+    async def _run_elector(self) -> None:
+        # release in finally: start() cancels this task on shutdown, so the
+        # loop usually exits via CancelledError, not the while condition —
+        # the clean lease handover must survive both paths
+        try:
+            while not self._stop.is_set():
+                try:
+                    self.elector.tick(self.clock.now())
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+                try:
+                    await asyncio.wait_for(self._stop.wait(),
+                                           timeout=self.elector.retry_period)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self.elector.release(self.clock.now())
+
     async def _run_controller(self, c) -> None:
         while not self._stop.is_set():
+            if self.elector is not None and not self.elector.is_leader():
+                try:
+                    await asyncio.wait_for(self._stop.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
             try:
                 requeue = c.reconcile(self.clock.now())
             except Exception as e:  # a crashing controller must not die silently
@@ -66,6 +96,8 @@ class Runtime:
             await self._serve_metrics()
         tasks = [asyncio.create_task(self._run_controller(c))
                  for c in self.controllers]
+        if self.elector is not None:
+            tasks.append(asyncio.create_task(self._run_elector()))
         await self._stop.wait()
         for t in tasks:
             t.cancel()

@@ -13,6 +13,7 @@ real TPU-cloud backend implements the same CloudProvider protocol +
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import List, Optional
 
 from .catalog.generator import GeneratorConfig, generate_catalog
@@ -81,7 +82,22 @@ def build_operator(options: Optional[Options] = None,
             store=store, cloud=cloud, catalog=catalog,
             termination=termination))
 
-    runtime = Runtime(clock=clock, metrics_port=opts.metrics_port)
+    elector = None
+    # empty lease path disables election even when the flag is on (the
+    # options docstring promises this; a FileLeaseBackend("") would fail
+    # every write and leave the replica permanently standby)
+    if opts.leader_elect and opts.leader_elect_lease_file:
+        import socket
+        from .utils.leaderelection import Elector, FileLeaseBackend
+        os_dir = os.path.dirname(opts.leader_elect_lease_file)
+        if os_dir:
+            os.makedirs(os_dir, exist_ok=True)
+        elector = Elector(
+            backend=FileLeaseBackend(opts.leader_elect_lease_file),
+            identity=opts.leader_elect_identity
+            or f"{socket.gethostname()}-{os.getpid()}")
+    runtime = Runtime(clock=clock, metrics_port=opts.metrics_port,
+                      elector=elector)
     runtime.add(*controllers)
 
     class _CloudTicker:

@@ -67,6 +67,8 @@ class NodeClaim:
     allocatable: Resources = field(default_factory=Resources)
     node_name: Optional[str] = None
     image_id: Optional[str] = None
+    network_groups: List[str] = field(default_factory=list)
+    profile: str = ""
     conditions: Dict[str, Condition] = field(default_factory=dict)
     created_at: float = 0.0
     launched_at: float = 0.0

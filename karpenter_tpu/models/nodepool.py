@@ -69,6 +69,15 @@ class NodeClassSpec:
     zones: List[str] = field(default_factory=list)  # empty = all discovered
     image_family: str = "standard"  # bootstrap/image strategy selector
     image_selector: Dict[str, str] = field(default_factory=dict)
+    # security-group analog: selector terms ({id}|{name}|{tag:val...}, OR'd)
+    # resolved by the nodeclass controller; empty = the cloud's "default"
+    # named group (the reference REQUIRES explicit terms; our abstract
+    # cloud ships a default so zero-config clusters work)
+    network_group_selectors: List[Dict[str, str]] = field(default_factory=list)
+    # instance-profile analog: role → managed profile, or an explicit
+    # pre-existing profile name (reference spec.role vs spec.instanceProfile)
+    role: str = "default-node-role"
+    node_profile: str = ""  # non-empty = unmanaged, used as-is
     user_data: str = ""
     tags: Dict[str, str] = field(default_factory=dict)
     block_device_gib: float = 100.0
@@ -87,6 +96,11 @@ class NodeClassSpec:
             "zones": sorted(self.zones),
             "image_family": self.image_family,
             "image_selector": dict(sorted(self.image_selector.items())),
+            "network_group_selectors": sorted(
+                json.dumps(dict(sorted(t.items())))
+                for t in self.network_group_selectors),
+            "role": self.role,
+            "node_profile": self.node_profile,
             "user_data": self.user_data,
             "tags": dict(sorted(self.tags.items())),
             "block_device_gib": self.block_device_gib,
@@ -102,6 +116,8 @@ class NodeClassSpec:
     ready: bool = True
     resolved_zones: List[str] = field(default_factory=list)
     resolved_images: List[str] = field(default_factory=list)
+    resolved_network_groups: List[str] = field(default_factory=list)
+    resolved_profile: str = ""
 
 
 @dataclass

@@ -107,6 +107,17 @@ def validate_nodeclass(nc: NodeClassSpec) -> None:
         errors.append(f"invalid metadata_http_tokens {nc.metadata_http_tokens!r}")
     if "alias" in nc.image_selector and len(nc.image_selector) > 1:
         errors.append("image alias cannot be combined with other selectors")
+    for term in nc.network_group_selectors:
+        if not term:
+            errors.append("network group selector term must not be empty")
+        if "id" in term and len(term) > 1:
+            # reference CEL on securityGroupSelectorTerms: 'id' is exclusive
+            errors.append("network group 'id' term cannot combine with others")
+    if nc.node_profile and nc.role != type(nc)().role and nc.role:
+        # reference: spec.role and spec.instanceProfile are mutually
+        # exclusive (an explicit non-default role next to a profile is a
+        # config contradiction)
+        errors.append("node_profile and a non-default role are exclusive")
     for k in nc.tags:
         if k.startswith("karpenter.tpu/") and k != "karpenter.tpu/cluster":
             errors.append(f"tag {k} is restricted")

@@ -78,6 +78,11 @@ class Store:
         self._notify("nodeclass", "add", nc)
         return nc
 
+    def delete_nodeclass(self, name: str) -> None:
+        nc = self.nodeclasses.pop(name, None)
+        if nc is not None:
+            self._notify("nodeclass", "delete", nc)
+
     def nodepools_by_weight(self) -> List[NodePool]:
         """Descending weight — provisioning tries heavier pools first
         (reference NodePool weight, karpenter.sh_nodepools.yaml:427-432)."""

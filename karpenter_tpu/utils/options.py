@@ -32,6 +32,12 @@ class Options:
     isolated: bool = False                # static pricing only (isolated-vpc)
     metrics_port: int = 8080
     log_level: str = "info"
+    # HA: lease-based leader election (reference: controller-runtime
+    # manager election, 2-replica chart). The file backend gives replicas
+    # sharing a volume real mutual exclusion; empty path disables election.
+    leader_elect: bool = False
+    leader_elect_lease_file: str = "/var/run/karpenter-tpu/leader.lease"
+    leader_elect_identity: str = ""       # default: hostname-pid
     # feature gates (reference Makefile:21-24 + settings.md)
     feature_gates: Dict[str, bool] = field(default_factory=lambda: {
         "SpotToSpotConsolidation": True,

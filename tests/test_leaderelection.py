@@ -1,0 +1,249 @@
+"""Lease-based leader election (utils/leaderelection.py).
+
+Reference behavior: controller-runtime manager leader election —
+client-go's tryAcquireOrRenew over a CAS'd Lease, 2-replica warm standby.
+All timing here is deterministic (ticks carry explicit `now`).
+"""
+
+import numpy as np
+
+from karpenter_tpu.utils.leaderelection import (Elector, FileLeaseBackend,
+                                                InMemoryLeaseBackend, Lease)
+
+
+def mk(backend, ident, **kw):
+    return Elector(backend=backend, identity=ident, lease_duration=15.0,
+                   renew_deadline=10.0, retry_period=2.0, **kw)
+
+
+class TestElector:
+    def test_first_candidate_acquires(self):
+        b = InMemoryLeaseBackend()
+        e = mk(b, "a")
+        assert e.tick(0.0) is True
+        assert b.get().holder == "a"
+        assert b.get().transitions == 0
+
+    def test_standby_waits_while_holder_renews(self):
+        b = InMemoryLeaseBackend()
+        a, s = mk(b, "a"), mk(b, "s")
+        assert a.tick(0.0)
+        t = 0.0
+        while t < 60.0:
+            t += 2.0
+            assert a.tick(t)
+            assert not s.tick(t)
+        assert b.get().holder == "a"
+
+    def test_standby_takes_over_after_expiry(self):
+        b = InMemoryLeaseBackend()
+        a, s = mk(b, "a"), mk(b, "s")
+        assert a.tick(0.0)
+        assert not s.tick(1.0)  # observes version v at t=1
+        # holder dies at t=2 (no more renews); standby keeps retrying
+        t = 1.0
+        while t + 2.0 < 16.0:  # expiry = observed(1.0) + lease(15.0)
+            t += 2.0
+            assert not s.tick(t), t  # lease_duration from OBSERVED time
+        assert s.tick(16.1)
+        assert b.get().holder == "s"
+        assert b.get().transitions == 1
+
+    def test_expiry_judged_from_observation_not_record_time(self):
+        """A candidate that just started must wait a full lease_duration
+        from its FIRST observation even if the record's renew_time is
+        ancient (holder clock skew must not cause premature takeover)."""
+        b = InMemoryLeaseBackend()
+        b.update(Lease(holder="a", acquire_time=-1000.0, renew_time=-1000.0,
+                       lease_duration=15.0), None)
+        s = mk(b, "s")
+        assert not s.tick(0.0)   # first observation at t=0
+        assert not s.tick(14.0)
+        assert s.tick(15.5)
+
+    def test_holder_steps_down_on_partition(self):
+        b = InMemoryLeaseBackend()
+        a = mk(b, "a")
+        stopped = []
+        a.on_stopped_leading.append(lambda: stopped.append(True))
+        assert a.tick(0.0)
+        b.fail_writes = True
+        assert a.tick(2.0)   # renew fails, within renew_deadline
+        assert a.tick(8.0)
+        assert not a.tick(10.5)  # renew_deadline exceeded → step down
+        assert stopped == [True]
+        # heal: the record still names "a", so it re-acquires by renewal
+        b.fail_writes = False
+        assert a.tick(12.0)
+
+    def test_no_dual_leadership_through_partition(self):
+        """Step-down (renew_deadline after last renew) strictly precedes
+        takeover (lease_duration after last observed change)."""
+        b = InMemoryLeaseBackend()
+        a, s = mk(b, "a"), mk(b, "s")
+        assert a.tick(0.0)
+        assert not s.tick(0.5)
+        b.fail_writes = True  # partition the holder's writes
+        both = []
+        t = 0.5
+        took_over = False
+        while t < 30.0 and not took_over:
+            t += 1.0
+            la = a.tick(t)
+            b.fail_writes = False
+            ls = s.tick(t + 0.01)
+            b.fail_writes = True
+            assert not (la and ls), f"dual leadership at t={t}"
+            took_over = ls
+        assert took_over
+
+    def test_cas_race_single_winner(self):
+        b = InMemoryLeaseBackend()
+        cands = [mk(b, f"c{i}") for i in range(5)]
+        wins = [c.tick(0.0) for c in cands]
+        assert sum(wins) == 1
+
+    def test_release_hands_over_immediately(self):
+        b = InMemoryLeaseBackend()
+        a, s = mk(b, "a"), mk(b, "s")
+        assert a.tick(0.0)
+        assert not s.tick(1.0)
+        a.release(2.0)
+        assert not a.is_leader()
+        assert s.tick(3.0)  # no lease_duration wait after clean release
+        assert b.get().transitions == 1
+
+    def test_callbacks_fire_once_per_transition(self):
+        b = InMemoryLeaseBackend()
+        started = []
+        a = mk(b, "a", on_started_leading=[lambda: started.append(1)])
+        a.tick(0.0)
+        a.tick(2.0)
+        a.tick(4.0)
+        assert started == [1]
+
+
+class TestFileBackend:
+    def test_cas_semantics(self, tmp_path):
+        b = FileLeaseBackend(str(tmp_path / "leader.lease"))
+        assert b.get() is None
+        assert b.update(Lease("a", 0.0, 0.0, 15.0), None)
+        got = b.get()
+        assert got.holder == "a" and got.version == 1
+        # stale version loses
+        assert not b.update(Lease("b", 1.0, 1.0, 15.0), None)
+        assert not b.update(Lease("b", 1.0, 1.0, 15.0), 99)
+        assert b.update(Lease("b", 1.0, 1.0, 15.0, transitions=1), 1)
+        assert b.get().holder == "b" and b.get().version == 2
+
+    def test_two_electors_over_file(self, tmp_path):
+        path = str(tmp_path / "leader.lease")
+        a = mk(FileLeaseBackend(path), "a")
+        s = mk(FileLeaseBackend(path), "s")
+        assert a.tick(0.0)
+        assert not s.tick(1.0)
+        a.release(2.0)
+        assert s.tick(3.0)
+
+    def test_corrupt_file_treated_as_absent(self, tmp_path):
+        path = str(tmp_path / "leader.lease")
+        with open(path, "w") as f:
+            f.write("{not json")
+        b = FileLeaseBackend(path)
+        assert b.get() is None
+        assert b.update(Lease("a", 0.0, 0.0, 15.0), None)
+
+
+class TestEngineHA:
+    def test_only_leader_provisions_and_failover_works(self):
+        """Two full controller stacks over one store+cloud: the standby
+        must not double-provision; killing the leader's lease renewals
+        fails over and the standby finishes the work."""
+        from karpenter_tpu.controllers.engine import Engine
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.sim import make_sim
+
+        env = make_sim()
+        backend = InMemoryLeaseBackend()
+        el_a = mk(backend, "replica-a")
+        el_b = mk(backend, "replica-b")
+        env.engine.elector = el_a
+        # replica B: its own engine over the SAME store/cloud/controllers
+        eng_b = Engine(clock=env.clock, elector=el_b)
+        eng_b.add(*env.engine.controllers)
+
+        for i in range(6):
+            env.store.add_pod(Pod(
+                name=f"p{i}", requests=Resources.parse(
+                    {"cpu": "1", "memory": "1Gi"})))
+
+        def both_tick():
+            env.engine.tick()
+            eng_b.tick()
+
+        for _ in range(40):
+            both_tick()
+            env.clock.step(0.5)
+        assert el_a.is_leader() and not el_b.is_leader()
+        bound = [p for p in env.store.pods.values() if p.node_name]
+        assert len(bound) == 6
+        n_claims = len(env.store.nodeclaims)
+
+        # leader's renewals start failing (process wedged)
+        backend.fail_writes = False
+        el_a.backend = _FailingBackend(backend)
+        for i in range(6, 12):
+            env.store.add_pod(Pod(
+                name=f"p{i}", requests=Resources.parse(
+                    {"cpu": "1", "memory": "1Gi"})))
+        ok = eng_b_took_over = False
+        for _ in range(120):
+            both_tick()
+            env.clock.step(0.5)
+            eng_b_took_over = eng_b_took_over or el_b.is_leader()
+            ok = all(p.node_name for p in env.store.pods.values())
+            if ok and eng_b_took_over:
+                break
+        assert not el_a.is_leader()
+        assert eng_b_took_over
+        assert ok, [p.name for p in env.store.pods.values() if not p.node_name]
+
+
+class TestRuntimeRelease:
+    def test_shutdown_releases_lease(self):
+        """Review finding: Runtime.stop() cancels the elector task, which
+        must still release the lease (finally, not post-loop code)."""
+        import asyncio
+
+        from karpenter_tpu.controllers.runtime import Runtime
+        from karpenter_tpu.utils.clock import RealClock
+
+        backend = InMemoryLeaseBackend()
+        el = Elector(backend=backend, identity="a", retry_period=0.01)
+        rt = Runtime(clock=RealClock(), elector=el)
+
+        async def drive():
+            task = asyncio.create_task(rt.start())
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                if el.is_leader():
+                    break
+            assert el.is_leader()
+            rt.stop()
+            await task
+
+        asyncio.run(drive())
+        assert not el.is_leader()
+        assert backend.get().holder == ""  # released, not just expired
+
+
+class _FailingBackend:
+    def __init__(self, inner):
+        self.inner = inner
+
+    def get(self):
+        return self.inner.get()
+
+    def update(self, lease, expected_version):
+        return False

@@ -1,0 +1,174 @@
+"""Network groups (security-group analog) + node profiles (instance-profile
+analog): resolution, launch attachment, drift, protection, GC.
+
+Reference behavior: pkg/providers/securitygroup (tag/id/name selector
+discovery), pkg/providers/instanceprofile (create/attach/protect/delete),
+pkg/controllers/nodeclass/garbagecollection (orphaned profile sweep),
+drift.go (security-group drift reason).
+"""
+
+import pytest
+
+from karpenter_tpu.cloud.netgroup import (ProfileProvider, profile_name,
+                                          resolve_network_groups)
+from karpenter_tpu.cloud.provider import NetworkGroup, UnauthorizedError
+from karpenter_tpu.models.nodepool import NodeClassSpec
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.models.validation import ValidationError
+from karpenter_tpu.sim import make_sim
+
+GROUPS = [
+    NetworkGroup(id="ng-1", name="default", tags={"team": "a"}),
+    NetworkGroup(id="ng-2", name="nodes", tags={"team": "a", "env": "prod"}),
+    NetworkGroup(id="ng-3", name="other", tags={"team": "b"}),
+]
+
+
+class TestResolution:
+    def test_by_id(self):
+        assert resolve_network_groups(GROUPS, [{"id": "ng-2"}]) == ["ng-2"]
+
+    def test_by_name(self):
+        assert resolve_network_groups(GROUPS, [{"name": "default"}]) == ["ng-1"]
+
+    def test_by_tags_conjunctive(self):
+        assert resolve_network_groups(
+            GROUPS, [{"team": "a", "env": "prod"}]) == ["ng-2"]
+
+    def test_terms_union(self):
+        assert resolve_network_groups(
+            GROUPS, [{"id": "ng-1"}, {"team": "b"}]) == ["ng-1", "ng-3"]
+
+    def test_no_match_empty(self):
+        assert resolve_network_groups(GROUPS, [{"team": "zzz"}]) == []
+
+    def test_validation_id_term_exclusive(self):
+        with pytest.raises(ValidationError):
+            from karpenter_tpu.models.validation import validate_nodeclass
+            validate_nodeclass(NodeClassSpec(
+                name="x", network_group_selectors=[{"id": "ng-1", "team": "a"}]))
+
+    def test_validation_empty_term(self):
+        with pytest.raises(ValidationError):
+            from karpenter_tpu.models.validation import validate_nodeclass
+            validate_nodeclass(NodeClassSpec(
+                name="x", network_group_selectors=[{}]))
+
+
+class TestLaunchAttachment:
+    def test_instances_carry_groups_and_profile(self):
+        env = make_sim()
+        env.store.add_pod(Pod(name="p0", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"})))
+        env.engine.run_until(
+            lambda: all(p.node_name for p in env.store.pods.values()))
+        inst = next(iter(env.cloud.instances.values()))
+        assert inst.network_groups == ["ng-default"]  # default selector
+        assert inst.profile == profile_name("default")
+        assert env.cloud.profiles[inst.profile].role == "default-node-role"
+        claim = next(iter(env.store.nodeclaims.values()))
+        assert claim.network_groups == ["ng-default"]
+        assert claim.profile == inst.profile
+
+    def test_unknown_profile_fails_launch(self):
+        env = make_sim()
+        nc = env.store.nodeclasses["default"]
+        nc.node_profile = "does-not-exist"  # unmanaged, never created
+        # re-resolve status with the explicit profile
+        for c in env.engine.controllers:
+            if getattr(c, "name", "") == "nodeclass":
+                c.reconcile(env.clock.now())
+        env.store.add_pod(Pod(name="p0", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"})))
+        done = env.engine.run_until(
+            lambda: all(p.node_name for p in env.store.pods.values()),
+            timeout=30.0)
+        assert not done  # launch keeps failing authorization
+        evs = [e for e in env.store.events if e[2] == "LaunchFailed"]
+        assert evs and "does-not-exist" in evs[0][3]
+
+    def test_readiness_gate_no_matching_groups(self):
+        env = make_sim()
+        nc = env.store.nodeclasses["default"]
+        nc.network_group_selectors = [{"name": "no-such-group"}]
+        for c in env.engine.controllers:
+            if getattr(c, "name", "") == "nodeclass":
+                c.reconcile(env.clock.now())
+        assert not nc.ready
+        env.store.add_pod(Pod(name="p0", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"})))
+        done = env.engine.run_until(
+            lambda: all(p.node_name for p in env.store.pods.values()),
+            timeout=20.0)
+        assert not done  # NotReady NodeClass blocks provisioning
+
+
+class TestNetworkGroupDrift:
+    def test_selector_change_drifts_nodes(self):
+        env = make_sim()
+        env.store.add_pod(Pod(name="p0", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"})))
+        env.engine.run_until(
+            lambda: all(p.node_name for p in env.store.pods.values()))
+        claim0 = next(iter(env.store.nodeclaims.values()))
+        assert claim0.network_groups == ["ng-default"]
+        # operator re-points the NodeClass at a different group set
+        nc = env.store.nodeclasses["default"]
+        nc.network_group_selectors = [{"name": "cluster-nodes"}]
+        # drifted node is replaced: a new claim launches with the new
+        # groups and the old one drains away
+        def replaced():
+            claims = list(env.store.nodeclaims.values())
+            return any(c.network_groups == ["ng-nodes"] for c in claims) \
+                and all(p.node_name for p in env.store.pods.values())
+        assert env.engine.run_until(replaced, timeout=1200.0)
+
+
+class TestProfileLifecycle:
+    def test_ensure_idempotent_and_role_update(self):
+        env = make_sim()
+        prov = ProfileProvider(cloud=env.cloud)
+        n1 = prov.ensure("web", "role-a")
+        n2 = prov.ensure("web", "role-a")
+        assert n1 == n2 and env.cloud.profiles[n1].role == "role-a"
+        prov.ensure("web", "role-b")  # role change recreates
+        assert env.cloud.profiles[n1].role == "role-b"
+
+    def test_gc_deletes_orphans_but_protects_in_use(self):
+        env = make_sim()
+        env.store.add_pod(Pod(name="p0", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"})))
+        env.engine.run_until(
+            lambda: all(p.node_name for p in env.store.pods.values()))
+        pname = profile_name("default")
+        assert pname in env.cloud.profiles
+        env.store.delete_nodeclass("default")
+        prov = ProfileProvider(cloud=env.cloud)
+        # protected: a live instance still uses it
+        assert prov.garbage_collect([]) == []
+        assert pname in env.cloud.profiles
+        env.cloud.terminate(list(env.cloud.instances.keys()))
+        assert prov.garbage_collect([]) == [pname]
+        assert pname not in env.cloud.profiles
+
+    def test_unmanaged_profiles_never_touched(self):
+        env = make_sim()
+        env.cloud.create_profile("user-made-profile", "their-role")
+        prov = ProfileProvider(cloud=env.cloud)
+        assert prov.garbage_collect(list(env.store.nodeclasses)) == []
+        assert "user-made-profile" in env.cloud.profiles
+        # even with NO live nodeclasses, foreign-named profiles survive
+        env.store.delete_nodeclass("default")
+        deleted = prov.garbage_collect([])
+        assert "user-made-profile" not in deleted
+        assert "user-made-profile" in env.cloud.profiles
+
+    def test_hash_covers_role_and_selectors(self):
+        a = NodeClassSpec(name="x")
+        b = NodeClassSpec(name="x", role="other-role")
+        c = NodeClassSpec(name="x",
+                          network_group_selectors=[{"name": "nodes"}])
+        assert a.hash() != b.hash()
+        assert a.hash() != c.hash()
+        assert b.hash() != c.hash()
