@@ -192,6 +192,14 @@ class FakeCloud:
             raise NotFoundError(name)
         del self.profiles[name]
 
+    def update_profile_role(self, name: str, role: str) -> None:
+        """Swap the role bound to a profile in place (the reference swaps
+        roles on live instance profiles rather than delete/recreate —
+        instanceprofile.go attaches the new role to the existing profile)."""
+        if name not in self.profiles:
+            raise NotFoundError(name)
+        self.profiles[name].role = role
+
     def describe_profiles(self) -> List[NodeProfile]:
         return list(self.profiles.values())
 

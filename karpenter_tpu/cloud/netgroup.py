@@ -15,7 +15,7 @@ NodeClass is gone — pkg/controllers/nodeclass/garbagecollection).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from .provider import AlreadyExistsError, NetworkGroup, NodeProfile
 
@@ -52,36 +52,43 @@ class ProfileProvider:
 
     Protected-profile semantics (reference instanceprofile.go:239-251): a
     profile attached to any live instance is never deleted, even when its
-    NodeClass is gone — the GC retries next sweep."""
+    NodeClass is gone — the GC retries next sweep. Role changes swap the
+    role on the live profile in place (the reference detaches/attaches the
+    role on the existing profile; delete/recreate would deadlock on the
+    in-use protection in a steadily-occupied cluster)."""
 
-    cloud: object  # needs create/delete/describe_profiles + describe()
+    cloud: object  # needs create/update/delete/describe_profiles + describe()
 
-    def ensure(self, node_class_name: str, role: str) -> str:
+    def ensure(self, node_class_name: str, role: str,
+               profiles: Optional[Dict[str, NodeProfile]] = None) -> str:
+        """profiles: optional snapshot ({name: profile}) so a reconcile
+        over N NodeClasses lists the cloud once, not N times."""
         name = profile_name(node_class_name)
-        existing = {p.name: p for p in self.cloud.describe_profiles()}
-        cur = existing.get(name)
+        if profiles is None:
+            profiles = {p.name: p for p in self.cloud.describe_profiles()}
+        cur = profiles.get(name)
         if cur is None:
             try:
                 self.cloud.create_profile(name, role)
             except AlreadyExistsError:
                 pass  # lost a create race: the profile exists, which is fine
         elif cur.role != role:
-            # role changed: recreate (IAM profiles bind one role)
-            if not self._in_use(name):
-                self.cloud.delete_profile(name)
-                self.cloud.create_profile(name, role)
+            self.cloud.update_profile_role(name, role)
         return name
 
-    def _in_use(self, name: str) -> bool:
-        return any(i.profile == name for i in self.cloud.describe())
-
-    def garbage_collect(self, live_node_classes: Sequence[str]) -> List[str]:
+    def garbage_collect(self, live_node_classes: Sequence[str],
+                        profiles: Optional[Sequence[NodeProfile]] = None,
+                        used: Optional[set] = None) -> List[str]:
         """Delete managed profiles whose NodeClass no longer exists and
-        that no live instance still uses; returns deleted names."""
+        that no live instance still uses; returns deleted names.
+        profiles/used: optional snapshots shared with the caller's sweep."""
         keep = {profile_name(nc) for nc in live_node_classes}
-        used = {i.profile for i in self.cloud.describe()}  # one sweep
+        if profiles is None:
+            profiles = self.cloud.describe_profiles()
+        if used is None:
+            used = {i.profile for i in self.cloud.describe()}  # one sweep
         deleted = []
-        for p in list(self.cloud.describe_profiles()):
+        for p in list(profiles):
             if not p.name.startswith(PROFILE_PREFIX + "-"):
                 continue  # unmanaged profile: never touch
             if p.name in keep or p.name in used:

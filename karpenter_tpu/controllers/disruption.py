@@ -173,7 +173,10 @@ class DisruptionController:
         if (node_class.resolved_zones and v.claim.zone
                 and v.claim.zone not in node_class.resolved_zones):
             return True
-        if (node_class.resolved_network_groups and v.claim.network_groups
+        # empty claim.network_groups is NOT exempt: a node launched before
+        # the NodeClass's first resolution runs without its firewall groups
+        # and must be remediated, not grandfathered
+        if (node_class.resolved_network_groups
                 and set(v.claim.network_groups)
                 != set(node_class.resolved_network_groups)):
             return True

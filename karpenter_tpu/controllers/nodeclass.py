@@ -34,6 +34,11 @@ class NodeClassController:
         zones = sorted({o.zone for t in self.cloud.describe_types()
                         for o in t.offerings})
         groups = self.cloud.describe_network_groups()
+        # one cloud snapshot per sweep — ensure/GC across N NodeClasses
+        # must not issue N ListProfiles + DescribeInstances calls
+        profile_list = self.cloud.describe_profiles()
+        profile_map = {p.name: p for p in profile_list}
+        used = {i.profile for i in self.cloud.describe()}
         for nc in self.store.nodeclasses.values():
             self.stats["reconciles"] += 1
             resolved_imgs = self.images.resolve(nc)
@@ -47,7 +52,8 @@ class NodeClassController:
             if nc.node_profile:
                 nc.resolved_profile = nc.node_profile  # unmanaged, as-is
             elif nc.role:
-                nc.resolved_profile = self.profiles.ensure(nc.name, nc.role)
+                nc.resolved_profile = self.profiles.ensure(
+                    nc.name, nc.role, profiles=profile_map)
             else:
                 nc.resolved_profile = ""
             ready = (bool(nc.resolved_images) and bool(nc.resolved_zones)
@@ -58,7 +64,8 @@ class NodeClassController:
             nc.ready = ready
         # orphaned managed profiles (reference nodeclass GC controller)
         for name in self.profiles.garbage_collect(
-                list(self.store.nodeclasses.keys())):
+                list(self.store.nodeclasses.keys()),
+                profiles=profile_list, used=used):
             self.store.record_event("profile", name, "GarbageCollected",
                                     "NodeClass gone, profile unused")
         return self.requeue

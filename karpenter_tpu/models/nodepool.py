@@ -92,13 +92,15 @@ class NodeClassSpec:
         """Static drift hash (reference EC2NodeClass.Hash(),
         ec2nodeclass.go:482 — drift detection compares this against the
         hash annotation stamped on launched nodes)."""
+        # selector terms (network groups) are hash-EXEMPT: their effect is
+        # covered by the dynamic resolved-set drift comparison, so a
+        # cosmetic selector rewrite that resolves to the same groups must
+        # not roll the fleet (the reference marks securityGroupSelectorTerms
+        # hash:"ignore" for exactly this reason); role/profile stay static
         blob = json.dumps({
             "zones": sorted(self.zones),
             "image_family": self.image_family,
             "image_selector": dict(sorted(self.image_selector.items())),
-            "network_group_selectors": sorted(
-                json.dumps(dict(sorted(t.items())))
-                for t in self.network_group_selectors),
             "role": self.role,
             "node_profile": self.node_profile,
             "user_data": self.user_data,

@@ -402,14 +402,23 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
 def finalize_offerings(result: SolveResult, cat: CatalogTensors) -> None:
     """Pick the cheapest surviving (zone, captype) for each new node —
     the launch decision (reference launch path picks cheapest via
-    CreateFleet's lowest-price strategy over the override list)."""
+    CreateFleet's lowest-price strategy over the override list).
+    Vectorized over all new nodes: this runs on every solve and a per-node
+    Python loop costs more than the TPU kernel at 100k-pod scale."""
+    new = result.new_nodes()
     result.launches = []
-    for n in result.new_nodes():
-        t = n.type_idx
-        masked = np.where(n.zone_mask[:, None] & n.cap_mask[None, :] & cat.available[t],
-                          cat.price[t], np.inf)
-        zi, ci = np.unravel_index(np.argmin(masked), masked.shape)
-        result.launches.append((t, int(zi), int(ci), float(masked[zi, ci])))
+    if not new:
+        return
+    t = np.array([n.type_idx for n in new])
+    zm = np.stack([n.zone_mask for n in new])          # [M, Z]
+    cm = np.stack([n.cap_mask for n in new])           # [M, C]
+    masked = np.where(zm[:, :, None] & cm[:, None, :] & cat.available[t],
+                      cat.price[t], np.inf)            # [M, Z, C]
+    flat = masked.reshape(len(new), -1)
+    k = np.argmin(flat, axis=1)
+    prices = flat[np.arange(len(new)), k]
+    result.launches = [(int(ti), int(ki // cat.C), int(ki % cat.C), float(p))
+                       for ti, ki, p in zip(t, k, prices)]
 
 
 def validate_solution(cat: CatalogTensors, enc: EncodedPods,

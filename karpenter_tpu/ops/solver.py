@@ -225,6 +225,40 @@ def _pad_to(x: np.ndarray, n: int, axis: int = 0, fill=0):
     return np.pad(x, pad, constant_values=fill)
 
 
+def _estimate_nodes(cat: CatalogTensors, enc: EncodedPods) -> int:
+    """FFD node-count estimate: ceil(count / slots) per group, at the slots
+    of the COST-PER-SLOT-ARGMIN type — the type the kernel actually commits
+    when it opens nodes for the group (max-slot types would undercount by
+    10x+; first-fit sharing only ever lowers the real total). Chunked over
+    groups so the [chunk, T, R] broadcast stays small."""
+    alloc = align_resources(cat.allocatable, enc.requests.shape[1])
+    # cheapest offering per type given each group's zone/captype masks is
+    # approximated by the global min price per type — close enough for a
+    # budget (the overflow retry covers the rest)
+    min_price = np.where(cat.available, cat.price, np.inf).min(axis=(1, 2))
+    est = 0.0
+    for lo in range(0, enc.G, 256):
+        hi = min(lo + 256, enc.G)
+        req = enc.requests[lo:hi].astype(np.float32)            # [g, R]
+        with_req = np.where(req > 0, req, np.float32(1.0))
+        slots = np.where(req[:, None, :] > 0,
+                         np.floor(alloc[None, :, :] / with_req[:, None, :]
+                                  + EPS),
+                         np.float32(BIG)).min(axis=2)           # [g, T]
+        cap = np.where(enc.max_per_node[lo:hi] > 0,
+                       enc.max_per_node[lo:hi], BIG)[:, None]
+        slots = np.clip(slots, 0.0, cap)
+        ok = enc.compat[lo:hi] & (slots >= 1) & np.isfinite(min_price)[None, :]
+        cps = np.where(ok, min_price[None, :] / np.maximum(slots, 1.0),
+                       np.inf)                                  # [g, T]
+        t_star = np.argmin(cps, axis=1)
+        g_idx = np.arange(hi - lo)
+        s = np.where(np.isfinite(cps[g_idx, t_star]),
+                     slots[g_idx, t_star], np.float32(BIG))
+        est += float(np.ceil(enc.counts[lo:hi] / np.maximum(s, 1.0)).sum())
+    return int(est)
+
+
 def _bucket(n: int, quantum: int = 64) -> int:
     """Round up to a padding bucket to bound recompilation."""
     return max(quantum, int(2 ** math.ceil(math.log2(max(n, 1)))))
@@ -241,13 +275,15 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
     existing = existing or []
     n_existing = len(existing)
     total_pods = int(enc.counts.sum())
+    G = enc.G
     auto_n = n_max is None
     if auto_n:
-        # optimistic node budget (~4 pods/node); the kernel reports overflow
-        # and we retry doubled, so a tight guess never drops pods — it just
-        # keeps the common case cheap (node axis dominates kernel cost)
-        n_max = _bucket(n_existing + max(64, total_pods // 4))
-    G = enc.G
+        # node budget from per-group best-type slots (the kernel's per-step
+        # cost is O(n_max), so a tight guess matters: 100k small pods pack
+        # ~100/node, not 4). Underestimates are safe — the kernel reports
+        # overflow and we retry doubled; 2x headroom makes that rare.
+        est = _estimate_nodes(cat, enc)
+        n_max = _bucket(n_existing + max(64, 2 * est + G))
     Gp = _bucket(G, 16)
 
     if dcat is None or dcat.alloc.shape[1] != R:
@@ -279,15 +315,22 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
     track = enc.conflict is not None
     conflict = (_pad_to(_pad_to(enc.conflict, Gp, 0), Gp, 1) if track
                 else np.zeros((Gp, 1), bool))
+    # prior occupancy / resident bans exist only when existing nodes carry
+    # them; otherwise ship [Gp, 1] zero dummies that broadcast over the node
+    # axis inside the kernel — saves a [Gp, n_max] int32 + bool host→device
+    # transfer per solve (the common fresh-solve case)
+    has_prior = any(n.prior_by_group for n in existing)
+    has_banned = any(n.banned_groups is not None for n in existing)
     k_max = 4 * n_max + Gp  # sparse-take budget; regrown on nnz overflow
     while True:
-        prior = np.zeros((Gp, n_max), np.int32)
-        banned = np.zeros((Gp, n_max), bool)
+        prior = np.zeros((Gp, n_max if has_prior else 1), np.int32)
+        banned = np.zeros((Gp, n_max if has_banned else 1), bool)
         for i, n in enumerate(existing):
-            for g, cnt in n.prior_by_group.items():
-                if g < Gp:
-                    prior[g, i] = cnt
-            if n.banned_groups is not None:
+            if has_prior:
+                for g, cnt in n.prior_by_group.items():
+                    if g < Gp:
+                        prior[g, i] = cnt
+            if has_banned and n.banned_groups is not None:
                 banned[: len(n.banned_groups), i] = n.banned_groups
         packed = _solve_kernel_packed(
             dcat.alloc, dcat.price, dcat.avail, requests, counts,

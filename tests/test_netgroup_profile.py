@@ -132,8 +132,24 @@ class TestProfileLifecycle:
         n1 = prov.ensure("web", "role-a")
         n2 = prov.ensure("web", "role-a")
         assert n1 == n2 and env.cloud.profiles[n1].role == "role-a"
-        prov.ensure("web", "role-b")  # role change recreates
+        prov.ensure("web", "role-b")  # role change swaps in place
         assert env.cloud.profiles[n1].role == "role-b"
+
+    def test_role_change_applies_while_profile_in_use(self):
+        """Review finding: a role change must land even when live instances
+        use the profile (in-place swap, not delete/recreate deadlock)."""
+        env = make_sim()
+        env.store.add_pod(Pod(name="p0", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"})))
+        env.engine.run_until(
+            lambda: all(p.node_name for p in env.store.pods.values()))
+        pname = profile_name("default")
+        assert any(i.profile == pname for i in env.cloud.describe())  # in use
+        env.store.nodeclasses["default"].role = "new-role"
+        for c in env.engine.controllers:
+            if getattr(c, "name", "") == "nodeclass":
+                c.reconcile(env.clock.now())
+        assert env.cloud.profiles[pname].role == "new-role"
 
     def test_gc_deletes_orphans_but_protects_in_use(self):
         env = make_sim()
@@ -164,11 +180,30 @@ class TestProfileLifecycle:
         assert "user-made-profile" not in deleted
         assert "user-made-profile" in env.cloud.profiles
 
-    def test_hash_covers_role_and_selectors(self):
+    def test_hash_covers_role_but_not_selectors(self):
+        """Role changes are static drift; selector terms are hash-exempt —
+        a cosmetic selector rewrite resolving to the same groups must not
+        roll the fleet (dynamic resolved-set drift covers real changes)."""
         a = NodeClassSpec(name="x")
         b = NodeClassSpec(name="x", role="other-role")
         c = NodeClassSpec(name="x",
                           network_group_selectors=[{"name": "nodes"}])
         assert a.hash() != b.hash()
-        assert a.hash() != c.hash()
-        assert b.hash() != c.hash()
+        assert a.hash() == c.hash()
+
+    def test_pre_resolution_launch_not_grandfathered(self):
+        """Review finding: a claim launched with empty network_groups
+        (before first resolution) must drift once groups resolve."""
+        from karpenter_tpu.controllers.disruption import DisruptionController
+        env = make_sim()
+        env.store.add_pod(Pod(name="p0", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"})))
+        env.engine.run_until(
+            lambda: all(p.node_name for p in env.store.pods.values()))
+        claim = next(iter(env.store.nodeclaims.values()))
+        claim.network_groups = []  # as if launched before resolution
+        def replaced():
+            return any(c.network_groups == ["ng-default"]
+                       for c in env.store.nodeclaims.values()) \
+                and all(p.node_name for p in env.store.pods.values())
+        assert env.engine.run_until(replaced, timeout=1200.0)
