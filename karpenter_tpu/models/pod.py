@@ -181,12 +181,19 @@ class Pod:
         if self._sig is not None:
             return self._sig
         empty = ()
+
+        def items(d):  # most of these dicts have 0-2 entries; sorted() on
+            if not d:  # a 1-tuple dominated the 100k-pod encode profile
+                return empty
+            it = tuple(d.items())
+            return it if len(it) == 1 else tuple(sorted(it))
+
         self._sig = (
             self.namespace,
             self.owner,
-            tuple(sorted(self.labels.items())) if self.labels else empty,
-            tuple(sorted(self.requests.items())) if self.requests else empty,
-            tuple(sorted(self.node_selector.items())) if self.node_selector else empty,
+            items(self.labels),
+            items(self.requests),
+            items(self.node_selector),
             tuple(sorted((t["key"], t["operator"], tuple(t.get("values", ())))
                          for t in self.node_affinity)) if self.node_affinity else empty,
             tuple(sorted((t["key"], t["operator"], tuple(t.get("values", ())),

@@ -63,8 +63,17 @@ def _pod_key(p: Pod) -> str:
 
 
 class Solver:
-    def __init__(self, catalog: CatalogProvider, backend: str = "auto"):
+    # below this many pods the device path's fixed dispatch+readback
+    # latency (a full RTT when the chip sits behind a network tunnel)
+    # exceeds the native solver's whole runtime — "auto" routes small
+    # solves native/host and reserves the TPU for the large ones
+    DEVICE_MIN_PODS = 4096
+
+    def __init__(self, catalog: CatalogProvider, backend: str = "auto",
+                 device_min_pods: Optional[int] = None):
         self.catalog = catalog
+        self.device_min_pods = (self.DEVICE_MIN_PODS if device_min_pods is None
+                                else device_min_pods)
         if backend == "auto":
             backend = self._detect_backend()
         self.backend = backend
@@ -73,15 +82,27 @@ class Solver:
         self._last_cat_key: tuple = ()
 
     @staticmethod
-    def _detect_backend() -> str:
-        """auto: TPU kernel when an accelerator is attached, else the
-        compiled C++ solver, else the numpy oracle."""
+    def _accel_attached() -> bool:
         try:
             import jax
-            if any(d.platform != "cpu" for d in jax.devices()):
-                return "device"
+            return any(d.platform != "cpu" for d in jax.devices())
         except Exception:
-            pass
+            return False
+
+    @classmethod
+    def _detect_backend(cls) -> str:
+        """auto: size-adaptive (hybrid) when an accelerator is attached,
+        else the compiled C++ solver, else the numpy oracle."""
+        if cls._accel_attached():
+            return "hybrid"
+        from . import native
+        return "native" if native.available() else "host"
+
+    def _resolve_backend(self, total_pods: int) -> str:
+        if self.backend != "hybrid":
+            return self.backend
+        if total_pods >= self.device_min_pods:
+            return "device"
         from . import native
         return "native" if native.available() else "host"
 
@@ -211,9 +232,10 @@ class Solver:
 
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
         t0 = _time.perf_counter()
-        if self.backend == "host":
+        backend = self._resolve_backend(int(enc.counts.sum()))
+        if backend == "host":
             result = solve_host(cat, enc, existing)
-        elif self.backend == "native":
+        elif backend == "native":
             from .native import solve_native
             result = solve_native(cat, enc, existing)
         else:
@@ -228,7 +250,7 @@ class Solver:
                 dcat = device_catalog(cat, R)
                 self._dcat_cache[dkey] = dcat
             result = solve_device(cat, enc, existing, dcat=dcat)
-        SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=self.backend)
+        SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=backend)
         SOLVE_PODS.observe(float(enc.counts.sum()))
 
         out = self._decode(cat, enc, result, nodepool, dropped)

@@ -25,7 +25,10 @@ class Options:
     # reference default vmMemoryOverheadPercent=0.075 (options.go)
     vm_memory_overhead_percent: float = 0.075
     interruption_queue: str = ""          # empty = interruption handling off
-    solver_backend: str = "auto"          # auto | device | native | host
+    # auto | hybrid | device | native | host — auto resolves to the
+    # size-adaptive hybrid on accelerator hosts (small solves native/host,
+    # large on the device kernel)
+    solver_backend: str = "auto"
     batch_idle_seconds: float = 1.0
     batch_max_seconds: float = 10.0
     max_instance_types: int = 60

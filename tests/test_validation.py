@@ -108,7 +108,22 @@ class TestReviewFixes:
         from karpenter_tpu.catalog import CatalogProvider, small_catalog
         from karpenter_tpu.ops.facade import Solver
         s = Solver(CatalogProvider(lambda: small_catalog()), backend="auto")
-        assert s.backend in ("device", "native", "host")
+        # accelerator hosts resolve to the size-adaptive hybrid
+        assert s.backend in ("hybrid", "native", "host")
+
+    def test_hybrid_backend_routes_by_size(self):
+        """'hybrid' (what auto resolves to on accelerator hosts) routes
+        small solves native/host — the device dispatch+readback latency
+        floor beats them — and large solves to the device kernel."""
+        from karpenter_tpu.catalog import CatalogProvider, small_catalog
+        from karpenter_tpu.ops.facade import Solver
+        s = Solver(CatalogProvider(lambda: small_catalog()),
+                   backend="hybrid", device_min_pods=100)
+        assert s._resolve_backend(10) in ("native", "host")
+        assert s._resolve_backend(100) == "device"
+        assert s._resolve_backend(10_000) == "device"
+        s2 = Solver(CatalogProvider(lambda: small_catalog()), backend="host")
+        assert s2._resolve_backend(10_000_000) == "host"  # explicit wins
 
     def test_dcat_cache_invalidated_on_epoch_change(self):
         """Device tensors must not survive a catalog epoch change (the
