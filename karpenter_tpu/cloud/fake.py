@@ -13,6 +13,7 @@ rate limits (kwok/ec2/ratelimiting.go:86-135), a kill-instance chaos hook
 from __future__ import annotations
 
 import itertools
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -85,7 +86,8 @@ class FakeCloud:
         self._nodes_created: Dict[str, Node] = {}
         self.api_calls: Dict[str, int] = {"create_fleet": 0, "terminate": 0,
                                           "describe": 0}
-        self.interruptions: List[dict] = []  # queued interruption events
+        # queued interruption events; deque so FIFO acks are O(1)
+        self.interruptions: "deque[dict]" = deque()
         self.expired_reservations: set = set()
         self.unhealthy: set = set()  # instance ids with a dead kubelet
         from .image import default_images
@@ -277,11 +279,17 @@ class FakeCloud:
 
     def poll_interruptions(self, max_messages: int = 10) -> List[dict]:
         """SQS-style receive (messages must be acked with delete_message)."""
-        return self.interruptions[:max_messages]
+        return list(itertools.islice(self.interruptions, max_messages))
 
     def delete_message(self, msg: dict) -> None:
-        if msg in self.interruptions:
-            self.interruptions.remove(msg)
+        # acks arrive in poll order, so the head-pop fast path is O(1);
+        # a 15k-message drain through list.remove was O(n^2) and dominated
+        # the interruption throughput benchmark
+        q = self.interruptions
+        if q and q[0] is msg:
+            q.popleft()
+        elif msg in q:
+            q.remove(msg)
 
     # --- snapshot / restore (kwok ConfigMap backup analog) ---
     def snapshot(self) -> dict:

@@ -45,7 +45,8 @@ def build_operator(options: Optional[Options] = None,
         GeneratorConfig(region=opts.region)), clock=clock)
     catalog = CatalogProvider(lambda: cloud.describe_types(), clock=clock)
     catalog.raw_types()  # sync hydrate before controllers start
-    solver = Solver(catalog, backend=opts.solver_backend)
+    solver = Solver(catalog, backend=opts.solver_backend,
+                    profile_dir=opts.profile_dir)
     provisioner = Provisioner(store=store, solver=solver, cloud=cloud,
                               catalog=catalog,
                               batch_idle=opts.batch_idle_seconds)

@@ -70,10 +70,13 @@ class Solver:
     DEVICE_MIN_PODS = 4096
 
     def __init__(self, catalog: CatalogProvider, backend: str = "auto",
-                 device_min_pods: Optional[int] = None):
+                 device_min_pods: Optional[int] = None,
+                 profile_dir: str = ""):
         self.catalog = catalog
         self.device_min_pods = (self.DEVICE_MIN_PODS if device_min_pods is None
                                 else device_min_pods)
+        # non-empty: every solve runs under jax.profiler.trace(profile_dir)
+        self.profile_dir = profile_dir
         if backend == "auto":
             backend = self._detect_backend()
         self.backend = backend
@@ -231,25 +234,28 @@ class Solver:
         import time as _time
 
         from ..metrics import SOLVE_DURATION, SOLVE_PODS
+        from ..utils.profiling import maybe_trace
         t0 = _time.perf_counter()
         backend = self._resolve_backend(int(enc.counts.sum()))
-        if backend == "host":
-            result = solve_host(cat, enc, existing)
-        elif backend == "native":
-            from .native import solve_native
-            result = solve_native(cat, enc, existing)
-        else:
-            from .solver import device_catalog, solve_device
-            R = enc.requests.shape[1]
-            # keyed on (nodeclass hash, catalog epoch, R) — NOT id(cat):
-            # a freed CatalogTensors' address can be reused by its successor
-            dkey = self._last_cat_key + (R,)
-            dcat = self._dcat_cache.get(dkey)
-            if dcat is None:
-                self._dcat_cache.clear()  # one epoch resident at a time
-                dcat = device_catalog(cat, R)
-                self._dcat_cache[dkey] = dcat
-            result = solve_device(cat, enc, existing, dcat=dcat)
+        with maybe_trace(self.profile_dir):
+            if backend == "host":
+                result = solve_host(cat, enc, existing)
+            elif backend == "native":
+                from .native import solve_native
+                result = solve_native(cat, enc, existing)
+            else:
+                from .solver import device_catalog, solve_device
+                R = enc.requests.shape[1]
+                # keyed on (nodeclass hash, catalog epoch, R) — NOT id(cat):
+                # a freed CatalogTensors' address can be reused by its
+                # successor
+                dkey = self._last_cat_key + (R,)
+                dcat = self._dcat_cache.get(dkey)
+                if dcat is None:
+                    self._dcat_cache.clear()  # one epoch resident at a time
+                    dcat = device_catalog(cat, R)
+                    self._dcat_cache[dkey] = dcat
+                result = solve_device(cat, enc, existing, dcat=dcat)
         SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=backend)
         SOLVE_PODS.observe(float(enc.counts.sum()))
 

@@ -29,6 +29,9 @@ class Options:
     # size-adaptive hybrid on accelerator hosts (small solves native/host,
     # large on the device kernel)
     solver_backend: str = "auto"
+    # non-empty: every Solve() runs under jax.profiler.trace(dir) —
+    # TensorBoard-viewable XLA device traces (utils/profiling.py)
+    profile_dir: str = ""
     batch_idle_seconds: float = 1.0
     batch_max_seconds: float = 10.0
     max_instance_types: int = 60

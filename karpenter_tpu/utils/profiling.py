@@ -1,0 +1,43 @@
+"""Solve() profiling — the JAX/XLA device-trace hook.
+
+Reference observability is Prometheus metrics + structured logs (SURVEY §5:
+every AWS SDK call timed through a middleware). The TPU-side analog this
+framework adds: when `Options.profile_dir` is set, each device solve runs
+under `jax.profiler.trace`, producing TensorBoard-viewable traces with
+per-op device time (MXU/VPU occupancy, transfer gaps, scan step cost) —
+the tool used to find the node-axis oversizing this repo's bench history
+records. Wall-clock timing is always on via the SOLVE_DURATION histogram
+(`metrics/registry`), measured around `block_until_ready`-equivalent
+boundaries (the facade's host read blocks on the device result).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+_warned = False
+
+
+@contextlib.contextmanager
+def maybe_trace(profile_dir: str = ""):
+    """Wrap a block in a JAX profiler trace when profile_dir is set;
+    zero-cost no-op otherwise. Tracing is best-effort: on a jax-less host
+    (where the native/host backends still run) the hook degrades to a
+    one-time warning instead of killing every solve."""
+    if not profile_dir:
+        yield
+        return
+    try:
+        import jax
+    except ImportError:
+        global _warned
+        if not _warned:
+            _warned = True
+            import warnings
+            warnings.warn("profile_dir set but jax is not importable; "
+                          "solve tracing disabled")
+        yield
+        return
+    with jax.profiler.trace(profile_dir):
+        yield

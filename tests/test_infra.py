@@ -224,3 +224,34 @@ class TestChangeMonitor:
         assert not m.has_changed("k", ["a", "b", "c"])
         clock.step(101)
         assert m.has_changed("k", ["a", "b", "c"])  # TTL re-log
+
+
+class TestProfiling:
+    def test_maybe_trace_noop_when_unset(self):
+        from karpenter_tpu.utils.profiling import maybe_trace
+        with maybe_trace(""):
+            x = 1 + 1
+        assert x == 2
+
+    def test_maybe_trace_writes_trace(self, tmp_path):
+        from karpenter_tpu.utils.profiling import maybe_trace
+        import jax.numpy as jnp
+        import os
+        with maybe_trace(str(tmp_path)):
+            jnp.arange(4).sum().block_until_ready()
+        # a profile session directory appears under the trace dir
+        assert any(os.scandir(str(tmp_path)))
+
+    def test_solver_profile_dir_plumbed(self, tmp_path):
+        from karpenter_tpu.catalog import CatalogProvider, small_catalog
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.ops.facade import Solver
+        import os
+        s = Solver(CatalogProvider(lambda: small_catalog()), backend="host",
+                   profile_dir=str(tmp_path))
+        out = s.solve([Pod(name="p", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"}))], NodePool(name="np"))
+        assert not out.unschedulable
+        assert any(os.scandir(str(tmp_path)))
