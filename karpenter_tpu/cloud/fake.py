@@ -63,6 +63,13 @@ class FakeCloudConfig:
     register_delay: float = 1.0       # launch -> node object exists
     create_fleet_rate: float = 50.0   # calls/sec token refill
     create_fleet_burst: int = 100
+    # per-API buckets mimicking EC2's per-action throttles (reference kwok
+    # ratelimiting.go:86-135 keeps one bucket per API); generous defaults —
+    # only abusive polling trips them
+    describe_rate: float = 100.0
+    describe_burst: int = 500
+    terminate_rate: float = 100.0
+    terminate_burst: int = 500
     unlimited_capacity: bool = True   # pools default to infinite
 
 
@@ -81,6 +88,12 @@ class FakeCloud:
         self.capacity_pools: Dict[Tuple[str, str, str], int] = {}
         self._bucket = TokenBucket(self.config.create_fleet_rate,
                                    self.config.create_fleet_burst, self.clock)
+        self._describe_bucket = TokenBucket(self.config.describe_rate,
+                                            self.config.describe_burst,
+                                            self.clock)
+        self._terminate_bucket = TokenBucket(self.config.terminate_rate,
+                                             self.config.terminate_burst,
+                                             self.clock)
         self.on_node_ready: List[Callable[[Node], None]] = []
         self.on_node_created: List[Callable[[Node], None]] = []
         self._nodes_created: Dict[str, Node] = {}
@@ -161,6 +174,8 @@ class FakeCloud:
 
     def terminate(self, instance_ids: List[str]) -> None:
         self.api_calls["terminate"] += 1
+        if not self._terminate_bucket.allow():
+            raise RateLimitedError("TerminateInstances throttled")
         for iid in instance_ids:
             inst = self.instances.get(iid)
             if inst and inst.state != "terminated":
@@ -207,6 +222,8 @@ class FakeCloud:
 
     def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]:
         self.api_calls["describe"] += 1
+        if not self._describe_bucket.allow():
+            raise RateLimitedError("DescribeInstances throttled")
         if instance_ids is None:
             return [i for i in self.instances.values() if i.state != "terminated"]
         return [self.instances[i] for i in instance_ids if i in self.instances]

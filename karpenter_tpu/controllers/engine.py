@@ -53,7 +53,17 @@ class Engine:
                 return
         for c in self.controllers:
             if now >= self._next_run.get(c.name, 0.0):
-                requeue = c.reconcile(now)
+                try:
+                    requeue = c.reconcile(now)
+                except Exception as e:
+                    # retryable cloud errors (rate limits, server errors)
+                    # model transient throttling: back off and retry, the
+                    # way real clients do. Anything else is a bug — crash.
+                    from ..cloud.provider import CloudError
+                    if not (isinstance(e, CloudError)
+                            and getattr(e, "retryable", False)):
+                        raise
+                    requeue = 2.0
                 self._next_run[c.name] = now + max(0.0, requeue)
 
     def run_for(self, seconds: float, step: float = 0.5) -> None:

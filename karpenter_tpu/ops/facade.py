@@ -38,6 +38,17 @@ from .encode import (CatalogTensors, EncodedPods, align_resources,
 MAX_OVERRIDES = 60  # reference MaxInstanceTypes (instance.go:62)
 
 
+def _min_values_floors(requirements: Optional[Requirements],
+                       ) -> List[Tuple[str, int]]:
+    """(key, minValues) floors of a Requirements conjunction — the single
+    extraction both the node-opening caps and the override-row selection
+    share, so the two enforcement points can't diverge."""
+    if requirements is None:
+        return []
+    return [(k, requirements.min_values(k)) for k in requirements.keys()
+            if requirements.min_values(k)]
+
+
 @dataclass
 class NodeLaunch:
     instance_type: str
@@ -196,6 +207,7 @@ class Solver:
             enc.compat &= fits_cap[None, :]
             if enc.compat_hard is not None:
                 enc.compat_hard = enc.compat_hard & fits_cap[None, :]
+        self._apply_min_values_caps(enc, cat, nodepool.requirements)
         # pods dropped by the taint filter are unschedulable for this pool
         enc_keys = {_pod_key(p) for g in enc.groups for p in g.pods}
         dropped = [_pod_key(p) for p in pods if _pod_key(p) not in enc_keys]
@@ -284,7 +296,8 @@ class Solver:
                 instance_type=cat.names[b.type_idx], zone=cat.zones[int(zi)],
                 capacity_type=cat.captypes[int(ci)],
                 price=float(masked[zi, ci]),
-                overrides=self._overrides(cat, vn, b.group_compat),
+                overrides=self._overrides(cat, vn, b.group_compat,
+                                          nodepool.requirements),
                 pod_keys=[_pod_key(p) for p in b.pods], requests=reqs,
                 labels=self._node_labels(cat, vn, nodepool)))
         for name, placed in plan.existing_placements.items():
@@ -509,7 +522,8 @@ class Solver:
             launches.append(NodeLaunch(
                 instance_type=it_name, zone=cat.zones[zi],
                 capacity_type=cat.captypes[ci], price=price,
-                overrides=self._overrides(cat, node, group_compat),
+                overrides=self._overrides(cat, node, group_compat,
+                                          nodepool.requirements),
                 pod_keys=keys, requests=reqs, labels=labels))
         unschedulable = list(dropped)
         for g, cnt in result.unschedulable.items():
@@ -519,11 +533,21 @@ class Solver:
                            unschedulable=unschedulable)
 
     def _overrides(self, cat: CatalogTensors, node: VirtualNode,
-                   group_compat: np.ndarray) -> List[Tuple[str, str, str, float]]:
+                   group_compat: np.ndarray,
+                   requirements: Optional[Requirements] = None,
+                   ) -> List[Tuple[str, str, str, float]]:
         """Price-sorted alternate offerings for this node's pod set: any
         type compatible with every pod on the node that holds node.cum, and
         any surviving (zone, captype). Gives the launch path ICE resilience
-        without a re-solve."""
+        without a re-solve.
+
+        requirements: the NodePool requirements; keys carrying minValues
+        turn the 60-row cap into constrained selection (reference
+        InstanceTypes.Truncate at instance.go:293) — the kept rows must
+        span >= minValues distinct values per key, so a launch keeps its
+        flexibility floor (e.g. the >=15-type spot-to-spot gate). Selection
+        is best-effort: when the floor is unreachable within the cap, the
+        plain cheapest rows ship rather than failing the launch."""
         alloc = align_resources(cat.allocatable, len(node.cum))
         fits = (alloc >= node.cum[None, :] - 1e-4).all(axis=1)  # [T]
         ok = fits & group_compat
@@ -531,14 +555,114 @@ class Solver:
                 & node.zone_mask[None, :, None] & node.cap_mask[None, None, :])
         t_idx, z_idx, c_idx = np.nonzero(mask)
         prices = cat.price[t_idx, z_idx, c_idx]
-        order = np.argsort(prices, kind="stable")[:MAX_OVERRIDES]
-        out = []
+        by_price = np.argsort(prices, kind="stable")
+        order = self._floor_rows(cat, t_idx, z_idx, c_idx, by_price,
+                                 _min_values_floors(requirements))
         primary = node.type_idx
         # ensure the committed type's cheapest offering is first
         rows = [(cat.names[t_idx[j]], cat.zones[z_idx[j]],
                  cat.captypes[c_idx[j]], float(prices[j])) for j in order]
         rows.sort(key=lambda r: (r[0] != cat.names[primary], r[3]))
         return rows[:MAX_OVERRIDES]
+
+    @staticmethod
+    def _apply_min_values_caps(enc: EncodedPods, cat: CatalogTensors,
+                               requirements: Requirements) -> None:
+        """minValues as a NODE-OPENING constraint (the reference scheduler
+        keeps each virtual node's remaining compatible-type set above every
+        minValues floor, opening a new node rather than shrinking below it):
+        cap each group's pods-per-node so a node's load still fits the
+        N-th-best compatible VALUE of each minValues key — then >= N
+        distinct values survive into the launch overrides. Exact for
+        single-group nodes (the dominant dense case); mixed-group nodes can
+        combine loads that narrow further, where the override floor stays
+        best-effort."""
+        mv = _min_values_floors(requirements)
+        if not mv:
+            return
+        from .binpack import BIG, EPS
+        alloc = align_resources(cat.allocatable, enc.requests.shape[1])
+        for i in range(enc.G):
+            req = enc.requests[i].astype(np.float32)
+            with_req = np.where(req > 0, req, np.float32(1.0))
+            slots = np.where(req[None, :] > 0,
+                             np.floor(alloc / with_req[None, :] + EPS),
+                             np.float32(BIG)).min(axis=1)       # [T]
+            slots = np.where(enc.compat[i], np.maximum(slots, 0.0), 0.0)
+            cap = BIG
+            for key, need in mv:
+                if key == L.INSTANCE_TYPE:
+                    per_value = slots[slots > 0]
+                elif key in cat.label_keys:
+                    ids = cat.label_val[:, cat.label_keys.index(key)]
+                    vals = np.unique(ids[(ids >= 0) & (slots > 0)])
+                    per_value = np.array(
+                        [slots[ids == v].max() for v in vals])
+                else:
+                    # offering-axis floors (zone/capacity-type) don't bound
+                    # node SIZE — _floor_rows spans them in the override
+                    # list instead
+                    continue
+                if len(per_value) < need:
+                    continue  # floor unreachable: solver proceeds, launch
+                    # ships best-effort rows (reference errors the create)
+                nth = np.sort(per_value)[-need]  # N-th largest value's slots
+                cap = min(cap, int(nth))
+            if cap < BIG and cap >= 1:
+                cur = int(enc.max_per_node[i])
+                enc.max_per_node[i] = cap if cur == 0 else min(cur, cap)
+
+    @staticmethod
+    def _floor_rows(cat: CatalogTensors, t_idx, z_idx, c_idx, by_price,
+                    mv: List[Tuple[str, int]]) -> np.ndarray:
+        """Override-row order honoring every minValues floor within the
+        60-row cap: reserve the cheapest row contributing each still-
+        missing distinct value per key — INSTANCE_TYPE = the row's type,
+        zone / capacity-type = the row's OFFERING axis (offering-axis
+        floors are real: minValues=3 on zone must ship rows spanning 3
+        zones), other keys = the row's type label — then fill the rest
+        cheapest-first. A floor the candidate rows cannot span falls back
+        to plain price order (best-effort; the reference errors the
+        create)."""
+        if not mv or len(by_price) == 0:
+            return by_price[:MAX_OVERRIDES]
+
+        def value_of(j: int, key: str):
+            t = int(t_idx[j])
+            if key == L.INSTANCE_TYPE:
+                return cat.names[t]
+            if key == L.ZONE:
+                return int(z_idx[j])
+            if key == L.CAPACITY_TYPE:
+                return int(c_idx[j])
+            if key in cat.label_keys:
+                v = int(cat.label_val[t, cat.label_keys.index(key)])
+                return v if v >= 0 else None
+            return None
+
+        selected: List[int] = []
+        chosen = set()
+        for key, need in mv:
+            have = {value_of(j, key) for j in selected} - {None}
+            for j in by_price:
+                if len(have) >= need:
+                    break
+                j = int(j)
+                if j in chosen:
+                    continue
+                v = value_of(j, key)
+                if v is not None and v not in have:
+                    selected.append(j)
+                    chosen.add(j)
+                    have.add(v)
+            if len(have) < need or len(selected) > MAX_OVERRIDES:
+                return by_price[:MAX_OVERRIDES]  # floor unreachable
+        for j in by_price:
+            if len(selected) >= MAX_OVERRIDES:
+                break
+            if int(j) not in chosen:
+                selected.append(int(j))
+        return np.array(selected, dtype=int)
 
     def _node_labels(self, cat: CatalogTensors, node: VirtualNode,
                      nodepool: NodePool) -> Dict[str, str]:

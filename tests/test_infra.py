@@ -255,3 +255,24 @@ class TestProfiling:
             {"cpu": "1", "memory": "1Gi"}))], NodePool(name="np"))
         assert not out.unschedulable
         assert any(os.scandir(str(tmp_path)))
+
+
+class TestPerApiRateLimits:
+    def test_describe_and_terminate_throttle(self):
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.cloud.fake import FakeCloud, FakeCloudConfig
+        from karpenter_tpu.cloud.provider import RateLimitedError
+        from karpenter_tpu.utils.clock import FakeClock
+        import pytest as _pytest
+        clock = FakeClock()
+        cloud = FakeCloud(small_catalog(), clock=clock, config=FakeCloudConfig(
+            describe_rate=1.0, describe_burst=2,
+            terminate_rate=1.0, terminate_burst=2))
+        cloud.describe(); cloud.describe()
+        with _pytest.raises(RateLimitedError):
+            cloud.describe()
+        clock.step(5)  # refill
+        cloud.describe()
+        cloud.terminate([]); cloud.terminate([])
+        with _pytest.raises(RateLimitedError):
+            cloud.terminate([])

@@ -67,6 +67,77 @@ class TestScaleSuite:
         # single CreateFleet batch for the whole burst
         assert sim.cloud.api_calls["create_fleet"] <= 3
 
+    def test_pod_dense_min_values_30(self):
+        """minValues=30 variant (reference provisioning_test.go:123-178):
+        every launch must keep >= 30 distinct instance types in its
+        override list — the flexibility floor survives truncation."""
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                                       Requirements)
+        pool = NodePool(name="default", requirements=Requirements(
+            Requirement(L.INSTANCE_TYPE, Operator.EXISTS, min_values=30)))
+        sim = make_sim(types=generate_catalog(), nodepool=pool)
+        for i in range(2000):
+            sim.store.add_pod(Pod(
+                name=f"mv-{i}",
+                requests=Resources.parse({"cpu": "100m", "memory": "256Mi"})))
+        launches = []
+        orig = sim.cloud.create_fleet
+
+        def spy(requests):
+            launches.extend(requests)
+            return orig(requests)
+        sim.cloud.create_fleet = spy
+        with RECORDER.measure("pod-dense-minvalues", sim_clock=sim.clock,
+                              pods=2000):
+            ok = sim.engine.run_until(lambda: all_bound(sim), timeout=1800)
+        assert ok
+        assert launches
+        for req in launches:
+            distinct = {o.instance_type for o in req.overrides}
+            assert len(distinct) >= 30, (
+                f"launch kept only {len(distinct)} types")
+
+    def test_min_values_zone_floor_in_overrides(self):
+        """Review finding: minValues on the ZONE key (an offering axis)
+        must ship override rows spanning that many zones."""
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                                       Requirements)
+        pool = NodePool(name="default", requirements=Requirements(
+            Requirement(L.ZONE, Operator.EXISTS, min_values=3)))
+        sim = make_sim(nodepool=pool)
+        launches = []
+        orig = sim.cloud.create_fleet
+
+        def spy(requests):
+            launches.extend(requests)
+            return orig(requests)
+        sim.cloud.create_fleet = spy
+        for i in range(100):
+            sim.store.add_pod(Pod(
+                name=f"zf-{i}",
+                requests=Resources.parse({"cpu": "100m", "memory": "256Mi"})))
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=600)
+        assert launches
+        for req in launches:
+            zones = {o.zone for o in req.overrides}
+            assert len(zones) >= 3, f"launch kept only zones {zones}"
+
+    def test_engine_backs_off_on_throttle(self):
+        """Review finding: a tripped describe/terminate throttle must back
+        the controller off, not crash the reconcile loop."""
+        from karpenter_tpu.cloud.fake import FakeCloudConfig
+        sim = make_sim(cloud_config=FakeCloudConfig(
+            describe_rate=2.0, describe_burst=2))
+        for i in range(20):
+            sim.store.add_pod(Pod(
+                name=f"th-{i}",
+                requests=Resources.parse({"cpu": "100m", "memory": "256Mi"})))
+        # several controllers hammer describe(); the engine must absorb
+        # RateLimitedError and still converge on simulated time
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=1200)
+
     def test_deprovisioning_200_node_consolidation(self):
         """200 under-utilized nodes consolidate down (reference
         deprovisioning_test.go:346-434)."""
