@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Protocol
 
+from ..cloud.provider import CloudError
+
 
 class Controller(Protocol):
     name: str
@@ -55,13 +57,11 @@ class Engine:
             if now >= self._next_run.get(c.name, 0.0):
                 try:
                     requeue = c.reconcile(now)
-                except Exception as e:
+                except CloudError as e:
                     # retryable cloud errors (rate limits, server errors)
                     # model transient throttling: back off and retry, the
                     # way real clients do. Anything else is a bug — crash.
-                    from ..cloud.provider import CloudError
-                    if not (isinstance(e, CloudError)
-                            and getattr(e, "retryable", False)):
+                    if not getattr(e, "retryable", False):
                         raise
                     requeue = 2.0
                 self._next_run[c.name] = now + max(0.0, requeue)

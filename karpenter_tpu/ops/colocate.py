@@ -44,7 +44,7 @@ from ..models.pod import anti_blocks, term_selects as _selects
 from ..models.requirements import Requirements
 from .binpack import BIG, EPS, VirtualNode, _fit_count
 from .encode import (CatalogTensors, _axis_allow, align_resources,
-                     compat_mask, group_pods)
+                     compat_mask, exotic_mask, group_pods, wants_exotic)
 
 
 def _pos_terms(p: Pod) -> List[PodAffinityTerm]:
@@ -140,16 +140,20 @@ def plan_colocation(pods: Sequence[Pod], cat: CatalogTensors,
         return out
 
     reqs_cache: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+    exotic = exotic_mask(cat)
 
     def g_masks(i: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         hit = reqs_cache.get(i)
         if hit is None:
-            r = groups[i].representative.scheduling_requirements()
+            rep = groups[i].representative
+            r = rep.scheduling_requirements()
             if extra_requirements is not None:
                 r = r.union_with(extra_requirements)
             comp = compat_mask(r, cat)
             if type_cap is not None:
                 comp = comp & type_cap
+            if exotic.any() and not wants_exotic(rep, r):
+                comp = comp & ~exotic  # same rule as encode_pods
             hit = (comp, _axis_allow(r, L.ZONE, cat.zones),
                    _axis_allow(r, L.CAPACITY_TYPE, cat.captypes))
             reqs_cache[i] = hit

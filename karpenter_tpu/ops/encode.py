@@ -37,6 +37,45 @@ from ..models.resources import Resources, num_resources, resource_axis
 ABSENT = -1
 CAPACITY_TYPES = (L.CAPACITY_ON_DEMAND, L.CAPACITY_SPOT, L.CAPACITY_RESERVED)
 
+# exotic-instance filter (reference filter.go:279 ExoticInstanceFilter):
+# metal and accelerator-carrying types are excluded unless the pod requests
+# the resource or its requirements show explicit intent via these keys
+from ..models.resources import GPU as _R_GPU
+from ..models.resources import NVIDIA_GPU as _R_NVIDIA
+from ..models.resources import TPU_CHIP as _R_TPU
+
+EXOTIC_RESOURCES = (_R_NVIDIA, _R_GPU, _R_TPU)
+EXOTIC_INTENT_KEYS = frozenset({
+    L.INSTANCE_TYPE, L.INSTANCE_FAMILY, L.INSTANCE_SIZE,
+    L.INSTANCE_GPU_NAME, L.INSTANCE_GPU_MANUFACTURER, L.INSTANCE_GPU_COUNT,
+    L.INSTANCE_GPU_MEMORY, L.INSTANCE_ACCELERATOR_NAME,
+    L.INSTANCE_ACCELERATOR_MANUFACTURER, L.INSTANCE_ACCELERATOR_COUNT,
+})
+
+
+def wants_exotic(rep: Pod, reqs: "Requirements") -> bool:
+    """Does a pod express intent for exotic (metal/accelerator) types —
+    either by requesting an exotic resource or by constraining an
+    exotic-intent label key? The ONE definition both the encoder and the
+    co-location planner consult."""
+    return (any(rep.requests.get(r, 0.0) > 0 for r in EXOTIC_RESOURCES)
+            or any(k in EXOTIC_INTENT_KEYS for k in reqs.keys()))
+
+
+def exotic_mask(cat: "CatalogTensors") -> np.ndarray:
+    """bool [T]: metal or accelerator-carrying types (reference
+    filter.go:279: these only serve pods that ask for them — a cheap spot
+    GPU box must not absorb plain web pods)."""
+    ex = np.zeros(cat.T, bool)
+    for rname in EXOTIC_RESOURCES:
+        if rname in cat.resources:
+            ex |= cat.allocatable[:, cat.resources.index(rname)] > 0
+    if L.INSTANCE_SIZE in cat.label_keys:
+        metal_id = cat.vocab[L.INSTANCE_SIZE].get("metal")
+        if metal_id is not None:
+            ex |= cat.label_val[:, cat.label_keys.index(L.INSTANCE_SIZE)] == metal_id
+    return ex
+
 
 @dataclass
 class CatalogTensors:
@@ -336,11 +375,14 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
     hard_z = np.ones((G, cat.Z), bool)
     hard_c = np.ones((G, cat.C), bool)
 
+    exotic = exotic_mask(cat)
     for i, g in enumerate(groups):
         reqs = g.representative.scheduling_requirements()
         if extra_requirements is not None:
             reqs = reqs.union_with(extra_requirements)
         compat[i] = compat_mask(reqs, cat)
+        if exotic.any() and not wants_exotic(g.representative, reqs):
+            compat[i] &= ~exotic
         allow_zone[i] = _axis_allow(reqs, L.ZONE, cat.zones)
         allow_cap[i] = _axis_allow(reqs, L.CAPACITY_TYPE, cat.captypes)
         hard[i] = compat[i]

@@ -643,6 +643,7 @@ class Solver:
         selected: List[int] = []
         chosen = set()
         for key, need in mv:
+            start = len(selected)
             have = {value_of(j, key) for j in selected} - {None}
             for j in by_price:
                 if len(have) >= need:
@@ -656,7 +657,10 @@ class Solver:
                     chosen.add(j)
                     have.add(v)
             if len(have) < need or len(selected) > MAX_OVERRIDES:
-                return by_price[:MAX_OVERRIDES]  # floor unreachable
+                # THIS floor is unreachable: drop only its reservations —
+                # floors other keys already secured must still ship
+                chosen.difference_update(selected[start:])
+                del selected[start:]
         for j in by_price:
             if len(selected) >= MAX_OVERRIDES:
                 break

@@ -129,3 +129,82 @@ class TestEncodePods:
         assert enc.max_per_node[by_name["anti"]] == 1
         assert enc.spread_zone[by_name["spread"]]
         assert enc.max_per_node[by_name["spread"]] == 0
+
+
+class TestExoticInstanceFilter:
+    """Reference filter.go:279 ExoticInstanceFilter: metal and accelerator
+    types serve only pods that ask for them."""
+
+    def _cat(self):
+        from karpenter_tpu.catalog import GeneratorConfig, generate_catalog
+        return encode_catalog(generate_catalog(GeneratorConfig(
+            families=["c5", "g5", "q6"])))
+
+    def test_plain_pod_excluded_from_exotic(self):
+        import numpy as np
+        from karpenter_tpu.ops.encode import exotic_mask
+        cat = self._cat()
+        ex = exotic_mask(cat)
+        assert ex.any()
+        p = Pod(name="plain",
+                requests=Resources.parse({"cpu": "1", "memory": "1Gi"}))
+        enc = encode_pods([p], cat)
+        assert not (enc.compat[0] & ex).any()
+        # but non-exotic types remain
+        assert enc.compat[0].any()
+
+    def test_gpu_request_keeps_gpu_types(self):
+        import numpy as np
+        cat = self._cat()
+        p = Pod(name="gpu", requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi", "nvidia.com/gpu": "1"}))
+        enc = encode_pods([p], cat)
+        names = [cat.names[t] for t in np.flatnonzero(enc.compat[0])]
+        assert any(n.startswith("g5") for n in names)
+
+    def test_explicit_family_intent_keeps_exotic(self):
+        import numpy as np
+        from karpenter_tpu.models import labels as L
+        cat = self._cat()
+        p = Pod(name="pinned",
+                requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                node_selector={L.INSTANCE_FAMILY: "g5"})
+        enc = encode_pods([p], cat)
+        names = [cat.names[t] for t in np.flatnonzero(enc.compat[0])]
+        assert names and all(n.startswith("g5") for n in names)
+
+    def test_metal_excluded_without_intent(self):
+        import numpy as np
+        from karpenter_tpu.models import labels as L
+        cat = self._cat()
+        p = Pod(name="plain",
+                requests=Resources.parse({"cpu": "1", "memory": "1Gi"}))
+        enc = encode_pods([p], cat)
+        names = [cat.names[t] for t in np.flatnonzero(enc.compat[0])]
+        assert names and not any(n.endswith(".metal") for n in names)
+        # explicit size intent brings metal back
+        p2 = Pod(name="metal",
+                 requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                 node_selector={L.INSTANCE_SIZE: "metal"})
+        enc2 = encode_pods([p2], cat)
+        names2 = [cat.names[t] for t in np.flatnonzero(enc2.compat[0])]
+        assert names2 and all(n.endswith(".metal") for n in names2)
+
+
+class TestFloorRowsPerKey:
+    def test_unreachable_floor_keeps_other_floors(self):
+        """Review finding: one unreachable minValues floor must not discard
+        the reservations other keys already secured."""
+        import numpy as np
+        from karpenter_tpu.models import labels as L
+        from karpenter_tpu.ops.facade import Solver
+        cat = encode_catalog(small_catalog())
+        # all rows, price-sorted
+        t_idx, z_idx, c_idx = np.nonzero(cat.available)
+        prices = cat.price[t_idx, z_idx, c_idx]
+        by_price = np.argsort(prices, kind="stable")
+        order = Solver._floor_rows(
+            cat, t_idx, z_idx, c_idx, by_price,
+            [(L.ZONE, 3), (L.INSTANCE_TYPE, 10_000)])  # 2nd unreachable
+        zones = {int(z_idx[j]) for j in order}
+        assert len(zones) >= 3  # the reachable zone floor still ships
