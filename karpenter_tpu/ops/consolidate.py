@@ -38,7 +38,10 @@ from .encode import CatalogTensors, EncodedPods, align_resources
 def _screen_kernel(alloc, avail, node_type, node_cum, node_zmask, node_cmask,
                    node_active, group_req, compat, allow_zone, allow_cap,
                    node_groups):
-    """Returns (k [N, G], screen [N] bool, headroom_slack [N, G])."""
+    """Returns ONE packed f32 vector: [0:N] screen (1.0 = candidate may
+    consolidate), [N:N+N*G] headroom slack (others' capacity minus need,
+    row-major [N, G]) — consolidation_screen unpacks it after a single
+    host read."""
     talloc = alloc[node_type]                                 # [N, R]
     headroom = talloc - node_cum                              # [N, R]
     with_req = jnp.where(group_req > 0, group_req, 1.0)       # [G, R]
@@ -59,7 +62,11 @@ def _screen_kernel(alloc, avail, node_type, node_cum, node_zmask, node_cmask,
     others = total[None, :] - k                               # [N, G]
     need = node_groups.astype(jnp.float32)                    # [N, G]
     screen = ((need <= others) | (need == 0)).all(axis=1) & node_active
-    return k, screen, others - need
+    # ONE packed output buffer: each host read of a separate array costs a
+    # full round trip when the chip sits behind a network tunnel (~70ms),
+    # and this screen used to ship two
+    return jnp.concatenate([screen.astype(jnp.float32),
+                            (others - need).reshape(-1)])
 
 
 def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
@@ -81,11 +88,14 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         node_zmask[i] = v.virtual.zone_mask
         node_cmask[i] = v.virtual.cap_mask
     active = np.ones(N, bool)
-    _k, screen, slack = _screen_kernel(
+    packed = _screen_kernel(
         jnp.asarray(alloc), jnp.asarray(cat.available),
         jnp.asarray(node_type), jnp.asarray(node_cum),
         jnp.asarray(node_zmask), jnp.asarray(node_cmask),
         jnp.asarray(active), jnp.asarray(enc.requests.astype(np.float32)),
         jnp.asarray(enc.compat), jnp.asarray(enc.allow_zone),
         jnp.asarray(enc.allow_cap), jnp.asarray(group_counts))
-    return np.asarray(screen), np.asarray(slack)
+    buf = np.asarray(packed)  # ONE host read
+    screen = buf[:N] > 0.5
+    slack = buf[N:].reshape(N, enc.G)
+    return screen, slack
