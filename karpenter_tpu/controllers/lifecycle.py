@@ -60,6 +60,8 @@ class LifecycleController:
         claim.phase = Phase.REGISTERED
         claim.registered_at = now
         claim.set_condition("Registered", True, now=now)
+        from ..metrics import LIFECYCLE_DURATION
+        LIFECYCLE_DURATION.observe(now - claim.created_at, phase="registered")
 
     def _initialize(self, claim: NodeClaim, node: Node, now: float) -> None:
         # startup taints cleared + node ready → Initialized
@@ -69,6 +71,8 @@ class LifecycleController:
         claim.phase = Phase.INITIALIZED
         claim.initialized_at = now
         claim.set_condition("Initialized", True, now=now)
+        from ..metrics import LIFECYCLE_DURATION
+        LIFECYCLE_DURATION.observe(now - claim.created_at, phase="initialized")
 
     def _reap(self, claim: NodeClaim) -> None:
         if claim.provider_id:

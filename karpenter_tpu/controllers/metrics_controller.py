@@ -1,26 +1,34 @@
-"""Cloudprovider metrics controller.
+"""Cloudprovider + cluster-state metrics controller.
 
 Reference: pkg/controllers/metrics/metrics.go:31-59 — exports per-offering
 availability and price-estimate gauges for every (instanceType, zone,
-capacityType) in the catalog, refreshed on a poll.
+capacityType) in the catalog, refreshed on a poll — plus the core metrics
+controllers' cluster-state families (node/pod counts, utilization;
+website reference/metrics.md cluster_state + nodes groups).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 from ..catalog.provider import CatalogProvider
-from ..metrics import OFFERING_AVAILABLE, OFFERING_PRICE
+from ..metrics import (CLUSTER_NODES, CLUSTER_PODS, CLUSTER_UTILIZATION,
+                       OFFERING_AVAILABLE, OFFERING_PRICE)
+from ..state.store import Store
 
 
 @dataclass
 class CloudProviderMetricsController:
     catalog: CatalogProvider
+    store: Optional[Store] = None
     name: str = "metrics.cloudprovider"
     requeue: float = 60.0
     _last_epoch: tuple = ()
 
     def reconcile(self, now: float) -> float:
+        if self.store is not None:
+            self._cluster_state()
         epoch = tuple(self.catalog.epoch)
         if epoch == self._last_epoch:
             return self.requeue
@@ -34,3 +42,30 @@ class CloudProviderMetricsController:
                 OFFERING_AVAILABLE.set(1.0 if o.available else 0.0, **labels)
                 OFFERING_PRICE.set(o.price, **labels)
         return self.requeue
+
+    def _cluster_state(self) -> None:
+        CLUSTER_NODES.set(float(len(self.store.nodes)))
+        pending = sum(1 for p in self.store.pods.values()
+                      if p.node_name is None)
+        CLUSTER_PODS.set(float(pending), phase="pending")
+        CLUSTER_PODS.set(float(len(self.store.pods) - pending),
+                         phase="bound")
+        # one pass over nodes + one over pods (pods_on_node per node would
+        # be O(nodes x pods)); EVERY allocatable resource gets a series —
+        # accelerator resources are the point of this framework
+        ready = {n.name for n in self.store.nodes.values() if n.ready}
+        allocatable: dict = {}
+        for n in self.store.nodes.values():
+            if n.name in ready:
+                for k, v in n.allocatable.items():
+                    allocatable[k] = allocatable.get(k, 0.0) + v
+        requested: dict = {}
+        for p in self.store.pods.values():
+            if p.node_name in ready:
+                for k, v in p.requests.items():
+                    requested[k] = requested.get(k, 0.0) + v
+        CLUSTER_UTILIZATION.clear()  # scale-to-zero must not leave stale %
+        for k, total in allocatable.items():
+            CLUSTER_UTILIZATION.set(
+                100.0 * requested.get(k, 0.0) / total if total else 0.0,
+                resource=k)

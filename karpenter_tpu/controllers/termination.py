@@ -86,3 +86,6 @@ class TerminationController:
         self._drain_started.pop(claim.name, None)
         self.store.delete_nodeclaim(claim.name)
         self.store.record_event("nodeclaim", claim.name, "Terminated")
+        if claim.deletion_timestamp is not None:
+            from ..metrics import TERMINATION_DURATION
+            TERMINATION_DURATION.observe(now - claim.deletion_timestamp)

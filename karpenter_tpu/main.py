@@ -60,7 +60,7 @@ def build_operator(options: Optional[Options] = None,
                                       termination=termination,
                                       spot_to_spot=opts.gate("SpotToSpotConsolidation"))
     gc = GarbageCollectionController(store=store, cloud=cloud)
-    metrics_c = CloudProviderMetricsController(catalog=catalog)
+    metrics_c = CloudProviderMetricsController(catalog=catalog, store=store)
     from .cloud.image import ImageProvider
     from .controllers.auxiliary import (CatalogRefreshController,
                                         DiscoveredCapacityController,

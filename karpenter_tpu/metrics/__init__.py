@@ -46,6 +46,26 @@ ICE_ERRORS = REGISTRY.counter(
 INTERRUPTION_MESSAGES = REGISTRY.counter(
     "karpenter_tpu_interruption_messages_total",
     "interruption queue messages", ("kind",))
+LIFECYCLE_DURATION = REGISTRY.histogram(
+    "karpenter_nodeclaims_lifecycle_duration_seconds",
+    "Seconds from creation to each lifecycle phase (reference: "
+    "karpenter_nodeclaims_instance_termination/registration duration "
+    "families)", ("phase",),
+    buckets=(1, 2, 5, 10, 30, 60, 120, 300, 600, 1800))
+TERMINATION_DURATION = REGISTRY.histogram(
+    "karpenter_nodeclaims_termination_duration_seconds",
+    "Seconds from deletion timestamp to finalization",
+    buckets=(1, 2, 5, 10, 30, 60, 120, 300, 600, 1800))
+CLUSTER_NODES = REGISTRY.gauge(
+    "karpenter_cluster_state_node_count",
+    "Nodes currently in cluster state (reference cluster_state family)")
+CLUSTER_PODS = REGISTRY.gauge(
+    "karpenter_cluster_state_pod_count",
+    "Pods currently tracked, by phase", ("phase",))
+CLUSTER_UTILIZATION = REGISTRY.gauge(
+    "karpenter_cluster_utilization_percent",
+    "Requested / allocatable across ready nodes, per resource",
+    ("resource",))
 BATCH_SIZE = REGISTRY.histogram(
     "karpenter_tpu_cloud_batcher_batch_size", "requests per wire call",
     ("op",), buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500))

@@ -94,7 +94,7 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     from .controllers.metrics_controller import CloudProviderMetricsController
     from .controllers.nodeclass import NodeClassController
     from .controllers.repair import NodeRepairController
-    metrics_c = CloudProviderMetricsController(catalog=catalog)
+    metrics_c = CloudProviderMetricsController(catalog=catalog, store=store)
     nodeclass_c = NodeClassController(store=store, cloud=cloud,
                                       images=ImageProvider(cloud.describe_images()))
     repair = NodeRepairController(store=store, termination=termination)

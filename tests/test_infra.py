@@ -276,3 +276,23 @@ class TestPerApiRateLimits:
         cloud.terminate([]); cloud.terminate([])
         with _pytest.raises(RateLimitedError):
             cloud.terminate([])
+
+
+class TestClusterStateMetrics:
+    def test_new_families_exposed_after_sim(self):
+        from karpenter_tpu.metrics import REGISTRY
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.sim import make_sim
+        sim = make_sim()
+        for i in range(5):
+            sim.store.add_pod(Pod(name=f"m-{i}", requests=Resources.parse(
+                {"cpu": "1", "memory": "1Gi"})))
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()))
+        sim.engine.run_for(120, step=10)  # let the metrics poll fire
+        text = REGISTRY.expose()
+        assert "karpenter_cluster_state_node_count" in text
+        assert 'karpenter_cluster_state_pod_count{phase="bound"}' in text
+        assert "karpenter_cluster_utilization_percent" in text
+        assert "karpenter_nodeclaims_lifecycle_duration_seconds" in text
