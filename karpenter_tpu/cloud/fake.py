@@ -220,6 +220,18 @@ class FakeCloud:
     def describe_profiles(self) -> List[NodeProfile]:
         return list(self.profiles.values())
 
+    def describe_nodes(self) -> List[Node]:
+        """The cluster's durable node objects — in k8s these live in the
+        API server and survive operator restarts; the fake cloud plays that
+        side too. Restart rehydration (state/rehydrate.py) rebuilds
+        Store.nodes from this seam."""
+        out = []
+        for iid, node in self._nodes_created.items():
+            inst = self.instances.get(iid)
+            if inst is not None and inst.state != "terminated":
+                out.append(node)
+        return out
+
     def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]:
         self.api_calls["describe"] += 1
         if not self._describe_bucket.allow():

@@ -128,7 +128,10 @@ class ReservationExceededError(CloudError):
 
 
 class CloudProvider(Protocol):
-    """The launch/terminate seam controllers speak to."""
+    """The seam controllers speak to. A real TPU-cloud backend implements
+    every method here; the controllers call all of them unconditionally
+    (NodeClassController/ProfileProvider drive the network-group and
+    profile methods; state.rehydrate drives describe_nodes)."""
 
     def create_fleet(self, requests: List[LaunchRequest]) -> List["Instance | CloudError"]:
         """One instance (or error) per request; the cloud picks among each
@@ -139,3 +142,28 @@ class CloudProvider(Protocol):
     def terminate(self, instance_ids: List[str]) -> None: ...
 
     def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]: ...
+
+    def describe_types(self) -> List[object]:
+        """DescribeInstanceTypes analog — the catalog provider's backend."""
+        ...
+
+    def describe_images(self) -> List[object]:
+        """DescribeImages analog — the image provider's backend."""
+        ...
+
+    def describe_nodes(self) -> List[object]:
+        """The cluster's durable node objects (API-server side); restart
+        rehydration rebuilds Store.nodes from this."""
+        ...
+
+    # network-group discovery (DescribeSecurityGroups analog)
+    def describe_network_groups(self) -> List[NetworkGroup]: ...
+
+    # node-profile lifecycle (IAM instance-profile analog)
+    def create_profile(self, name: str, role: str) -> NodeProfile: ...
+
+    def delete_profile(self, name: str) -> None: ...
+
+    def update_profile_role(self, name: str, role: str) -> None: ...
+
+    def describe_profiles(self) -> List[NodeProfile]: ...

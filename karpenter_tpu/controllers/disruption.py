@@ -34,6 +34,11 @@ from ..state.store import Store
 from .termination import TerminationController
 
 SPOT_TO_SPOT_MIN_TYPES = 15  # reference flexibility floor (disruption.md:129)
+# settle window after restart adoption before any voluntary disruption:
+# adopted nodes look empty until workloads re-list, and the empty pass must
+# not reap them in that gap (reference: disruption requires cluster-state
+# sync before acting)
+ADOPTION_SETTLE = 120.0
 
 
 @dataclass
@@ -63,6 +68,9 @@ class DisruptionController:
 
     def reconcile(self, now: float) -> float:
         self._advance_pending(now)
+        if (self.store.adopted_at is not None
+                and now - self.store.adopted_at < ADOPTION_SETTLE):
+            return self.requeue
         for pool in self.store.nodepools_by_weight():
             self._reconcile_pool(pool, now)
         return self.requeue
@@ -164,8 +172,17 @@ class DisruptionController:
         from the resolved set (the security-group drift reason)."""
         if node_class is None:
             return False
+        from ..models.nodepool import NODECLASS_HASH_VERSION
         stamped = v.claim.annotations.get("karpenter.tpu/nodeclass-hash")
-        if stamped is not None and stamped != node_class.hash():
+        stamped_ver = v.claim.annotations.get("karpenter.tpu/nodeclass-hash-version")
+        if stamped is not None and stamped_ver != NODECLASS_HASH_VERSION:
+            # hash-schema change (operator upgrade): the stored hash was
+            # computed under a different field set, so a mismatch says
+            # nothing about real drift — re-stamp instead of rolling the
+            # fleet (reference ec2nodeclass-hash-version migration)
+            v.claim.annotations["karpenter.tpu/nodeclass-hash"] = node_class.hash()
+            v.claim.annotations["karpenter.tpu/nodeclass-hash-version"] = NODECLASS_HASH_VERSION
+        elif stamped is not None and stamped != node_class.hash():
             return True
         if (node_class.resolved_images and v.claim.image_id
                 and v.claim.image_id not in node_class.resolved_images):

@@ -27,6 +27,13 @@ class GarbageCollectionController:
         "instances_reaped": 0, "nodes_reaped": 0})
 
     def reconcile(self, now: float) -> float:
+        if not self.store.hydrated:
+            # cold store: a freshly restarted operator has not adopted its
+            # fleet yet — reaping now would terminate every live instance.
+            # The reference GC only trusts the durable store's NodeClaim
+            # list (controller.go:55-112); ours is trustworthy only after
+            # state.rehydrate ran.
+            return self.requeue
         claimed = {c.provider_id for c in self.store.nodeclaims.values()
                    if c.provider_id}
         for inst in self.cloud.describe():

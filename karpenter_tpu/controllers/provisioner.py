@@ -212,7 +212,9 @@ class Provisioner:
                 expire_after=pool.expire_after,
                 termination_grace_period=pool.termination_grace_period,
                 created_at=now)
+            from ..models.nodepool import NODECLASS_HASH_VERSION
             claim.annotations["karpenter.tpu/nodeclass-hash"] = node_class.hash()
+            claim.annotations["karpenter.tpu/nodeclass-hash-version"] = NODECLASS_HASH_VERSION
             claim.instance_type = launch.instance_type
             self.store.add_nodeclaim(claim)
             claims.append((claim, launch))
@@ -228,7 +230,16 @@ class Provisioner:
                 image_id=(node_class.resolved_images[0]
                           if node_class.resolved_images else "img-default"),
                 user_data=self._user_data(pool, node_class, launch),
-                tags={**node_class.tags, "karpenter.tpu/nodepool": pool.name},
+                # adoption tags: enough for state.rehydrate to rebuild the
+                # NodeClaim from the instance after an operator restart
+                tags={**node_class.tags,
+                      L.TAG_NODEPOOL: pool.name,
+                      L.TAG_NODECLAIM: claim.name,
+                      L.TAG_NODECLASS: node_class.name,
+                      L.TAG_NODECLASS_HASH:
+                          claim.annotations["karpenter.tpu/nodeclass-hash"],
+                      L.TAG_NODECLASS_HASH_VERSION:
+                          claim.annotations["karpenter.tpu/nodeclass-hash-version"]},
                 network_groups=list(node_class.resolved_network_groups),
                 profile=node_class.resolved_profile))
         results = self.cloud.create_fleet(requests)

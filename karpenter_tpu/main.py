@@ -113,6 +113,8 @@ def build_operator(options: Optional[Options] = None,
     store.add_nodeclass(NodeClassSpec(name="default"))
     store.add_nodepool(NodePool(name="default"))
     nodeclass_c.reconcile(clock.now())  # sync hydrate before start
+    from .state.rehydrate import rehydrate
+    rehydrate(store, cloud, catalog, clock.now())  # adopt fleet after restart
     return runtime, store, cloud
 
 

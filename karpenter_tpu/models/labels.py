@@ -71,6 +71,15 @@ NUMERIC_LABELS = frozenset({
 # (zone, capacity-type) axes, not by the per-type label mask
 OFFERING_LABELS = frozenset({ZONE, CAPACITY_TYPE})
 
+# instance adoption tags, stamped at launch and read back by restart
+# rehydration (state/rehydrate.py) — the writer (provisioner) and reader
+# must share one spelling or instances silently become unadoptable
+TAG_NODECLAIM = f"{_G}/nodeclaim"
+TAG_NODEPOOL = NODEPOOL
+TAG_NODECLASS = f"{_G}/nodeclass"
+TAG_NODECLASS_HASH = f"{_G}/nodeclass-hash"
+TAG_NODECLASS_HASH_VERSION = f"{_G}/nodeclass-hash-version"
+
 # restricted: users may not set these directly on NodePool templates
 RESTRICTED_LABELS = frozenset({NODEPOOL, NODE_INITIALIZED, NODE_REGISTERED, HOSTNAME})
 

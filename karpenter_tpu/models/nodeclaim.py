@@ -109,3 +109,15 @@ class Node:
 
 def new_nodeclaim_name(nodepool: str) -> str:
     return f"{nodepool}-{next(_seq):06d}"
+
+
+def advance_name_sequence(past: int) -> None:
+    """Ensure future generated names use suffixes > `past`.
+
+    The sequence is process-local, so after a true restart it resets to 0
+    while adopted claims keep their old names — without this, a fresh
+    launch would mint a colliding name, silently overwrite the adopted
+    claim in the store, and expose its live instance to GC."""
+    global _seq
+    current = next(_seq)
+    _seq = itertools.count(max(current, past + 1))

@@ -22,6 +22,13 @@ from .pod import Taint
 from .requirements import Requirement, Requirements
 from .resources import Resources
 
+# Hash-schema version stamped alongside the nodeclass hash. When the set of
+# fields feeding NodeClassSpec.hash() changes, bump this: drift detection
+# re-stamps (instead of rolling the fleet) on nodes whose stored version
+# differs (reference: karpenter.k8s.aws/ec2nodeclass-hash-version,
+# ec2nodeclass.go:480 hash version v4 + the hash controller's migration).
+NODECLASS_HASH_VERSION = "v2"
+
 
 @dataclass
 class Budget:

@@ -66,11 +66,22 @@ class SimEnvironment:
 def make_sim(types: Optional[List[InstanceType]] = None,
              backend: str = "host",
              cloud_config: Optional[FakeCloudConfig] = None,
-             nodepool: Optional[NodePool] = None) -> SimEnvironment:
-    clock = FakeClock()
+             nodepool: Optional[NodePool] = None,
+             cloud: Optional[FakeCloud] = None,
+             clock: Optional[FakeClock] = None) -> SimEnvironment:
+    """Passing an existing `cloud` (+ its clock) simulates an operator
+    restart: the new stack rehydrates its fresh Store from the cloud's
+    durable state instead of starting empty-world."""
+    if cloud is not None and (types is not None or cloud_config is not None):
+        raise ValueError("types/cloud_config are ignored when an existing "
+                         "cloud is passed — configure the cloud directly")
+    # a passed cloud keeps its own clock: driving it from a fresh clock
+    # would freeze its time (register delays never elapse, buckets never
+    # refill), so default to the cloud's
+    clock = clock or (cloud.clock if cloud is not None else FakeClock())
     store = Store()
     types = types if types is not None else small_catalog()
-    cloud = FakeCloud(types, clock=clock, config=cloud_config)
+    cloud = cloud or FakeCloud(types, clock=clock, config=cloud_config)
     catalog = CatalogProvider(lambda: cloud.describe_types(), clock=clock)
     solver = Solver(catalog, backend=backend)
     provisioner = Provisioner(store=store, solver=solver, cloud=cloud,
@@ -123,6 +134,8 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     store.add_nodeclass(NodeClassSpec(name="default"))
     store.add_nodepool(nodepool or NodePool(name="default"))
     nodeclass_c.reconcile(clock.now())  # sync hydrate (operator.go:151 analog)
+    from .state.rehydrate import rehydrate
+    rehydrate(store, cloud, catalog, clock.now())  # adopt any pre-existing fleet
     return SimEnvironment(clock=clock, store=store, cloud=cloud,
                           catalog=catalog, solver=solver, engine=engine,
                           provisioner=provisioner, lifecycle=lifecycle,

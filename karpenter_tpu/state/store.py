@@ -1,10 +1,12 @@
 """In-memory cluster state store — the sim's API server.
 
 Plays the role the Kubernetes API server plays for the reference (its
-coordination bus; SURVEY.md §5 'distributed communication backend'): all
-durable state lives here, controllers watch it, and restart recovery is
-'rebuild from the store' exactly like the reference rebuilds from watches.
-Event hooks provide the watch mechanism.
+coordination bus; SURVEY.md §5 'distributed communication backend'):
+controllers watch it via event hooks. Unlike the real API server the
+store is process-local, so restart recovery rebuilds it from the cloud's
+durable state (`state/rehydrate.py`: instance adoption tags + cluster
+node objects); the `hydrated` flag gates destructive sweeps (GC) until
+that adoption ran.
 """
 
 from __future__ import annotations
@@ -26,6 +28,13 @@ class Store:
         self.nodes: Dict[str, Node] = {}
         self._watchers: Dict[str, List[Callable]] = defaultdict(list)
         self.events: List[tuple] = []  # (kind, object-name, reason, message)
+        # set by state.rehydrate.rehydrate(); until then the store may be a
+        # cold restart and GC must not reap (see controllers/gc.py)
+        self.hydrated: bool = False
+        # when rehydration adopted a live fleet, the time it did so —
+        # disruption waits out a settle window from here so re-listing
+        # workloads aren't raced by the empty-node pass
+        self.adopted_at: Optional[float] = None
 
     # --- watch / events ---
     def watch(self, kind: str, fn: Callable) -> None:

@@ -1,0 +1,146 @@
+"""Restart-safe state recovery (VERDICT round-1 Missing #1).
+
+The reference rebuilds everything from the durable k8s API on restart and
+its GC only reaps instances whose NodeClaim is verifiably gone there
+(pkg/controllers/nodeclaim/garbagecollection/controller.go:55-112). Our
+analog: instances carry adoption tags, the cluster keeps its node objects,
+and state.rehydrate rebuilds a fresh Store from both — so an operator
+restart must terminate ZERO instances and rebind all pods.
+"""
+
+from karpenter_tpu.controllers.gc import GarbageCollectionController
+from karpenter_tpu.models.nodeclaim import Phase
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+from karpenter_tpu.state.store import Store
+
+
+def add_pods(sim, n, cpu="500m", mem="1Gi", prefix="p"):
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def all_bound(sim):
+    return all(p.node_name is not None for p in sim.store.pods.values())
+
+
+class TestRestartRecovery:
+    def test_restart_terminates_nothing_and_rebinds_pods(self):
+        # --- first operator: provision a real fleet ---
+        sim1 = make_sim()
+        add_pods(sim1, 200, cpu="2", mem="4Gi")
+        assert sim1.engine.run_until(lambda: all_bound(sim1), timeout=120)
+        instances_before = {i.id for i in sim1.cloud.describe()}
+        assert len(instances_before) >= 20
+        claims_before = dict(sim1.store.nodeclaims)
+
+        # --- operator restart: same cloud + clock, fresh Store ---
+        sim2 = make_sim(cloud=sim1.cloud, clock=sim1.clock)
+        assert sim2.store.hydrated
+        assert len(sim2.store.nodeclaims) == len(claims_before)
+        for name, old in claims_before.items():
+            adopted = sim2.store.nodeclaims[name]
+            assert adopted.provider_id == old.provider_id
+            assert adopted.nodepool == old.nodepool
+            assert adopted.instance_type == old.instance_type
+            assert adopted.phase == Phase.INITIALIZED
+            assert adopted.node_name == old.node_name
+        assert len(sim2.store.nodes) == len(sim1.store.nodes)
+
+        # workload re-lists (pods are durable in real k8s); the solver must
+        # absorb them into the adopted fleet's headroom, not launch anew
+        terminates_before = sim1.cloud.api_calls["terminate"]
+        fleets_before = sim1.cloud.api_calls["create_fleet"]
+        add_pods(sim2, 200, cpu="2", mem="4Gi")
+        # run well past GC MIN_AGE + a sweep interval
+        assert sim2.engine.run_until(lambda: all_bound(sim2), timeout=300,
+                                     step=2.0)
+        sim2.engine.run_for(300, step=10.0)
+        assert {i.id for i in sim2.cloud.describe()} == instances_before
+        assert sim2.cloud.api_calls["terminate"] == terminates_before
+        assert sim2.cloud.api_calls["create_fleet"] == fleets_before
+        assert sim2.gc.stats["instances_reaped"] == 0
+
+    def test_adoption_settle_blocks_empty_pass_before_pods_relist(self):
+        """Adopted nodes look empty until workloads re-list; the empty pass
+        must wait out the adoption settle window instead of reaping them."""
+        sim1 = make_sim()
+        add_pods(sim1, 20, cpu="2", mem="4Gi")
+        assert sim1.engine.run_until(lambda: all_bound(sim1), timeout=120)
+        n_inst = len(sim1.cloud.describe())
+        sim2 = make_sim(cloud=sim1.cloud)  # no pods re-listed yet
+        sim2.engine.run_for(30, step=1.0)  # operator runs before workload list
+        assert len(sim2.cloud.describe()) == n_inst
+        assert sim2.disruption.stats["empty"] == 0
+        # once pods re-list and the settle window passes, disruption resumes
+        add_pods(sim2, 20, cpu="2", mem="4Gi")
+        assert sim2.engine.run_until(lambda: all_bound(sim2), timeout=300,
+                                     step=2.0)
+        assert len(sim2.cloud.describe()) == n_inst
+
+    def test_cold_store_gc_refuses_to_reap(self):
+        sim = make_sim()
+        add_pods(sim, 10, cpu="2", mem="4Gi")
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        sim.clock.step(600)  # everything is long past MIN_AGE
+        cold = Store()  # fresh process, nothing rehydrated
+        gc = GarbageCollectionController(store=cold, cloud=sim.cloud)
+        gc.reconcile(sim.clock.now())
+        assert gc.stats["instances_reaped"] == 0
+        assert all(i.state != "terminated" for i in sim.cloud.instances.values())
+
+    def test_name_sequence_advances_past_adopted_names(self):
+        """A true process restart resets the claim-name counter to 0; fresh
+        launches must not mint names colliding with adopted claims (the
+        collision would overwrite the adopted claim and expose its live
+        instance to GC)."""
+        import itertools
+
+        from karpenter_tpu.models import nodeclaim as ncmod
+        sim1 = make_sim()
+        add_pods(sim1, 20, cpu="2", mem="4Gi")
+        assert sim1.engine.run_until(lambda: all_bound(sim1), timeout=120)
+        adopted_names = set(sim1.store.nodeclaims)
+        ncmod._seq = itertools.count(0)  # simulate new process
+        sim2 = make_sim(cloud=sim1.cloud)
+        add_pods(sim2, 40, cpu="2", mem="4Gi", prefix="burst")
+        assert sim2.engine.run_until(lambda: all_bound(sim2), timeout=300,
+                                     step=2.0)
+        # every adopted claim survived (no overwrite), and the fleet grew
+        assert adopted_names <= set(sim2.store.nodeclaims)
+        sim2.engine.run_for(300, step=10.0)
+        assert sim2.gc.stats["instances_reaped"] == 0
+
+    def test_untagged_instances_are_not_adopted(self):
+        sim1 = make_sim()
+        # an instance launched out-of-band (no adoption tags, no nodeclaim)
+        from karpenter_tpu.cloud.provider import Instance
+        rogue = Instance(id="i-rogue", instance_type="m5.large", zone="zone-a",
+                        capacity_type="on-demand", image_id="img-default",
+                        state="running", launch_time=sim1.clock.now())
+        sim1.cloud.instances[rogue.id] = rogue
+        sim2 = make_sim(cloud=sim1.cloud, clock=sim1.clock)
+        assert sim2.store.nodeclaim_by_provider_id(rogue.provider_id) is None
+
+    def test_hash_version_migration_restamps_instead_of_drifting(self):
+        sim = make_sim()
+        add_pods(sim, 5, cpu="2", mem="4Gi")
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        # simulate nodes launched under an older hash schema: stale stored
+        # hash AND stale version — drift must re-stamp, not roll the fleet
+        for c in sim.store.nodeclaims.values():
+            c.annotations["karpenter.tpu/nodeclass-hash"] = "deadbeef00000000"
+            c.annotations["karpenter.tpu/nodeclass-hash-version"] = "v0"
+        sim.engine.run_for(60)
+        assert sim.disruption.stats["drift"] == 0
+        nc = sim.store.nodeclasses["default"]
+        from karpenter_tpu.models.nodepool import NODECLASS_HASH_VERSION
+        for c in sim.store.nodeclaims.values():
+            assert c.annotations["karpenter.tpu/nodeclass-hash"] == nc.hash()
+            assert (c.annotations["karpenter.tpu/nodeclass-hash-version"]
+                    == NODECLASS_HASH_VERSION)
