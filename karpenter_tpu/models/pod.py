@@ -19,6 +19,8 @@ from .resources import Resources
 DO_NOT_DISRUPT = "karpenter.tpu/do-not-disrupt"
 
 _uid = itertools.count()
+# constraint-signature → small-int intern table backing Pod.group_key()
+_sig_intern: Dict[Tuple, int] = {}
 
 
 @dataclass
@@ -141,6 +143,7 @@ class Pod:
     node_name: Optional[str] = None  # bound node (None = pending)
     phase: str = "Pending"
     _sig: Optional[Tuple] = field(default=None, repr=False, compare=False)
+    _gid: Optional[int] = field(default=None, repr=False, compare=False)
 
     def scheduling_requirements(self) -> Requirements:
         """nodeSelector + required nodeAffinity as one Requirements conjunction."""
@@ -180,6 +183,16 @@ class Pod:
         """
         if self._sig is not None:
             return self._sig
+        # fast path: a plain pod (requests only — the overwhelmingly common
+        # shape at 100k-pod scale) skips building eight empty fields; no
+        # closure allocation here, this runs once per pod in the fleet
+        if not (self.labels or self.node_selector or self.node_affinity
+                or self.preferred_node_affinity or self.tolerations
+                or self.topology_spread or self.affinity_terms):
+            it = tuple(self.requests.items())
+            self._sig = (self.namespace, self.owner,
+                         it if len(it) <= 1 else tuple(sorted(it)))
+            return self._sig
         empty = ()
 
         def items(d):  # most of these dicts have 0-2 entries; sorted() on
@@ -212,3 +225,22 @@ class Pod:
                          for t in self.affinity_terms)) if self.affinity_terms else empty,
         )
         return self._sig
+
+    def group_key(self) -> int:
+        """Process-interned int id of constraint_signature().
+
+        Grouping 100k pods by nested-tuple signatures re-hashes every tuple
+        per solve; interning to a small int once per pod lifetime (the store
+        does it at admission) makes solve-time grouping an int-dict pass.
+        Ids only ever grow — equal signatures always map to the same id, so
+        grouping by id is exactly grouping by signature.
+        """
+        gid = self._gid
+        if gid is None:
+            sig = self.constraint_signature()
+            gid = _sig_intern.get(sig)
+            if gid is None:
+                gid = len(_sig_intern)
+                _sig_intern[sig] = gid
+            self._gid = gid
+        return gid

@@ -201,11 +201,22 @@ def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
     """Exact-dedupe pods into interchangeable groups (see
     Pod.constraint_signature). Order is deterministic: groups sorted by
     descending cpu-then-memory of the representative — the FFD 'decreasing'
-    ordering (reference designs/bin-packing.md sorts pods by size desc)."""
-    by_sig: Dict[tuple, List[Pod]] = {}
+    ordering (reference designs/bin-packing.md sorts pods by size desc).
+
+    Grouping keys on the interned int group id (Pod.group_key): for pods
+    the store already admitted this is one attribute read per pod, keeping
+    the 100k-pod steady-state encode off the Python signature path."""
+    by_gid: Dict[int, List[Pod]] = {}
     for p in pods:
-        by_sig.setdefault(p.constraint_signature(), []).append(p)
-    groups = [PodGroup(pods=v, representative=v[0]) for v in by_sig.values()]
+        gid = p._gid
+        if gid is None:
+            gid = p.group_key()
+        lst = by_gid.get(gid)
+        if lst is None:
+            by_gid[gid] = [p]
+        else:
+            lst.append(p)
+    groups = [PodGroup(pods=v, representative=v[0]) for v in by_gid.values()]
     groups.sort(key=lambda g: (-g.representative.requests.get("cpu"),
                                -g.representative.requests.get("memory"),
                                g.representative.name))

@@ -51,6 +51,10 @@ class Store:
     def add_pod(self, pod: Pod) -> Pod:
         key = f"{pod.namespace}/{pod.name}"
         self.pods[key] = pod
+        # amortize constraint-signature interning to admission time: the
+        # solve-time encode then groups 100k pods by one int read per pod
+        # instead of re-walking Python constraint objects every reconcile
+        pod.group_key()
         self._notify("pod", "add", pod)
         return pod
 
