@@ -1,0 +1,106 @@
+"""Multi-chip scaling benchmark on the virtual CPU mesh.
+
+Real multi-chip hardware is not attached in this environment (one tunneled
+TPU v5e chip), so the 1→8-device scaling curve runs on XLA's virtual host
+devices: it validates that the PRODUCTION mesh path (the same Solver facade
+call the provisioner makes, plus the sharded consolidation screen) compiles,
+executes, and stays result-identical at every device count — and reports
+wall times for the record. On CPU devices the absolute times measure host
+thread scheduling, not ICI; the point is the path, the shardings, and the
+collectives being exercised end-to-end.
+
+Prints ONE JSON line:
+  {"metric": "mesh scaling 100k pods / 5k-node screen", "detail": {...}}
+
+Run: python bench_mesh.py   (forces 8 virtual CPU devices itself)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from karpenter_tpu.catalog import generate_catalog
+    from karpenter_tpu.models.nodeclaim import NodeClaim
+    from karpenter_tpu.models.pod import Pod
+    from karpenter_tpu.models.resources import Resources
+    from karpenter_tpu.ops.binpack import VirtualNode
+    from karpenter_tpu.ops.consolidate import consolidation_screen
+    from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+    from karpenter_tpu.ops.solver import solve_device
+    from karpenter_tpu.parallel import make_mesh
+    from karpenter_tpu.state.cluster import NodeView
+
+    detail = {}
+    shapes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"),
+              ("2", "4Gi"), ("4", "16Gi"), ("500m", "4Gi"),
+              ("1", "8Gi"), ("250m", "1Gi")]
+    cat = encode_catalog(generate_catalog())
+    pods = [Pod(name=f"p{i}",
+                requests=Resources.parse({"cpu": shapes[i % 8][0],
+                                          "memory": shapes[i % 8][1]}))
+            for i in range(100_000)]
+    enc = encode_pods(pods, cat)
+
+    baseline_nodes = None
+    for nd in (1, 2, 4, 8):
+        mesh = make_mesh(nd)
+        r = solve_device(cat, enc, mesh=mesh)  # compile
+        t0 = time.perf_counter()
+        r = solve_device(cat, enc, mesh=mesh)
+        detail[f"solve_100k_{nd}dev_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        if baseline_nodes is None:
+            baseline_nodes = len(r.nodes)
+        assert len(r.nodes) == baseline_nodes, (
+            f"{nd}-device solve diverged: {len(r.nodes)} vs {baseline_nodes}")
+        assert not r.unschedulable
+    detail["solve_nodes"] = baseline_nodes
+
+    # 5k-node consolidation screen, sharded node axis
+    N = 5000
+    t2x = [i for i, n in enumerate(cat.names) if n.endswith(".2xlarge")][:20]
+    views = []
+    counts = np.zeros((N, enc.G), np.int32)
+    for i in range(N):
+        views.append(NodeView(
+            claim=NodeClaim(name=f"n{i}", nodepool="default"), node=None,
+            pods=[],
+            virtual=VirtualNode(type_idx=t2x[i % len(t2x)],
+                                zone_mask=np.ones(cat.Z, bool),
+                                cap_mask=np.ones(cat.C, bool),
+                                cum=np.asarray(enc.requests[i % enc.G] * 4,
+                                               np.float32),
+                                existing_name=f"n{i}"),
+            price=0.1))
+        counts[i, i % enc.G] = 4
+    base_screen = None
+    for nd in (1, 2, 4, 8):
+        mesh = make_mesh(nd)
+        s, _ = consolidation_screen(cat, enc, views, counts, mesh=mesh)
+        t0 = time.perf_counter()
+        s, _ = consolidation_screen(cat, enc, views, counts, mesh=mesh)
+        detail[f"screen_5k_{nd}dev_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        if base_screen is None:
+            base_screen = s
+        assert (s == base_screen).all(), f"{nd}-device screen diverged"
+
+    print(json.dumps({
+        "metric": "mesh scaling: 100k-pod solve + 5k-node screen, 1-8 virtual devices",
+        "value": detail["solve_100k_8dev_ms"], "unit": "ms",
+        "detail": detail}))
+
+
+if __name__ == "__main__":
+    main()

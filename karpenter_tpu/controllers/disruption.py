@@ -270,7 +270,9 @@ class DisruptionController:
                 if gi is not None:
                     counts[i, gi] += 1
         try:
-            screen, _slack = consolidation_screen(cat, enc, views, counts)
+            screen, _slack = consolidation_screen(
+                cat, enc, views, counts,
+                mesh=self.solver.screen_mesh(len(views)))
         except Exception:
             return candidates  # screen is best-effort; fall back to cost order
         ok = {v.name for i, v in enumerate(views) if screen[i]}

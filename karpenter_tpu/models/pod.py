@@ -19,8 +19,16 @@ from .resources import Resources
 DO_NOT_DISRUPT = "karpenter.tpu/do-not-disrupt"
 
 _uid = itertools.count()
-# constraint-signature → small-int intern table backing Pod.group_key()
+# constraint-signature → int intern table backing Pod.group_key(). Bounded:
+# per-pod-unique signatures (StatefulSet pod-name labels, rolling template
+# hashes) would otherwise accrete one retained tuple per pod ever admitted.
+# On overflow the table rotates (clears); ids are drawn from a monotonic
+# counter and NEVER reused, so a pod's cached _gid stays valid across
+# rotations — equal signatures in different generations may land in
+# different groups, which only costs a little dedupe, never correctness.
 _sig_intern: Dict[Tuple, int] = {}
+_SIG_INTERN_MAX = 1_000_000
+_next_gid = itertools.count()
 
 
 @dataclass
@@ -240,7 +248,9 @@ class Pod:
             sig = self.constraint_signature()
             gid = _sig_intern.get(sig)
             if gid is None:
-                gid = len(_sig_intern)
+                if len(_sig_intern) >= _SIG_INTERN_MAX:
+                    _sig_intern.clear()  # rotate; ids stay monotonic
+                gid = next(_next_gid)
                 _sig_intern[sig] = gid
             self._gid = gid
         return gid

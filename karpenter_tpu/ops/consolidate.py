@@ -34,10 +34,9 @@ from .binpack import BIG, EPS, VirtualNode
 from .encode import CatalogTensors, EncodedPods, align_resources
 
 
-@jax.jit
-def _screen_kernel(alloc, avail, node_type, node_cum, node_zmask, node_cmask,
-                   node_active, group_req, compat, allow_zone, allow_cap,
-                   node_groups):
+def _screen_kernel_impl(alloc, avail, node_type, node_cum, node_zmask,
+                        node_cmask, node_active, group_req, compat,
+                        allow_zone, allow_cap, node_groups):
     """Returns ONE packed f32 vector: [0:N] screen (1.0 = candidate may
     consolidate), [N:N+N*G] headroom slack (others' capacity minus need,
     row-major [N, G]) — consolidation_screen unpacks it after a single
@@ -69,33 +68,73 @@ def _screen_kernel(alloc, avail, node_type, node_cum, node_zmask, node_cmask,
                             (others - need).reshape(-1)])
 
 
+_screen_kernel = jax.jit(_screen_kernel_impl)
+
+# mesh-jitted screens, keyed on the (hashable) Mesh itself and capped —
+# id() keys break under address reuse and pin dead meshes forever
+_mesh_screen_cache: dict = {}
+_MESH_SCREEN_CACHE_MAX = 16
+
+
+def _mesh_screen_fn(mesh):
+    """Node-axis-sharded screen: each chip computes its nodes' k[m, g] rows;
+    the total-over-nodes reduction becomes a psum GSPMD inserts. The packed
+    output replicates for the single host read."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    fn = _mesh_screen_cache.get(mesh)
+    if fn is None:
+        if len(_mesh_screen_cache) >= _MESH_SCREEN_CACHE_MAX:
+            _mesh_screen_cache.clear()
+        fn = jax.jit(_screen_kernel_impl,
+                     out_shardings=NamedSharding(mesh, P()))
+        _mesh_screen_cache[mesh] = fn
+    return fn
+
+
 def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
                          views: "List",
-                         group_counts: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+                         group_counts: np.ndarray,
+                         mesh=None) -> Tuple[np.ndarray, np.ndarray]:
     """views: NodeView list; group_counts [N, G] = pods of group g on node n.
-    Returns (screen [N] bool, slack [N, G])."""
+    Returns (screen [N] bool, slack [N, G]).
+
+    mesh: shard the candidate-node axis across the mesh's chips (inactive
+    padding rows make N divisible); the production multi-chip path for
+    large-cluster consolidation."""
     R = enc.requests.shape[1]
     N = len(views)
     if N == 0:
         return np.zeros(0, bool), np.zeros((0, enc.G), np.float32)
+    Np = N if mesh is None else -(-N // int(mesh.size)) * int(mesh.size)
     alloc = align_resources(cat.allocatable, R)
-    node_type = np.array([v.virtual.type_idx for v in views], np.int32)
-    node_cum = np.zeros((N, R), np.float32)
-    node_zmask = np.zeros((N, cat.Z), bool)
-    node_cmask = np.zeros((N, cat.C), bool)
+    node_type = np.zeros(Np, np.int32)
+    node_cum = np.zeros((Np, R), np.float32)
+    node_zmask = np.zeros((Np, cat.Z), bool)
+    node_cmask = np.zeros((Np, cat.C), bool)
     for i, v in enumerate(views):
+        node_type[i] = v.virtual.type_idx
         node_cum[i, : len(v.virtual.cum)] = v.virtual.cum
         node_zmask[i] = v.virtual.zone_mask
         node_cmask[i] = v.virtual.cap_mask
-    active = np.ones(N, bool)
-    packed = _screen_kernel(
-        jnp.asarray(alloc), jnp.asarray(cat.available),
-        jnp.asarray(node_type), jnp.asarray(node_cum),
-        jnp.asarray(node_zmask), jnp.asarray(node_cmask),
-        jnp.asarray(active), jnp.asarray(enc.requests.astype(np.float32)),
-        jnp.asarray(enc.compat), jnp.asarray(enc.allow_zone),
-        jnp.asarray(enc.allow_cap), jnp.asarray(group_counts))
+    active = np.zeros(Np, bool)
+    active[:N] = True
+    counts = group_counts if Np == N else np.concatenate(
+        [group_counts, np.zeros((Np - N, enc.G), group_counts.dtype)])
+    args = (alloc, cat.available, node_type, node_cum, node_zmask, node_cmask,
+            active, enc.requests.astype(np.float32), enc.compat,
+            enc.allow_zone, enc.allow_cap, counts)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        nodes_sh = NamedSharding(mesh, P("nodes"))
+        rep_sh = NamedSharding(mesh, P())
+        # node-axis arrays shard; catalog + group arrays replicate
+        sharded = [rep_sh, rep_sh, nodes_sh, nodes_sh, nodes_sh, nodes_sh,
+                   nodes_sh, rep_sh, rep_sh, rep_sh, rep_sh, nodes_sh]
+        packed = _mesh_screen_fn(mesh)(
+            *(jax.device_put(np.asarray(a), s) for a, s in zip(args, sharded)))
+    else:
+        packed = _screen_kernel(*(jnp.asarray(a) for a in args))
     buf = np.asarray(packed)  # ONE host read
     screen = buf[:N] > 0.5
-    slack = buf[N:].reshape(N, enc.G)
+    slack = buf[Np: Np + N * enc.G].reshape(N, enc.G)
     return screen, slack

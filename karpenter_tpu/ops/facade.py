@@ -36,6 +36,7 @@ from .encode import (CatalogTensors, EncodedPods, align_resources,
                      encode_catalog, encode_pods)
 
 MAX_OVERRIDES = 60  # reference MaxInstanceTypes (instance.go:62)
+_MESH_UNSET = object()
 
 
 def _min_values_floors(requirements: Optional[Requirements],
@@ -94,6 +95,7 @@ class Solver:
         self._cat_cache: Dict[tuple, CatalogTensors] = {}
         self._dcat_cache: Dict[tuple, object] = {}  # device-resident tensors
         self._last_cat_key: tuple = ()
+        self._mesh_obj = _MESH_UNSET
 
     @staticmethod
     def _accel_attached() -> bool:
@@ -112,11 +114,46 @@ class Solver:
         from . import native
         return "native" if native.available() else "host"
 
+    def mesh(self):
+        """The multi-chip mesh this solver shards over, or None single-chip.
+        Built lazily on first use; a "nodes"-axis Mesh over every attached
+        device (parallel/mesh.py)."""
+        if self._mesh_obj is _MESH_UNSET:
+            self._mesh_obj = None
+            try:
+                import jax
+                if len(jax.devices()) > 1:
+                    from ..parallel.mesh import make_mesh
+                    self._mesh_obj = make_mesh()
+            except Exception:
+                pass
+        return self._mesh_obj
+
+    # screen sharding threshold in CANDIDATE NODES — deliberately separate
+    # from device_min_pods (a pod-count calibration for solve routing):
+    # the [N, G] screen's cost model is per-node rows, and tuning one
+    # knob must not silently retune the other
+    SCREEN_MESH_MIN_NODES = 1024
+
+    def screen_mesh(self, n_nodes: int):
+        """Mesh for the consolidation screen's node axis, or None when the
+        single-device path is the right call (small clusters, no mesh)."""
+        if self.backend == "mesh":
+            return self.mesh()
+        if (self.backend == "hybrid"
+                and n_nodes >= self.SCREEN_MESH_MIN_NODES):
+            return self.mesh()
+        return None
+
     def _resolve_backend(self, total_pods: int) -> str:
+        if self.backend == "mesh":
+            return "mesh"
         if self.backend != "hybrid":
             return self.backend
         if total_pods >= self.device_min_pods:
-            return "device"
+            # multi-chip attached → shard the node axis over the mesh; the
+            # same facade call the provisioner makes reaches all chips
+            return "mesh" if self.mesh() is not None else "device"
         from . import native
         return "native" if native.available() else "host"
 
@@ -258,16 +295,18 @@ class Solver:
             else:
                 from .solver import device_catalog, solve_device
                 R = enc.requests.shape[1]
-                # keyed on (nodeclass hash, catalog epoch, R) — NOT id(cat):
-                # a freed CatalogTensors' address can be reused by its
-                # successor
-                dkey = self._last_cat_key + (R,)
+                mesh = self.mesh() if backend == "mesh" else None
+                # keyed on (nodeclass hash, catalog epoch, R, placement) —
+                # NOT id(cat): a freed CatalogTensors' address can be
+                # reused by its successor
+                dkey = self._last_cat_key + (R, backend == "mesh")
                 dcat = self._dcat_cache.get(dkey)
                 if dcat is None:
                     self._dcat_cache.clear()  # one epoch resident at a time
-                    dcat = device_catalog(cat, R)
+                    dcat = device_catalog(cat, R, mesh=mesh)
                     self._dcat_cache[dkey] = dcat
-                result = solve_device(cat, enc, existing, dcat=dcat)
+                result = solve_device(cat, enc, existing, dcat=dcat,
+                                      mesh=mesh)
         SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=backend)
         SOLVE_PODS.observe(float(enc.counts.sum()))
 

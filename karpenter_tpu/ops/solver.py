@@ -46,11 +46,19 @@ class DeviceCatalog:
     avail: jax.Array      # bool [T, Z, C]
 
 
-def device_catalog(cat: CatalogTensors, R: int) -> DeviceCatalog:
+def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
+    """mesh: replicate the catalog over the mesh's devices (the sharded
+    solve reads it on every chip) instead of committing to device 0."""
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(mesh, P())
+        put = lambda x: jax.device_put(np.asarray(x), rep)
+    else:
+        put = jnp.asarray
     return DeviceCatalog(
-        alloc=jnp.asarray(align_resources(cat.allocatable, R)),
-        price=jnp.asarray(cat.price),
-        avail=jnp.asarray(cat.available),
+        alloc=put(align_resources(cat.allocatable, R)),
+        price=put(cat.price),
+        avail=put(cat.available),
     )
 
 
@@ -178,12 +186,11 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
     return ntype, cum, zmask, cmask, nopen, nused, takes, unsched, clamped.any()
 
 
-@partial(jax.jit, static_argnames=("n_max", "k_max", "track_conflicts"))
-def _solve_kernel_packed(alloc, price, avail, requests, counts, compat,
-                         allow_zone, allow_cap, max_per_node, prior_counts,
-                         banned, conflict, node_type, node_cum, node_zmask,
-                         node_cmask, node_open, n_used, n_max: int,
-                         k_max: int, track_conflicts: bool = False):
+def _solve_kernel_packed_impl(alloc, price, avail, requests, counts, compat,
+                              allow_zone, allow_cap, max_per_node, prior_counts,
+                              banned, conflict, node_type, node_cum, node_zmask,
+                              node_cmask, node_open, n_used, n_max: int,
+                              k_max: int, track_conflicts: bool = False):
     """Kernel + single-buffer output packing.
 
     The deployment TPU sits behind a network tunnel where every host read
@@ -217,6 +224,70 @@ def _solve_kernel_packed(alloc, price, avail, requests, counts, compat,
         idx.astype(jnp.int32),
         vals.astype(jnp.int32),
     ])
+
+
+_solve_kernel_packed = partial(
+    jax.jit, static_argnames=("n_max", "k_max", "track_conflicts")
+)(_solve_kernel_packed_impl)
+
+
+# mesh-jitted packed kernels, keyed on the (hashable) Mesh itself — id()
+# keys break under address reuse and pin dead meshes; the cap bounds both
+# executable count and the meshes the cache keeps alive
+_mesh_fn_cache: dict = {}
+_MESH_FN_CACHE_MAX = 32
+
+
+def _mesh_packed_fn(mesh, n_max: int, k_max: int, track: bool):
+    """jit the packed kernel for a node-axis-sharded mesh run. Inputs are
+    device_put with explicit shardings by the caller; GSPMD propagates them
+    through the scan and inserts the ICI collectives (cumsum/argmin/sum
+    reductions over the node axis). The packed output replicates — it's a
+    small int32 vector read once by the host."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    key = (mesh, n_max, k_max, track)
+    fn = _mesh_fn_cache.get(key)
+    if fn is None:
+        if len(_mesh_fn_cache) >= _MESH_FN_CACHE_MAX:
+            _mesh_fn_cache.clear()
+        fn = jax.jit(
+            partial(_solve_kernel_packed_impl, n_max=n_max, k_max=k_max,
+                    track_conflicts=track),
+            out_shardings=NamedSharding(mesh, P()))
+        _mesh_fn_cache[key] = fn
+    return fn
+
+
+def _group_inputs(enc: EncodedPods, Gp: int):
+    """Pad the per-group arrays to the scan bucket — the ONE prep both
+    solve_device and the kernel_args bench seam share, so the published
+    kernel timing can't drift from the production shapes."""
+    return (_pad_to(enc.requests.astype(np.float32), Gp),
+            _pad_to(enc.counts.astype(np.int32), Gp),
+            _pad_to(enc.compat, Gp),
+            _pad_to(enc.allow_zone, Gp),
+            _pad_to(enc.allow_cap, Gp),
+            _pad_to(enc.max_per_node.astype(np.int32), Gp))
+
+
+def _auto_node_budget(cat: CatalogTensors, enc: EncodedPods,
+                      n_existing: int) -> int:
+    """Node-axis budget: the estimate commits the same cost-per-slot argmin
+    type the kernel does and lands within a few % of n_used, so 1.25x
+    margin suffices; underestimates are safe — the kernel reports overflow
+    and solve_device retries doubled."""
+    est = _estimate_nodes(cat, enc)
+    return _bucket(n_existing + max(64, est + est // 4 + enc.G))
+
+
+def _mesh_put(mesh, np_arrays_nodes, np_arrays_rep):
+    """device_put node-axis arrays as P('nodes') shards and the rest
+    replicated; returns the two lists of device arrays."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    nodes = NamedSharding(mesh, P("nodes"))
+    rep = NamedSharding(mesh, P())
+    return ([jax.device_put(a, nodes) for a in np_arrays_nodes],
+            [jax.device_put(a, rep) for a in np_arrays_rep])
 
 
 def _pad_to(x: np.ndarray, n: int, axis: int = 0, fill=0):
@@ -284,32 +355,25 @@ def kernel_args(cat: CatalogTensors, enc: EncodedPods,
 
     Returns (args_tuple, n_max, k_max, track_conflicts)."""
     R = enc.requests.shape[1]
-    G = enc.G
-    Gp = _bucket(G, 8)
+    Gp = _bucket(enc.G, 8)
     if dcat is None or dcat.alloc.shape[1] != R:
         dcat = device_catalog(cat, R)
-    est = _estimate_nodes(cat, enc)
-    n_max = _bucket(max(64, est + est // 4 + G))
+    n_max = _auto_node_budget(cat, enc, 0)
     k_max = _bucket(2 * n_max)
     track = enc.conflict is not None
     conflict = (_pad_to(_pad_to(enc.conflict, Gp, 0), Gp, 1) if track
                 else np.zeros((Gp, 1), bool))
-    args = (dcat.alloc, dcat.price, dcat.avail,
-            jnp.asarray(_pad_to(enc.requests.astype(np.float32), Gp)),
-            jnp.asarray(_pad_to(enc.counts.astype(np.int32), Gp)),
-            jnp.asarray(_pad_to(enc.compat, Gp)),
-            jnp.asarray(_pad_to(enc.allow_zone, Gp)),
-            jnp.asarray(_pad_to(enc.allow_cap, Gp)),
-            jnp.asarray(_pad_to(enc.max_per_node.astype(np.int32), Gp)),
-            jnp.asarray(np.zeros((Gp, 1), np.int32)),
-            jnp.asarray(np.zeros((Gp, 1), bool)),
-            jnp.asarray(conflict),
-            jnp.asarray(np.zeros(n_max, np.int32)),
-            jnp.asarray(np.zeros((n_max, R), np.float32)),
-            jnp.asarray(np.zeros((n_max, cat.Z), bool)),
-            jnp.asarray(np.zeros((n_max, cat.C), bool)),
-            jnp.asarray(np.zeros(n_max, bool)),
-            jnp.asarray(0, jnp.int32))
+    args = ((dcat.alloc, dcat.price, dcat.avail)
+            + tuple(jnp.asarray(a) for a in _group_inputs(enc, Gp))
+            + (jnp.asarray(np.zeros((Gp, 1), np.int32)),
+               jnp.asarray(np.zeros((Gp, 1), bool)),
+               jnp.asarray(conflict),
+               jnp.asarray(np.zeros(n_max, np.int32)),
+               jnp.asarray(np.zeros((n_max, R), np.float32)),
+               jnp.asarray(np.zeros((n_max, cat.Z), bool)),
+               jnp.asarray(np.zeros((n_max, cat.C), bool)),
+               jnp.asarray(np.zeros(n_max, bool)),
+               jnp.asarray(0, jnp.int32)))
     return args, n_max, k_max, track
 
 
@@ -337,9 +401,14 @@ def kernel_device_time(cat: CatalogTensors, enc: EncodedPods,
 def solve_device(cat: CatalogTensors, enc: EncodedPods,
                  existing: Optional[List[VirtualNode]] = None,
                  n_max: Optional[int] = None,
-                 dcat: Optional[DeviceCatalog] = None) -> SolveResult:
+                 dcat: Optional[DeviceCatalog] = None,
+                 mesh=None) -> SolveResult:
     """Run the kernel and decode the result to the same SolveResult shape
-    solve_host produces. `enc` must be spread-free (split_spread_groups)."""
+    solve_host produces. `enc` must be spread-free (split_spread_groups).
+
+    mesh: a jax.sharding.Mesh with a "nodes" axis — the node axis shards
+    across the mesh's chips (catalog + group inputs replicated; GSPMD
+    inserts the ICI collectives), the production multi-chip path."""
     assert not enc.spread_zone.any(), "run split_spread_groups before solve"
     R = enc.requests.shape[1]
     existing = existing or []
@@ -350,24 +419,19 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
     if auto_n:
         # node budget from per-group best-type slots (the kernel's per-step
         # cost is O(n_max), so a tight guess matters: 100k small pods pack
-        # ~100/node, not 4). The estimate commits the same cost-per-slot
-        # argmin type the kernel does and lands within a few % of n_used,
-        # so 1.25x margin suffices; underestimates are safe — the kernel
-        # reports overflow and we retry doubled.
-        est = _estimate_nodes(cat, enc)
-        n_max = _bucket(n_existing + max(64, est + est // 4 + G))
+        # ~100/node, not 4)
+        n_max = _auto_node_budget(cat, enc, n_existing)
+    if mesh is not None:
+        ms = int(mesh.size)
+        n_max = -(-n_max // ms) * ms  # shardable node axis
     Gp = _bucket(G, 8)
 
     if dcat is None or dcat.alloc.shape[1] != R:
-        dcat = device_catalog(cat, R)
+        dcat = device_catalog(cat, R, mesh=mesh)
 
     # pad group inputs; padded groups have count 0 → no-ops in the scan
-    requests = _pad_to(enc.requests.astype(np.float32), Gp)
-    counts = _pad_to(enc.counts.astype(np.int32), Gp)
-    compat = _pad_to(enc.compat, Gp)
-    allow_zone = _pad_to(enc.allow_zone, Gp)
-    allow_cap = _pad_to(enc.allow_cap, Gp)
-    max_per_node = _pad_to(enc.max_per_node.astype(np.int32), Gp)
+    (requests, counts, compat, allow_zone, allow_cap,
+     max_per_node) = _group_inputs(enc, Gp)
 
     node_type = np.zeros(n_existing, np.int32)
     node_cum = np.zeros((n_existing, R), np.float32)
@@ -406,17 +470,38 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
                         prior[g, i] = cnt
             if has_banned and n.banned_groups is not None:
                 banned[: len(n.banned_groups), i] = n.banned_groups
-        packed = _solve_kernel_packed(
-            dcat.alloc, dcat.price, dcat.avail, requests, counts,
-            compat, allow_zone, allow_cap, max_per_node, jnp.asarray(prior),
-            jnp.asarray(banned), jnp.asarray(conflict),
-            jnp.asarray(_pad_to(node_type, n_max)),
-            jnp.asarray(_pad_to(node_cum, n_max)),
-            jnp.asarray(_pad_to(node_zmask, n_max)),
-            jnp.asarray(_pad_to(node_cmask, n_max)),
-            jnp.asarray(_pad_to(node_open, n_max)),
-            jnp.asarray(n_existing, jnp.int32), n_max=n_max, k_max=k_max,
-            track_conflicts=track)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            nodes_sh = NamedSharding(mesh, P("nodes"))
+            rep_sh = NamedSharding(mesh, P())
+            gn_sh = NamedSharding(mesh, P(None, "nodes"))
+            put = jax.device_put
+            packed = _mesh_packed_fn(mesh, n_max, k_max, track)(
+                dcat.alloc, dcat.price, dcat.avail,
+                put(requests, rep_sh), put(counts, rep_sh),
+                put(compat, rep_sh), put(allow_zone, rep_sh),
+                put(allow_cap, rep_sh), put(max_per_node, rep_sh),
+                put(prior, gn_sh if has_prior else rep_sh),
+                put(banned, gn_sh if has_banned else rep_sh),
+                put(conflict, rep_sh),
+                put(_pad_to(node_type, n_max), nodes_sh),
+                put(_pad_to(node_cum, n_max), nodes_sh),
+                put(_pad_to(node_zmask, n_max), nodes_sh),
+                put(_pad_to(node_cmask, n_max), nodes_sh),
+                put(_pad_to(node_open, n_max), nodes_sh),
+                put(np.asarray(n_existing, np.int32), rep_sh))
+        else:
+            packed = _solve_kernel_packed(
+                dcat.alloc, dcat.price, dcat.avail, requests, counts,
+                compat, allow_zone, allow_cap, max_per_node, jnp.asarray(prior),
+                jnp.asarray(banned), jnp.asarray(conflict),
+                jnp.asarray(_pad_to(node_type, n_max)),
+                jnp.asarray(_pad_to(node_cum, n_max)),
+                jnp.asarray(_pad_to(node_zmask, n_max)),
+                jnp.asarray(_pad_to(node_cmask, n_max)),
+                jnp.asarray(_pad_to(node_open, n_max)),
+                jnp.asarray(n_existing, jnp.int32), n_max=n_max, k_max=k_max,
+                track_conflicts=track)
         buf = np.asarray(packed)  # ONE host read
         nused, overflowed, nnz = int(buf[0]), bool(buf[1]), int(buf[2])
         o = 3
@@ -431,6 +516,9 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
         if not overflowed or not auto_n or n_max >= n_existing + total_pods:
             break
         n_max = min(_bucket(n_max * 2), _bucket(n_existing + total_pods))
+        if mesh is not None:
+            ms = int(mesh.size)
+            n_max = -(-n_max // ms) * ms
         k_max = _bucket(2 * n_max)
 
     # --- host-side reconstruction (vectorized, no device reads) ---

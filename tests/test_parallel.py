@@ -48,6 +48,73 @@ def test_sharded_solve_agrees_with_host():
             assert takes[g, i] == n.pods_by_group.get(g, 0)
 
 
+def test_mesh_backend_facade_parity():
+    """The PRODUCTION multi-chip path: Solver(backend='mesh') — the same
+    facade call the provisioner makes — must agree launch-for-launch with
+    the host backend on the 8-device CPU mesh, including existing-node
+    reuse and a larger mixed workload."""
+    from karpenter_tpu.catalog import CatalogProvider
+    from karpenter_tpu.models.nodepool import NodePool
+    from karpenter_tpu.ops.facade import Solver
+
+    shapes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"),
+              ("2", "4Gi"), ("4", "16Gi"), ("500m", "4Gi")]
+    pods = [Pod(name=f"p{i}",
+                requests=Resources.parse({"cpu": shapes[i % 6][0],
+                                          "memory": shapes[i % 6][1]}))
+            for i in range(3000)]
+    pool = NodePool(name="mesh-pool")
+    mesh_solver = Solver(CatalogProvider(lambda: small_catalog()),
+                         backend="mesh")
+    host_solver = Solver(CatalogProvider(lambda: small_catalog()),
+                         backend="host")
+    assert mesh_solver.mesh() is not None and mesh_solver.mesh().size == 8
+    m = mesh_solver.solve(pods, pool)
+    h = host_solver.solve(pods, pool)
+    assert not m.unschedulable and not h.unschedulable
+    assert len(m.launches) == len(h.launches)
+    for lm, lh in zip(m.launches, h.launches):
+        assert lm.instance_type == lh.instance_type
+        assert lm.capacity_type == lh.capacity_type
+        assert sorted(lm.pod_keys) == sorted(lh.pod_keys)
+
+
+def test_mesh_screen_parity():
+    """The sharded consolidation screen must agree with the single-device
+    screen, including non-divisible candidate counts (padding rows)."""
+    from karpenter_tpu.models.nodeclaim import NodeClaim
+    from karpenter_tpu.ops.binpack import VirtualNode
+    from karpenter_tpu.ops.consolidate import consolidation_screen
+    from karpenter_tpu.parallel import make_mesh
+    from karpenter_tpu.state.cluster import NodeView
+
+    cat = encode_catalog(small_catalog())
+    pods = [Pod(name=f"s{i}",
+                requests=Resources.parse({"cpu": "1", "memory": "2Gi"}))
+            for i in range(100)]
+    enc = encode_pods(pods, cat)
+    N = 37  # deliberately not divisible by 8
+    views = []
+    counts = np.zeros((N, enc.G), np.int32)
+    for i in range(N):
+        cum = np.zeros(len(cat.resources), np.float32)
+        if i % 3 == 0:  # every third node carries load
+            cum[0] = 30.0
+            counts[i, 0] = 4
+        views.append(NodeView(
+            claim=NodeClaim(name=f"n{i}", nodepool="p"), node=None, pods=[],
+            virtual=VirtualNode(type_idx=i % cat.T,
+                                zone_mask=np.ones(cat.Z, bool),
+                                cap_mask=np.ones(cat.C, bool),
+                                cum=cum, existing_name=f"n{i}"),
+            price=0.1))
+    s1, sl1 = consolidation_screen(cat, enc, views, counts)
+    mesh = make_mesh(8)
+    s2, sl2 = consolidation_screen(cat, enc, views, counts, mesh=mesh)
+    assert (s1 == s2).all()
+    np.testing.assert_allclose(sl1, sl2, rtol=1e-6)
+
+
 def test_graft_entry_contract():
     """The driver's entry() must stay jittable with its example args."""
     import sys

@@ -114,14 +114,18 @@ class TestReviewFixes:
     def test_hybrid_backend_routes_by_size(self):
         """'hybrid' (what auto resolves to on accelerator hosts) routes
         small solves native/host — the device dispatch+readback latency
-        floor beats them — and large solves to the device kernel."""
+        floor beats them — and large solves to the device kernel (the
+        mesh-sharded variant whenever more than one chip is attached)."""
+        import jax
+
         from karpenter_tpu.catalog import CatalogProvider, small_catalog
         from karpenter_tpu.ops.facade import Solver
         s = Solver(CatalogProvider(lambda: small_catalog()),
                    backend="hybrid", device_min_pods=100)
+        big = "mesh" if len(jax.devices()) > 1 else "device"
         assert s._resolve_backend(10) in ("native", "host")
-        assert s._resolve_backend(100) == "device"
-        assert s._resolve_backend(10_000) == "device"
+        assert s._resolve_backend(100) == big
+        assert s._resolve_backend(10_000) == big
         s2 = Solver(CatalogProvider(lambda: small_catalog()), backend="host")
         assert s2._resolve_backend(10_000_000) == "host"  # explicit wins
 
