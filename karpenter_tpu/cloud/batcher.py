@@ -1,117 +1,170 @@
-"""Windowed request coalescing — the reference's concurrency kernel.
+"""Cross-controller wire-call coalescing — the reference's batcher.
 
-Reference: pkg/batcher/batcher.go:32-84 — generic Batcher[T, U] with
-per-hash buckets, an idle-timeout/max-timeout trigger window, and a batch
-executor that fans one wire call back out to N callers. Instantiated for
-CreateFleet (one bucket), DescribeInstances (hash by filters), and
-TerminateInstances. Ours is asyncio-based with the same Options surface;
-the deterministic sim engine doesn't need it (one reconciler), but the
-async runtime batches concurrent reconcilers' cloud calls through it.
+Reference: pkg/batcher/batcher.go:32-84 runs a generic Batcher[T, U] with
+per-hash buckets and an idle/max-window trigger, instantiated for
+CreateFleet (createfleet.go:47), DescribeInstances (describeinstances.go:50)
+and TerminateInstances (terminateinstances.go:49); N goroutines' requests
+fan into one wire call.
+
+Our controllers are synchronous reconcilers on one event loop, so the same
+coalescing inverts: `BatchingCloud` wraps the CloudProvider and
+
+- **terminate** accumulates instance ids (fire-and-forget — no caller
+  consumes a result) and a runtime flusher task sends ONE wire call per
+  idle/max window for every controller's terminations combined
+  (termination + GC + lifecycle reap within a window share the call);
+  retryable cloud errors keep the batch pending for the next window.
+- **describe** coalesces reads: calls with equal filters inside one idle
+  window share a single wire sweep (the reference hashes DescribeInstances
+  by filter set the same way). The cache invalidates whenever a
+  termination batch flushes, so post-write reads never serve pre-write
+  state beyond the window.
+- **create_fleet** passes through — the provisioner already aggregates a
+  whole reconcile's launches into one call (the natural batch; the
+  reference's one-bucket CreateFleet batcher exists because its callers
+  are per-claim goroutines, ours is already a list API) — and records the
+  batch size on the same metric family.
+
+Every other CloudProvider method delegates untouched. The deterministic
+sim engine keeps the raw cloud (single sequential reconciler — nothing to
+coalesce); the async runtime (main.build_operator) wraps the cloud and
+registers `flusher()` as a high-frequency controller.
 """
 
 from __future__ import annotations
 
-import asyncio
-from dataclasses import dataclass, field
-from typing import (Awaitable, Callable, Dict, Generic, Hashable, List,
-                    Optional, Sequence, TypeVar)
+from typing import Dict, List, Optional, Tuple
 
-T = TypeVar("T")  # request item
-U = TypeVar("U")  # response item
+from ..metrics import BATCH_SIZE
+from .provider import CloudError
 
 DEFAULT_IDLE = 0.100   # reference: 100ms idle window
 DEFAULT_MAX = 1.0      # reference: 1s max window
 DEFAULT_MAX_ITEMS = 500
 
 
-@dataclass
-class BatcherOptions:
-    idle_timeout: float = DEFAULT_IDLE
-    max_timeout: float = DEFAULT_MAX
-    max_items: int = DEFAULT_MAX_ITEMS
-    # request hasher: requests with equal hashes share a wire call
-    request_hasher: Callable[[object], Hashable] = lambda _req: 0
+class BatchingCloud:
+    """CloudProvider wrapper coalescing wire calls across controllers."""
 
+    def __init__(self, inner, clock, idle: float = DEFAULT_IDLE,
+                 max_window: float = DEFAULT_MAX,
+                 max_items: int = DEFAULT_MAX_ITEMS):
+        self.inner = inner
+        self.clock = clock
+        self.idle = idle
+        self.max_window = max_window
+        self.max_items = max_items
+        self._pending: List[str] = []      # terminate ids, insertion order
+        self._pending_set: set = set()
+        self._first_at = 0.0
+        self._last_add = 0.0
+        self._retry_after = 0.0            # throttle backoff gate
+        self._backoff = 0.0
+        # describe read-coalescing: filter-key -> (fetched_at, result)
+        self._describe_cache: Dict[Optional[Tuple[str, ...]],
+                                   Tuple[float, list]] = {}
+        self.stats = {"terminate_batches": 0, "terminate_items": 0,
+                      "largest_batch": 0, "describe_calls": 0,
+                      "describe_coalesced": 0, "terminate_errors": 0}
 
-class Batcher(Generic[T, U]):
-    """executor(batch) -> list of per-item results (or one exception for
-    the whole batch). Callers `await submit(item)` and get their item's
-    result."""
-
-    def __init__(self, executor: Callable[[List[T]], Awaitable[List[U]]],
-                 options: Optional[BatcherOptions] = None):
-        self.executor = executor
-        self.options = options or BatcherOptions()
-        self._buckets: Dict[Hashable, "_Bucket[T, U]"] = {}
-        self.stats = {"batches": 0, "items": 0, "largest_batch": 0}
-
-    async def submit(self, item: T) -> U:
-        key = self.options.request_hasher(item)
-        bucket = self._buckets.get(key)
-        if bucket is None or bucket.closed:
-            bucket = _Bucket(self)
-            self._buckets[key] = bucket
-        return await bucket.add(item)
-
-
-class _Bucket(Generic[T, U]):
-    def __init__(self, parent: Batcher):
-        self.parent = parent
-        self.items: List[T] = []
-        self.futures: List[asyncio.Future] = []
-        self.closed = False
-        self._first_at: Optional[float] = None
-        self._idle_task: Optional[asyncio.Task] = None
-        self._loop = asyncio.get_event_loop()
-
-    async def add(self, item: T) -> U:
-        opts = self.parent.options
-        fut: asyncio.Future = self._loop.create_future()
-        self.items.append(item)
-        self.futures.append(fut)
-        now = self._loop.time()
-        if self._first_at is None:
+    # --- terminate: windowed write coalescing ---
+    def terminate(self, instance_ids: List[str]) -> None:
+        now = self.clock.now()
+        if not self._pending:
             self._first_at = now
-        if len(self.items) >= opts.max_items:
-            self._fire()
-        else:
-            if self._idle_task is not None:
-                self._idle_task.cancel()
-            remaining_max = self._first_at + opts.max_timeout - now
-            delay = min(opts.idle_timeout, max(0.0, remaining_max))
-            self._idle_task = self._loop.create_task(self._fire_after(delay))
-        return await fut
+        for iid in instance_ids:
+            if iid not in self._pending_set:
+                self._pending.append(iid)
+                self._pending_set.add(iid)
+        self._last_add = now
+        if len(self._pending) >= self.max_items and now >= self._retry_after:
+            self._flush_terminations()
 
-    async def _fire_after(self, delay: float) -> None:
+    def flush(self, now: Optional[float] = None) -> None:
+        """Send the pending termination batch when its window has closed
+        (idle since last add, or max window since first add). A throttled
+        flush backs off exponentially — retrying every window would
+        amplify the very throttling it hit."""
+        if not self._pending:
+            return
+        now = self.clock.now() if now is None else now
+        if now < self._retry_after:
+            return
+        if (now - self._last_add >= self.idle
+                or now - self._first_at >= self.max_window):
+            self._flush_terminations()
+
+    def _flush_terminations(self) -> None:
+        batch, self._pending = self._pending, []
+        self._pending_set = set()
         try:
-            await asyncio.sleep(delay)
-        except asyncio.CancelledError:
+            self.inner.terminate(batch)  # ONE wire call for N controllers
+        except CloudError as e:
+            self.stats["terminate_errors"] += 1
+            if getattr(e, "retryable", False):
+                # keep the batch for a later window — the callers that
+                # fired these already moved on, the flusher owns the retry
+                self._pending = batch
+                self._pending_set = set(batch)
+                now = self.clock.now()
+                self._first_at = self._last_add = now
+                self._backoff = min(max(self._backoff * 2, self.idle), 30.0)
+                self._retry_after = now + self._backoff
+                return
+            # non-retryable batch error: one bad id must not poison (and
+            # silently drop) the rest — fall back to per-id calls, letting
+            # individually-bad ids fail alone (the GC sweep is the final
+            # backstop for anything that still leaks)
+            for iid in batch:
+                try:
+                    self.inner.terminate([iid])
+                except CloudError:
+                    self.stats["terminate_errors"] += 1
+            self._describe_cache.clear()
             return
-        self._fire()
+        self._backoff = 0.0
+        self._retry_after = 0.0
+        BATCH_SIZE.observe(float(len(batch)), op="terminate")
+        self.stats["terminate_batches"] += 1
+        self.stats["terminate_items"] += len(batch)
+        self.stats["largest_batch"] = max(self.stats["largest_batch"],
+                                          len(batch))
+        self._describe_cache.clear()  # reads must see the writes
 
-    def _fire(self) -> None:
-        if self.closed or not self.items:
-            return
-        self.closed = True
-        if self._idle_task is not None:
-            self._idle_task.cancel()
-        items, futures = self.items, self.futures
-        stats = self.parent.stats
-        stats["batches"] += 1
-        stats["items"] += len(items)
-        stats["largest_batch"] = max(stats["largest_batch"], len(items))
+    # --- describe: windowed read coalescing ---
+    def describe(self, instance_ids: Optional[List[str]] = None) -> list:
+        key = None if instance_ids is None else tuple(sorted(instance_ids))
+        now = self.clock.now()
+        hit = self._describe_cache.get(key)
+        if hit is not None and now - hit[0] < self.idle:
+            self.stats["describe_coalesced"] += 1
+            return hit[1]
+        result = self.inner.describe(instance_ids)
+        self._describe_cache[key] = (now, result)
+        self.stats["describe_calls"] += 1
+        return result
 
-        async def run():
-            try:
-                results = await self.parent.executor(items)
-                for f, r in zip(futures, results):
-                    if not f.done():
-                        if isinstance(r, Exception):
-                            f.set_exception(r)
-                        else:
-                            f.set_result(r)
-            except Exception as e:  # batch-wide failure fans out to all
-                for f in futures:
-                    if not f.done():
-                        f.set_exception(e)
-        self._loop.create_task(run())
+    # --- create_fleet: natural per-reconcile batch, metered ---
+    def create_fleet(self, requests: list) -> list:
+        BATCH_SIZE.observe(float(len(requests)), op="create_fleet")
+        try:
+            return self.inner.create_fleet(requests)
+        finally:
+            self._describe_cache.clear()  # reads must see the new instances
+
+    def flusher(self):
+        """A controller driving the window clock — register with the
+        runtime (or engine) alongside the real controllers."""
+        outer = self
+
+        class _Flusher:
+            name = "cloud.batcher.flush"
+
+            def reconcile(self, now: float) -> float:
+                outer.flush(now)
+                return outer.idle / 2
+
+        return _Flusher()
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)

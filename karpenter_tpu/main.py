@@ -43,23 +43,29 @@ def build_operator(options: Optional[Options] = None,
     store = store or Store()
     cloud = cloud or FakeCloud(generate_catalog(
         GeneratorConfig(region=opts.region)), clock=clock)
+    # every controller speaks to the batching wrapper: terminations from
+    # termination+gc+lifecycle coalesce into one wire call per window,
+    # describe sweeps within a window share one call (reference
+    # pkg/batcher/); the raw cloud stays the simulation/tick seam
+    from .cloud.batcher import BatchingCloud
+    bcloud = BatchingCloud(cloud, clock)
     catalog = CatalogProvider(lambda: cloud.describe_types(), clock=clock)
     catalog.raw_types()  # sync hydrate before controllers start
     solver = Solver(catalog, backend=opts.solver_backend,
                     profile_dir=opts.profile_dir)
-    provisioner = Provisioner(store=store, solver=solver, cloud=cloud,
+    provisioner = Provisioner(store=store, solver=solver, cloud=bcloud,
                               catalog=catalog,
                               batch_idle=opts.batch_idle_seconds)
-    lifecycle = LifecycleController(store=store, cloud=cloud)
+    lifecycle = LifecycleController(store=store, cloud=bcloud)
     binding = BindingController(store=store)
-    termination = TerminationController(store=store, cloud=cloud,
+    termination = TerminationController(store=store, cloud=bcloud,
                                         catalog=catalog)
     disruption = DisruptionController(store=store, solver=solver,
                                       catalog=catalog,
                                       provisioner=provisioner,
                                       termination=termination,
                                       spot_to_spot=opts.gate("SpotToSpotConsolidation"))
-    gc = GarbageCollectionController(store=store, cloud=cloud)
+    gc = GarbageCollectionController(store=store, cloud=bcloud)
     metrics_c = CloudProviderMetricsController(catalog=catalog, store=store)
     from .cloud.image import ImageProvider
     from .controllers.auxiliary import (CatalogRefreshController,
@@ -68,19 +74,20 @@ def build_operator(options: Optional[Options] = None,
                                         TaggingController)
     from .controllers.nodeclass import NodeClassController
     from .controllers.repair import NodeRepairController
-    nodeclass_c = NodeClassController(store=store, cloud=cloud,
+    nodeclass_c = NodeClassController(store=store, cloud=bcloud,
                                       images=ImageProvider(cloud.describe_images()))
     repair = NodeRepairController(store=store, termination=termination,
                                   enabled=opts.gate("NodeRepair"))
     controllers: List[object] = [provisioner, lifecycle, binding, termination,
                                  disruption, gc, metrics_c, nodeclass_c,
-                                 repair, TaggingController(store=store, cloud=cloud),
+                                 repair, TaggingController(store=store, cloud=bcloud),
                                  DiscoveredCapacityController(store=store, catalog=catalog),
                                  CatalogRefreshController(catalog=catalog, store=store),
-                                 ReservationExpirationController(store=store, cloud=cloud)]
+                                 ReservationExpirationController(store=store, cloud=bcloud)]
+    controllers.append(bcloud.flusher())
     if opts.interruption_queue:
         controllers.append(InterruptionController(
-            store=store, cloud=cloud, catalog=catalog,
+            store=store, cloud=bcloud, catalog=catalog,
             termination=termination))
 
     elector = None

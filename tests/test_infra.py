@@ -1,70 +1,216 @@
-"""Batcher, metrics registry, options, async runtime."""
+"""Batching cloud, metrics registry, options, async runtime."""
 
 import asyncio
 
 import pytest
 
-from karpenter_tpu.cloud.batcher import Batcher, BatcherOptions
+from karpenter_tpu.cloud.batcher import BatchingCloud
 from karpenter_tpu.metrics.registry import (Counter, Gauge, Histogram,
                                             Registry)
 from karpenter_tpu.utils.options import Options
 
 
-class TestBatcher:
-    def test_coalesces_within_window(self):
-        async def run():
-            calls = []
+def _mk_cloud(clock=None):
+    from karpenter_tpu.catalog import small_catalog
+    from karpenter_tpu.cloud.fake import FakeCloud
+    from karpenter_tpu.utils.clock import FakeClock
+    clock = clock or FakeClock()
+    return FakeCloud(small_catalog(), clock=clock), clock
 
-            async def executor(items):
-                calls.append(list(items))
-                return [i * 2 for i in items]
 
-            b = Batcher(executor, BatcherOptions(idle_timeout=0.02,
-                                                 max_timeout=0.2))
-            results = await asyncio.gather(*[b.submit(i) for i in range(20)])
-            assert results == [i * 2 for i in range(20)]
-            assert len(calls) == 1  # one wire call for 20 submits
-            assert b.stats["largest_batch"] == 20
-        asyncio.run(run())
+class TestBatchingCloud:
+    def test_terminations_coalesce_across_controllers(self):
+        """N controllers' terminate calls within a window → ONE wire call
+        (reference pkg/batcher/terminateinstances.go:49)."""
+        cloud, clock = _mk_cloud()
+        b = BatchingCloud(cloud, clock, idle=0.1, max_window=1.0)
+        # seed instances to terminate
+        from karpenter_tpu.cloud.provider import Instance
+        for i in range(9):
+            cloud.instances[f"i-{i}"] = Instance(
+                id=f"i-{i}", instance_type="m5.large", zone="zone-a",
+                capacity_type="on-demand", image_id="img", state="running")
+        before = cloud.api_calls["terminate"]
+        # three controllers fire within the same window
+        b.terminate(["i-0", "i-1", "i-2"])   # termination controller
+        b.terminate(["i-3", "i-4"])          # gc sweep
+        b.terminate(["i-5"])                 # lifecycle reap
+        assert cloud.api_calls["terminate"] == before  # window open
+        clock.step(0.2)
+        b.flush()
+        assert cloud.api_calls["terminate"] == before + 1  # ONE wire call
+        assert all(cloud.instances[f"i-{k}"].state == "terminated"
+                   for k in range(6))
+        assert b.stats["largest_batch"] == 6
+
+    def test_max_window_bounds_latency(self):
+        cloud, clock = _mk_cloud()
+        b = BatchingCloud(cloud, clock, idle=10.0, max_window=1.0)
+        b.terminate(["i-x"])
+        clock.step(0.5)
+        b.terminate(["i-y"])  # keeps the idle window open forever…
+        clock.step(0.6)
+        b.flush()  # …but the max window closes at 1s from first add
+        assert b.stats["terminate_batches"] == 1
 
     def test_max_items_fires_immediately(self):
+        cloud, clock = _mk_cloud()
+        b = BatchingCloud(cloud, clock, idle=10.0, max_window=30.0,
+                          max_items=5)
+        before = cloud.api_calls["terminate"]
+        b.terminate([f"i-{k}" for k in range(5)])
+        assert cloud.api_calls["terminate"] == before + 1
+
+    def test_describe_coalesces_reads_within_window(self):
+        cloud, clock = _mk_cloud()
+        b = BatchingCloud(cloud, clock, idle=0.1)
+        before = cloud.api_calls["describe"]
+        b.describe(); b.describe(); b.describe()  # three controllers
+        assert cloud.api_calls["describe"] == before + 1
+        assert b.stats["describe_coalesced"] == 2
+        clock.step(0.2)  # window over: fresh sweep
+        b.describe()
+        assert cloud.api_calls["describe"] == before + 2
+
+    def test_describe_sees_flushed_terminations(self):
+        cloud, clock = _mk_cloud()
+        from karpenter_tpu.cloud.provider import Instance
+        cloud.instances["i-d"] = Instance(
+            id="i-d", instance_type="m5.large", zone="zone-a",
+            capacity_type="on-demand", image_id="img", state="running")
+        b = BatchingCloud(cloud, clock, idle=0.1)
+        assert any(i.id == "i-d" for i in b.describe())
+        b.terminate(["i-d"])
+        clock.step(0.2)
+        b.flush()  # invalidates the read cache
+        assert not any(i.id == "i-d" for i in b.describe())
+
+    def test_retryable_flush_error_keeps_batch_pending(self):
+        from karpenter_tpu.cloud.fake import FakeCloudConfig
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        cloud = FakeCloud(small_catalog(), clock=clock,
+                          config=FakeCloudConfig(terminate_rate=0.5,
+                                                 terminate_burst=1))
+        b = BatchingCloud(cloud, clock, idle=0.1)
+        cloud.terminate([])  # drain the token bucket
+        b.terminate(["i-r"])
+        clock.step(0.2)
+        b.flush()  # throttled: batch survives for the next window
+        assert b.stats["terminate_errors"] == 1
+        clock.step(5.0)  # bucket refills
+        b.flush()
+        assert b.stats["terminate_batches"] == 1
+
+    def test_nonretryable_batch_error_falls_back_per_id(self):
+        """One bad id must not silently drop the rest of the batch."""
+        from karpenter_tpu.cloud.provider import Instance, NotFoundError
+        cloud, clock = _mk_cloud()
+        for i in range(3):
+            cloud.instances[f"i-{i}"] = Instance(
+                id=f"i-{i}", instance_type="m5.large", zone="zone-a",
+                capacity_type="on-demand", image_id="img", state="running")
+        real_terminate = cloud.terminate
+
+        def poisoned(ids):
+            if len(ids) > 1:
+                raise NotFoundError("i-poison not found")
+            if ids == ["i-poison"]:
+                raise NotFoundError("i-poison not found")
+            real_terminate(ids)
+        cloud.terminate = poisoned
+        b = BatchingCloud(cloud, clock, idle=0.1)
+        b.terminate(["i-0", "i-poison", "i-1", "i-2"])
+        clock.step(0.2)
+        b.flush()
+        # the three good ids terminated despite the poisoned batch
+        assert all(cloud.instances[f"i-{k}"].state == "terminated"
+                   for k in range(3))
+        assert not b._pending
+
+    def test_throttled_flush_backs_off_exponentially(self):
+        from karpenter_tpu.cloud.provider import RateLimitedError
+        cloud, clock = _mk_cloud()
+        calls = []
+
+        def throttled(ids):
+            calls.append(clock.now())
+            raise RateLimitedError("throttle")
+        cloud.terminate = throttled
+        b = BatchingCloud(cloud, clock, idle=0.1, max_items=2)
+        b.terminate(["a", "b"])  # max_items: immediate attempt #1
+        assert len(calls) == 1
+        # further adds while backing off must NOT fire despite >= max_items
+        b.terminate(["c", "d"])
+        assert len(calls) == 1
+        for _ in range(50):  # flusher ticking every 50ms for 2.5s
+            clock.step(0.05)
+            b.flush()
+        # exponential gaps, not one attempt per tick
+        assert len(calls) <= 6
+
+    def test_runtime_concurrent_reconcilers_one_wire_call(self):
+        """The wired path: N controllers under the async Runtime + the
+        flusher task → one TerminateInstances wire call."""
+        from karpenter_tpu.controllers.runtime import Runtime
+        from karpenter_tpu.cloud.provider import Instance
+        from karpenter_tpu.utils.clock import RealClock
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.cloud.fake import FakeCloud
+        clock = RealClock()
+        cloud = FakeCloud(small_catalog(), clock=clock)
+        for i in range(8):
+            cloud.instances[f"i-{i}"] = Instance(
+                id=f"i-{i}", instance_type="m5.large", zone="zone-a",
+                capacity_type="on-demand", image_id="img", state="running")
+        b = BatchingCloud(cloud, clock, idle=0.05, max_window=0.5)
+
+        class Reaper:
+            def __init__(self, name, ids):
+                self.name, self.ids, self.fired = name, ids, False
+
+            def reconcile(self, now):
+                if not self.fired:
+                    self.fired = True
+                    b.terminate(self.ids)
+                return 10.0
+
+        reapers = [Reaper(f"r{k}", [f"i-{2*k}", f"i-{2*k+1}"])
+                   for k in range(4)]
+
+        before = cloud.api_calls["terminate"]
+
         async def run():
-            calls = []
-
-            async def executor(items):
-                calls.append(list(items))
-                return items
-
-            b = Batcher(executor, BatcherOptions(idle_timeout=10.0,
-                                                 max_timeout=30.0, max_items=5))
-            await asyncio.gather(*[b.submit(i) for i in range(5)])
-            assert len(calls) == 1  # fired on max_items, not on timeout
+            rt = Runtime(clock=clock).add(*reapers, b.flusher())
+            task = asyncio.create_task(rt.start())
+            await asyncio.sleep(0.4)
+            rt.stop()
+            await task
         asyncio.run(run())
+        assert cloud.api_calls["terminate"] == before + 1
+        assert b.stats["terminate_batches"] == 1
+        assert b.stats["terminate_items"] == 8
+        assert all(i.state == "terminated" for i in cloud.instances.values())
 
-    def test_hasher_separates_buckets(self):
-        async def run():
-            calls = []
-
-            async def executor(items):
-                calls.append(list(items))
-                return items
-
-            b = Batcher(executor, BatcherOptions(
-                idle_timeout=0.02, request_hasher=lambda i: i % 2))
-            await asyncio.gather(*[b.submit(i) for i in range(10)])
-            assert len(calls) == 2  # evens and odds batched separately
-        asyncio.run(run())
-
-    def test_batch_error_fans_out(self):
-        async def run():
-            async def executor(items):
-                raise RuntimeError("wire failure")
-
-            b = Batcher(executor, BatcherOptions(idle_timeout=0.01))
-            results = await asyncio.gather(*[b.submit(i) for i in range(3)],
-                                           return_exceptions=True)
-            assert all(isinstance(r, RuntimeError) for r in results)
-        asyncio.run(run())
+    def test_build_operator_wires_batching_cloud(self):
+        """Production wiring: the operator's controllers all speak to one
+        BatchingCloud over the raw cloud."""
+        from karpenter_tpu.cloud.fake import FakeCloud, FakeCloudConfig
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.main import build_operator
+        cloud = FakeCloud(small_catalog())
+        opts = Options.parse([], env={})
+        opts.metrics_port = 0
+        opts.solver_backend = "host"
+        runtime, store, raw = build_operator(opts, cloud=cloud)
+        wrapped = {getattr(c, "cloud", None) for c in runtime.controllers}
+        bclouds = {c for c in wrapped if isinstance(c, BatchingCloud)}
+        assert len(bclouds) == 1  # one shared batcher
+        assert next(iter(bclouds)).inner is cloud
+        assert any(c.name == "cloud.batcher.flush"
+                   for c in runtime.controllers)
 
 
 class TestMetrics:
