@@ -33,7 +33,10 @@ class CatalogProvider:
         self.clock = clock or RealClock()
         self._list_types = list_types
         self.pricing = pricing or PricingProvider()
-        self.unavailable = unavailable or UnavailableOfferings()
+        # the ICE cache must share the provider's clock: under a sim's
+        # FakeClock a wall-clock default would make 3-minute marks expire
+        # on real time — never inside the sim, or mid-test at random
+        self.unavailable = unavailable or UnavailableOfferings(clock=self.clock)
         self._raw_cache = TTLCache(INSTANCE_TYPES_TTL, self.clock)
         self._resolved_cache = TTLCache(INSTANCE_TYPES_TTL, self.clock)
         self._epoch = 0  # bumps when the raw catalog changes

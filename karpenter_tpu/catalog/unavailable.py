@@ -23,7 +23,12 @@ class UnavailableOfferings:
     @property
     def seqnum(self) -> int:
         """Monotonic change counter; embed in downstream cache keys
-        (reference offering.go:113-121 keys its cache on this)."""
+        (reference offering.go:113-121 keys its cache on this). A mark
+        EXPIRING is a change too — without the prune-and-bump here, the
+        resolved catalog would keep serving the baked-in unavailability
+        long after the 3-minute mark lapsed."""
+        if self._cache.prune():
+            self._seqnum += 1
         return self._seqnum
 
     def mark_unavailable(self, instance_type: str, zone: str,

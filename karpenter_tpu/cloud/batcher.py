@@ -33,7 +33,7 @@ registers `flusher()` as a high-frequency controller.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
 from ..metrics import BATCH_SIZE
 from .provider import CloudError
@@ -60,9 +60,9 @@ class BatchingCloud:
         self._last_add = 0.0
         self._retry_after = 0.0            # throttle backoff gate
         self._backoff = 0.0
-        # describe read-coalescing: filter-key -> (fetched_at, result)
-        self._describe_cache: Dict[Optional[Tuple[str, ...]],
-                                   Tuple[float, list]] = {}
+        # describe read-coalescing: filter-key -> result within one window
+        from ..utils.cache import TTLCache
+        self._describe_cache = TTLCache(idle, clock)
         self.stats = {"terminate_batches": 0, "terminate_items": 0,
                       "largest_batch": 0, "describe_calls": 0,
                       "describe_coalesced": 0, "terminate_errors": 0}
@@ -113,14 +113,20 @@ class BatchingCloud:
                 return
             # non-retryable batch error: one bad id must not poison (and
             # silently drop) the rest — fall back to per-id calls, letting
-            # individually-bad ids fail alone (the GC sweep is the final
-            # backstop for anything that still leaks)
-            for iid in batch:
+            # individually-bad ids fail alone; per-id RETRYABLE failures
+            # go back in the pending set for the next window (the GC sweep
+            # remains the final backstop for anything that still leaks)
+            for n, iid in enumerate(batch):
                 try:
                     self.inner.terminate([iid])
-                except CloudError:
+                except CloudError as pe:
                     self.stats["terminate_errors"] += 1
-            self._describe_cache.clear()
+                    if getattr(pe, "retryable", False):
+                        self.terminate(batch[n:])  # requeue the remainder
+                        break
+            self._backoff = 0.0
+            self._retry_after = 0.0
+            self._describe_cache.flush()
             return
         self._backoff = 0.0
         self._retry_after = 0.0
@@ -129,18 +135,17 @@ class BatchingCloud:
         self.stats["terminate_items"] += len(batch)
         self.stats["largest_batch"] = max(self.stats["largest_batch"],
                                           len(batch))
-        self._describe_cache.clear()  # reads must see the writes
+        self._describe_cache.flush()  # reads must see the writes
 
     # --- describe: windowed read coalescing ---
     def describe(self, instance_ids: Optional[List[str]] = None) -> list:
-        key = None if instance_ids is None else tuple(sorted(instance_ids))
-        now = self.clock.now()
+        key = ("all",) if instance_ids is None else tuple(sorted(instance_ids))
         hit = self._describe_cache.get(key)
-        if hit is not None and now - hit[0] < self.idle:
+        if hit is not None:
             self.stats["describe_coalesced"] += 1
-            return hit[1]
+            return hit
         result = self.inner.describe(instance_ids)
-        self._describe_cache[key] = (now, result)
+        self._describe_cache.set(key, result)
         self.stats["describe_calls"] += 1
         return result
 
@@ -150,7 +155,7 @@ class BatchingCloud:
         try:
             return self.inner.create_fleet(requests)
         finally:
-            self._describe_cache.clear()  # reads must see the new instances
+            self._describe_cache.flush()  # reads must see the new instances
 
     def flusher(self):
         """A controller driving the window clock — register with the

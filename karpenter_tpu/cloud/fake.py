@@ -22,9 +22,10 @@ from ..models.instancetype import InstanceType
 from ..models.nodeclaim import Node
 from ..models.resources import Resources
 from ..utils.clock import Clock, RealClock
-from .provider import (CloudError, Instance, InsufficientCapacityError,
-                       LaunchRequest, NetworkGroup, NodeProfile,
-                       NotFoundError, RateLimitedError, UnauthorizedError)
+from .provider import (CapacityTypeUnfulfillableError, CloudError, Instance,
+                       InsufficientCapacityError, LaunchRequest, NetworkGroup,
+                       NodeProfile, NotFoundError, RateLimitedError,
+                       UnauthorizedError, ZoneExhaustedError)
 
 
 def default_network_groups() -> List[NetworkGroup]:
@@ -71,6 +72,10 @@ class FakeCloudConfig:
     terminate_rate: float = 100.0
     terminate_burst: int = 500
     unlimited_capacity: bool = True   # pools default to infinite
+    # per-zone network/IP capacity (the subnet free-address model,
+    # reference subnet.go:135): zones absent from the map are unlimited;
+    # each running instance consumes one address, terminations return it
+    zone_ip_capacity: Optional[Dict[str, int]] = None
 
 
 class FakeCloud:
@@ -103,6 +108,15 @@ class FakeCloud:
         self.interruptions: "deque[dict]" = deque()
         self.expired_reservations: set = set()
         self.unhealthy: set = set()  # instance ids with a dead kubelet
+        # remaining free addresses per zone (absent = unlimited)
+        self.zone_ips: Dict[str, int] = dict(self.config.zone_ip_capacity or {})
+        # capacity types in a fleet-wide drought (UnfulfillableCapacity)
+        self.captype_outages: set = set()
+        # live zonal spot price book (DescribeSpotPriceHistory analog),
+        # seeded from the catalog's static spot offerings
+        self.spot_prices: Dict[Tuple[str, str], float] = {
+            (t.name, o.zone): o.price for t in types
+            for o in t.offerings if o.capacity_type == "spot"}
         from .image import default_images
         self.images = default_images(self.clock.now())
         self.network_groups: Dict[str, NetworkGroup] = {
@@ -146,17 +160,29 @@ class FakeCloud:
             return UnauthorizedError(
                 f"node profile {req.profile} does not exist")
         exhausted = []
+        no_ip_zones = set()
+        outage_types = set()
         # lowest-price strategy over the override list
         for ov in sorted(req.overrides, key=lambda o: o.price):
             key = (ov.instance_type, ov.zone, ov.capacity_type)
             if ov.instance_type not in self.types:
                 continue
-            if not self._take_capacity(key):
-                exhausted.append(key)
+            if ov.capacity_type in self.captype_outages:
+                outage_types.add(ov.capacity_type)
                 continue
+            if not self._zone_has_ip(ov.zone):
+                no_ip_zones.add(ov.zone)
+                continue
+            # expiry check BEFORE taking capacity: the old order leaked a
+            # unit of the pool on every expired-reservation attempt
             if ov.reservation_id and ov.reservation_id in self.expired_reservations:
                 exhausted.append(key)
                 continue
+            if not self._take_capacity(key):
+                exhausted.append(key)
+                continue
+            if ov.zone in self.zone_ips:
+                self.zone_ips[ov.zone] -= 1
             inst = Instance(
                 id=f"i-{next(_ids):08d}", instance_type=ov.instance_type,
                 zone=ov.zone, capacity_type=ov.capacity_type,
@@ -168,9 +194,20 @@ class FakeCloud:
                 profile=req.profile)
             self.instances[inst.id] = inst
             return inst
+        # failure taxonomy (reference errors.go:68-227): pure address
+        # exhaustion → InsufficientFreeAddresses analog; pure capacity-type
+        # drought → UnfulfillableCapacity analog; anything mixed falls back
+        # to per-offering ICE (the provisioner marks pools individually)
+        if no_ip_zones and not exhausted and not outage_types:
+            return ZoneExhaustedError(sorted(no_ip_zones))
+        if outage_types and not exhausted and not no_ip_zones:
+            return CapacityTypeUnfulfillableError(sorted(outage_types))
         return InsufficientCapacityError(exhausted or
                                          [(o.instance_type, o.zone, o.capacity_type)
                                           for o in req.overrides])
+
+    def _zone_has_ip(self, zone: str) -> bool:
+        return zone not in self.zone_ips or self.zone_ips[zone] > 0
 
     def terminate(self, instance_ids: List[str]) -> None:
         self.api_calls["terminate"] += 1
@@ -182,6 +219,8 @@ class FakeCloud:
                 inst.state = "terminated"
                 self._return_capacity((inst.instance_type, inst.zone,
                                        inst.capacity_type))
+                if inst.zone in self.zone_ips:
+                    self.zone_ips[inst.zone] += 1  # address freed
 
     def describe_types(self) -> List[InstanceType]:
         """DescribeInstanceTypes analog — the catalog provider's backend."""
@@ -277,6 +316,38 @@ class FakeCloud:
             allocatable=it.allocatable(), ready=False,
             created_at=self.clock.now())
 
+    def describe_zone_capacity(self) -> Dict[str, float]:
+        """Free addresses per zone (DescribeSubnets available-IP analog,
+        reference subnet.go:135) — the provisioner's in-flight accounting
+        reads this once per launch batch. Unconfigured zones are
+        unlimited."""
+        import math
+        zones = {o.zone for t in self.types.values() for o in t.offerings}
+        return {z: float(self.zone_ips.get(z, math.inf)) for z in zones}
+
+    def describe_spot_prices(self) -> Dict[Tuple[str, str], float]:
+        """DescribeSpotPriceHistory analog: the live zonal spot book."""
+        return dict(self.spot_prices)
+
+    def set_spot_price(self, instance_type: str, zone: str, price: float) -> None:
+        self.spot_prices[(instance_type, zone)] = price
+
+    def walk_spot_prices(self, seed: int = 0, pct: float = 0.2) -> None:
+        """Chaos: jitter every spot price by ±pct (market movement)."""
+        import random
+        rng = random.Random(seed)
+        for k, v in self.spot_prices.items():
+            self.spot_prices[k] = max(1e-4, v * (1 + rng.uniform(-pct, pct)))
+
+    def set_capacity_type_outage(self, capacity_type: str,
+                                 active: bool = True) -> None:
+        """Chaos: fleet-wide drought for a capacity type — every launch
+        whose overrides are all this type fails UnfulfillableCapacity."""
+        if active:
+            self.captype_outages.add(capacity_type)
+        else:
+            self.captype_outages.discard(capacity_type)
+
     def expire_reservation(self, reservation_id: str) -> None:
         self.expired_reservations.add(reservation_id)
 
@@ -325,8 +396,10 @@ class FakeCloud:
         return {
             "instances": {k: vars(v).copy() for k, v in self.instances.items()},
             "capacity_pools": dict(self.capacity_pools),
+            "zone_ips": dict(self.zone_ips),
         }
 
     def restore(self, snap: dict) -> None:
         self.instances = {k: Instance(**v) for k, v in snap["instances"].items()}
         self.capacity_pools = dict(snap["capacity_pools"])
+        self.zone_ips = dict(snap.get("zone_ips", {}))

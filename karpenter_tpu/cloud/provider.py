@@ -127,6 +127,32 @@ class ReservationExceededError(CloudError):
         self.reservation_id = reservation_id
 
 
+class ZoneExhaustedError(CloudError):
+    """Per-zone network/IP capacity exhausted — every candidate zone of the
+    launch had no free addresses (reference InsufficientFreeAddresses,
+    errors.go:180, mapped to AZ-wide unavailability). The provisioner marks
+    each zone unavailable zone-wide so the next Solve avoids it."""
+
+    retryable = True
+
+    def __init__(self, zones: Sequence[str]):
+        super().__init__(f"no free addresses in zones: {list(zones)}")
+        self.zones = list(zones)
+
+
+class CapacityTypeUnfulfillableError(CloudError):
+    """Fleet-wide UnfulfillableCapacity: every override of the launch was a
+    capacity type the cloud cannot currently fulfill at all (reference
+    errors.go:172 — e.g. a spot-only fleet during a spot drought). The
+    provisioner marks the capacity type unavailable cluster-wide."""
+
+    retryable = True
+
+    def __init__(self, capacity_types: Sequence[str]):
+        super().__init__(f"unfulfillable capacity types: {list(capacity_types)}")
+        self.capacity_types = list(capacity_types)
+
+
 class CloudProvider(Protocol):
     """The seam controllers speak to. A real TPU-cloud backend implements
     every method here; the controllers call all of them unconditionally

@@ -127,6 +127,35 @@ class CatalogRefreshController:
 
 
 @dataclass
+class SpotPricingController:
+    """Live zonal spot-price feed: polls the cloud's spot price book into
+    the pricing provider (reference pricing.go:379 UpdateSpotPricing via
+    DescribeSpotPriceHistory). A price change bumps pricing.updates, which
+    rolls the catalog's availability version — the next solve (and the
+    consolidation pass) sees the new prices without any explicit flush."""
+
+    catalog: CatalogProvider
+    cloud: object
+    name: str = "providers.pricing.spot"
+    requeue: float = 300.0  # reference polls spot pricing on minutes scale
+    stats: Dict[str, int] = field(default_factory=lambda: {"updates": 0})
+
+    def reconcile(self, now: float) -> float:
+        describe = getattr(self.cloud, "describe_spot_prices", None)
+        if describe is None:
+            return self.requeue
+        book = describe()
+        if not book:
+            return self.requeue
+        changed = any(self.catalog.pricing.spot_price(t, z) != p
+                      for (t, z), p in book.items())
+        if changed:
+            self.catalog.pricing.update_spot(book)
+            self.stats["updates"] += 1
+        return self.requeue
+
+
+@dataclass
 class ReservationExpirationController:
     """Reserved claims whose capacity reservation expired are demoted to
     on-demand (billing falls back to OD when the reservation lapses)."""

@@ -19,9 +19,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..catalog.provider import CatalogProvider
-from ..cloud.provider import (CloudError, Instance,
-                              InsufficientCapacityError, LaunchOverride,
-                              LaunchRequest)
+from ..cloud.provider import (CapacityTypeUnfulfillableError, CloudError,
+                              Instance, InsufficientCapacityError,
+                              LaunchOverride, LaunchRequest,
+                              ZoneExhaustedError)
 from ..models import labels as L
 from ..models.nodeclaim import NodeClaim, Phase, new_nodeclaim_name
 from ..models.nodepool import NodeClassSpec, NodePool
@@ -242,6 +243,7 @@ class Provisioner:
                           claim.annotations["karpenter.tpu/nodeclass-hash-version"]},
                 network_groups=list(node_class.resolved_network_groups),
                 profile=node_class.resolved_profile))
+        self._apply_inflight_ip_accounting(requests)
         results = self.cloud.create_fleet(requests)
 
         launched: List[NodeClaim] = []
@@ -293,11 +295,58 @@ class Provisioner:
         claim.set_condition("Launched", False, type(err).__name__, str(err))
         self.store.record_event("nodeclaim", claim.name, "LaunchFailed", str(err))
         self.store.delete_nodeclaim(claim.name)
-        if isinstance(err, InsufficientCapacityError):
+        if isinstance(err, ZoneExhaustedError):
+            # InsufficientFreeAddresses → AZ-wide mark (errors.go:180): the
+            # next solve's availability tensor zeroes the whole zone
+            self.stats["ice_errors"] += 1
+            for z in err.zones:
+                ICE_ERRORS.inc(capacity_type="zone-wide")
+                self.catalog.unavailable.mark_zone_unavailable(z)
+                self.store.record_event("zone", z, "Exhausted",
+                                        "no free addresses")
+        elif isinstance(err, CapacityTypeUnfulfillableError):
+            # fleet-wide UnfulfillableCapacity → capacity-type-wide mark
+            # (errors.go:172): reroutes the next solve off e.g. spot
+            self.stats["ice_errors"] += 1
+            for c in err.capacity_types:
+                ICE_ERRORS.inc(capacity_type=c)
+                self.catalog.unavailable.mark_capacity_type_unavailable(c)
+                self.store.record_event("capacity-type", c, "Unfulfillable",
+                                        "fleet-wide")
+        elif isinstance(err, InsufficientCapacityError):
             self.stats["ice_errors"] += 1
             for (t, z, c) in err.offerings:
                 ICE_ERRORS.inc(capacity_type=c)
                 self.catalog.unavailable.mark_unavailable(t, z, c, reason="ICE")
+
+    def _apply_inflight_ip_accounting(self, requests: List[LaunchRequest],
+                                      ) -> None:
+        """In-flight address accounting across one launch batch (reference
+        subnet.go:183-230 UpdateInflightIPs): walk the batch in order,
+        predict each request's zone (its cheapest surviving override) and
+        decrement that zone's free-address budget; once a zone's budget is
+        consumed by earlier requests in the SAME batch, later requests drop
+        their overrides in that zone so a burst can't exhaust it mid-batch.
+        A request whose every override sits in consumed zones keeps its
+        list untouched (the cloud's error path + zone marks take over)."""
+        describe = getattr(self.cloud, "describe_zone_capacity", None)
+        if describe is None or not requests:
+            return
+        try:
+            free = dict(describe())
+        except CloudError:
+            return  # accounting is an optimization; throttled reads skip it
+        import math
+        if all(v == math.inf for v in free.values()):
+            return
+        for req in requests:
+            kept = [ov for ov in req.overrides if free.get(ov.zone, math.inf) > 0]
+            if kept and len(kept) < len(req.overrides):
+                req.overrides = kept
+            if req.overrides:
+                pick = min(req.overrides, key=lambda o: o.price)
+                if free.get(pick.zone, math.inf) != math.inf:
+                    free[pick.zone] -= 1
 
     def _user_data(self, pool: NodePool, node_class: NodeClassSpec,
                    launch: NodeLaunch) -> str:

@@ -71,6 +71,7 @@ def build_operator(options: Optional[Options] = None,
     from .controllers.auxiliary import (CatalogRefreshController,
                                         DiscoveredCapacityController,
                                         ReservationExpirationController,
+                                        SpotPricingController,
                                         TaggingController)
     from .controllers.nodeclass import NodeClassController
     from .controllers.repair import NodeRepairController
@@ -83,7 +84,8 @@ def build_operator(options: Optional[Options] = None,
                                  repair, TaggingController(store=store, cloud=bcloud),
                                  DiscoveredCapacityController(store=store, catalog=catalog),
                                  CatalogRefreshController(catalog=catalog, store=store),
-                                 ReservationExpirationController(store=store, cloud=bcloud)]
+                                 ReservationExpirationController(store=store, cloud=bcloud),
+                                 SpotPricingController(catalog=catalog, cloud=bcloud)]
     controllers.append(bcloud.flusher())
     if opts.interruption_queue:
         controllers.append(InterruptionController(

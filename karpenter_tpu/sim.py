@@ -101,6 +101,7 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     from .controllers.auxiliary import (CatalogRefreshController,
                                         DiscoveredCapacityController,
                                         ReservationExpirationController,
+                                        SpotPricingController,
                                         TaggingController)
     from .controllers.metrics_controller import CloudProviderMetricsController
     from .controllers.nodeclass import NodeClassController
@@ -113,10 +114,12 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     discovered = DiscoveredCapacityController(store=store, catalog=catalog)
     refresh = CatalogRefreshController(catalog=catalog, store=store)
     res_exp = ReservationExpirationController(store=store, cloud=cloud)
+    spot_pricing = SpotPricingController(catalog=catalog, cloud=cloud)
     engine = Engine(clock=clock).add(nodeclass_c, provisioner, lifecycle,
                                      binding, termination, disruption,
                                      interruption, gc, metrics_c, repair,
-                                     tagging, discovered, refresh, res_exp)
+                                     tagging, discovered, refresh, res_exp,
+                                     spot_pricing)
 
     # cloud → store node materialization (kubelet joining the cluster)
     cloud.on_node_created.append(store.add_node)

@@ -49,6 +49,15 @@ class TTLCache:
     def delete(self, key: Any) -> None:
         self._store.pop(key, None)
 
+    def prune(self) -> int:
+        """Evict expired entries; returns how many were removed (callers
+        that version their contents — the ICE cache — bump on expiry)."""
+        now = self.clock.now()
+        expired = [k for k, (exp, _) in self._store.items() if now >= exp]
+        for k in expired:
+            del self._store[k]
+        return len(expired)
+
     def flush(self) -> None:
         self._store.clear()
 

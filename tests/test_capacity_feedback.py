@@ -1,0 +1,179 @@
+"""Capacity-feedback loops: zone IP exhaustion, capacity-type droughts,
+in-flight address accounting, and the live spot-price feed.
+
+Reference parity: subnet free-address modeling + in-flight IP accounting
+(pkg/providers/subnet/subnet.go:135,183-230), InsufficientFreeAddresses →
+AZ-wide unavailability and UnfulfillableCapacity → capacity-type-wide
+marks (pkg/errors/errors.go:172-185, instance.go:469-512), and the spot
+price poller (pkg/providers/pricing/pricing.go:379).
+"""
+
+import math
+
+from karpenter_tpu.cloud.fake import FakeCloudConfig
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.requirements import Operator, Requirement
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def add_pods(sim, n, cpu="2", mem="4Gi", prefix="p", one_per_node=False,
+             app=None):
+    kw = {}
+    if one_per_node:
+        from karpenter_tpu.models.pod import PodAffinityTerm
+        app = app or prefix
+        kw = dict(labels={"app": app},
+                  affinity_terms=[PodAffinityTerm(
+                      topology_key=L.HOSTNAME,
+                      label_selector={"app": app}, anti=True)])
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def all_bound(sim):
+    return all(p.node_name is not None for p in sim.store.pods.values())
+
+
+class TestZoneExhaustion:
+    def test_exhaustion_marks_zone_and_recovers(self):
+        """All candidate zones out of addresses → ZoneExhaustedError →
+        zone-wide marks; freed addresses + TTL expiry let pods schedule."""
+        pool = NodePool(name="pinned")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, ("zone-a",)))
+        sim = make_sim(cloud_config=FakeCloudConfig(zone_ip_capacity={
+            "zone-a": 2, "zone-b": 2, "zone-c": 2}), nodepool=pool)
+        add_pods(sim, 2, cpu="4", mem="8Gi", one_per_node=True)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        assert sim.cloud.zone_ips["zone-a"] == 0
+        # next burst cannot launch anywhere (pool pinned to zone-a)
+        extra = add_pods(sim, 2, cpu="4", mem="8Gi", prefix="x",
+                         one_per_node=True, app="p")
+        sim.engine.run_for(30)
+        assert all(p.node_name is None for p in extra)
+        assert any(e[0] == "zone" and e[2] == "Exhausted"
+                   for e in sim.store.events)
+        # the catalog now reports every zone-a offering unavailable
+        assert all(not o.available
+                   for t in sim.catalog.list() for o in t.offerings
+                   if o.zone == "zone-a")
+        # free an address: remove one original workload pod and drain its
+        # node, then wait out the 3m zone mark
+        victim_pod = sim.store.pods["default/p-0"]
+        node_name = victim_pod.node_name
+        sim.store.delete_pod("default", "p-0")
+        victim = next(c for c in sim.store.nodeclaims.values()
+                      if c.node_name == node_name)
+        sim.termination.delete_nodeclaim(victim, sim.clock.now(), "test")
+        sim.engine.run_for(4 * 60, step=10)
+        sim.engine.run_until(lambda: any(p.node_name for p in extra),
+                             timeout=120)
+        assert any(p.node_name for p in extra)
+
+    def test_cloud_fails_over_to_zones_with_addresses(self):
+        """With free zones still available, the launch lands there —
+        no error, no marks (the override list spans zones)."""
+        sim = make_sim(cloud_config=FakeCloudConfig(zone_ip_capacity={
+            "zone-a": 1, "zone-b": 50, "zone-c": 50}))
+        add_pods(sim, 10, cpu="4", mem="8Gi")
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        by_zone = {}
+        for c in sim.store.nodeclaims.values():
+            by_zone[c.zone] = by_zone.get(c.zone, 0) + 1
+        assert by_zone.get("zone-a", 0) <= 1
+        assert sim.provisioner.stats["ice_errors"] == 0
+
+
+class TestInflightAccounting:
+    def test_batch_spreads_before_exhausting_a_zone(self):
+        """The accounting pre-pass drops a zone's overrides once earlier
+        requests in the SAME batch consumed its budget (subnet.go:183)."""
+        from karpenter_tpu.cloud.provider import LaunchOverride, LaunchRequest
+        sim = make_sim(cloud_config=FakeCloudConfig(zone_ip_capacity={
+            "zone-a": 2, "zone-b": 50, "zone-c": 50}))
+        reqs = []
+        for i in range(6):
+            reqs.append(LaunchRequest(
+                nodeclaim_name=f"c{i}",
+                overrides=[  # zone-a cheapest for everyone
+                    LaunchOverride("m5.large", "zone-a", "on-demand", 0.010),
+                    LaunchOverride("m5.large", "zone-b", "on-demand", 0.020),
+                    LaunchOverride("m5.large", "zone-c", "on-demand", 0.030)]))
+        sim.provisioner._apply_inflight_ip_accounting(reqs)
+        # first two keep zone-a; the rest had it dropped client-side
+        assert all(any(o.zone == "zone-a" for o in r.overrides)
+                   for r in reqs[:2])
+        assert all(all(o.zone != "zone-a" for o in r.overrides)
+                   for r in reqs[2:])
+        # and every request still has somewhere to go
+        assert all(r.overrides for r in reqs)
+
+
+class TestCapacityTypeDrought:
+    def test_spot_drought_marks_capacity_type_and_reroutes(self):
+        """A spot-only pool hits fleet-wide UnfulfillableCapacity → the
+        capacity type is marked; a flexible pool's next solve routes
+        straight to on-demand without touching the drought."""
+        pool = NodePool(name="spot-only")
+        pool.requirements.add(Requirement(L.CAPACITY_TYPE, Operator.IN,
+                                          ("spot",)))
+        sim = make_sim(nodepool=pool)
+        sim.cloud.set_capacity_type_outage("spot")
+        stranded = add_pods(sim, 3)
+        sim.engine.run_for(20)
+        assert all(p.node_name is None for p in stranded)
+        assert any(e[0] == "capacity-type" and e[2] == "Unfulfillable"
+                   for e in sim.store.events)
+        assert sim.catalog.unavailable.is_unavailable(
+            "m5.large", "zone-a", "spot")
+        # a flexible pool now solves directly to on-demand — one launch
+        # call, no new ICE errors
+        flexible = NodePool(name="flexible", weight=10)
+        sim.store.add_nodepool(flexible)
+        errors_before = sim.provisioner.stats["ice_errors"]
+        ok = add_pods(sim, 3, prefix="flex")
+        sim.engine.run_until(lambda: all(p.node_name for p in ok),
+                             timeout=60)
+        assert all(p.node_name for p in ok)
+        assert sim.provisioner.stats["ice_errors"] == errors_before
+        for p in ok:
+            claim = sim.store.nodeclaims[p.annotations.get(
+                "karpenter.tpu/nominated-nodeclaim")]
+            assert claim.capacity_type == "on-demand"
+
+
+class TestSpotPriceFeed:
+    def test_consolidation_reacts_to_spot_price_drop(self):
+        """Spot starts expensive → fleet lands on-demand; the market drops,
+        the pricing poller ingests it, and consolidation replaces nodes
+        with the now-cheaper spot capacity (reference pricing.go:379 +
+        SpotToSpotConsolidation=n/a: victims are on-demand)."""
+        sim = make_sim()
+        # spot drought pricing: 10x on-demand
+        for (t, z), p in list(sim.cloud.spot_prices.items()):
+            sim.cloud.set_spot_price(t, z, p * 20)
+        sim.engine.run_for(2)  # spot poller ingests the expensive book
+        add_pods(sim, 6, cpu="4", mem="8Gi")
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        assert all(c.capacity_type == "on-demand"
+                   for c in sim.store.nodeclaims.values())
+        # market recovers: spot at 10% of on-demand
+        for t in sim.cloud.types.values():
+            for o in t.offerings:
+                if o.capacity_type == "spot":
+                    od = next((x.price for x in t.offerings
+                               if x.capacity_type == "on-demand"
+                               and x.zone == o.zone), None)
+                    if od:
+                        sim.cloud.set_spot_price(t.name, o.zone, od * 0.1)
+        # poller runs every 300s; give consolidation room to act
+        sim.engine.run_for(15 * 60, step=5)
+        assert any(c.capacity_type == "spot"
+                   for c in sim.store.nodeclaims.values())
+        assert sim.disruption.stats["consolidated"] >= 1
