@@ -236,6 +236,18 @@ def _build_type(cfg: GeneratorConfig, name: str, category: str, family: str,
                 price=od_price / 1e7,  # reference prices reserved at OD/10^7
                 reservation_id=f"cr-{name}-{zone}",
                 reservation_capacity=int(2 + 14 * _hash01("odcrcap", name, zone))))
+        elif (accels or gpus) and _hash01("block", name, zone) < 0.25:
+            # capacity blocks: prepaid time-boxed accelerator reservations
+            # (reference CapacityReservationType capacity-block); the end
+            # time is set by the environment (fake cloud / tests) — None
+            # means not yet scheduled to end
+            from ..models.instancetype import RESERVATION_CAPACITY_BLOCK
+            offerings.append(Offering(
+                zone=zone, capacity_type=L.CAPACITY_RESERVED,
+                price=od_price / 1e7,
+                reservation_id=f"cb-{name}-{zone}",
+                reservation_capacity=int(1 + 7 * _hash01("blockcap", name, zone)),
+                reservation_type=RESERVATION_CAPACITY_BLOCK))
 
     return InstanceType(
         name=name,

@@ -116,10 +116,16 @@ class CatalogProvider:
             if o.reservation_id is not None:
                 rem = self._reservation_remaining.get(o.reservation_id, o.reservation_capacity)
                 available = available and rem > 0
+                if o.reservation_ends is not None:
+                    # a capacity block past (or at) its end no longer
+                    # offers anything (reference expiration semantics)
+                    available = available and self.clock.now() < o.reservation_ends
             out.append(Offering(zone=o.zone, capacity_type=o.capacity_type,
                                 price=price, available=available,
                                 reservation_id=o.reservation_id,
-                                reservation_capacity=rem))
+                                reservation_capacity=rem,
+                                reservation_type=o.reservation_type,
+                                reservation_ends=o.reservation_ends))
         return out
 
     @property

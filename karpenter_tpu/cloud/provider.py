@@ -21,6 +21,7 @@ class LaunchOverride:
     capacity_type: str
     price: float
     reservation_id: Optional[str] = None
+    reservation_type: str = "default"  # default | capacity-block
 
 
 @dataclass

@@ -155,26 +155,74 @@ class SpotPricingController:
         return self.requeue
 
 
+# capacity-block claims drain this long before the block's end time (the
+# reference drains ahead of the block's scheduled teardown; AWS emits the
+# interruption warning ~10 minutes out)
+BLOCK_DRAIN_LEAD = 10 * 60
+
+
 @dataclass
 class ReservationExpirationController:
-    """Reserved claims whose capacity reservation expired are demoted to
-    on-demand (billing falls back to OD when the reservation lapses)."""
+    """Two reservation flavors, two expirations (reference
+    pkg/controllers/capacityreservation/{capacitytype,expiration}):
+
+    - DEFAULT reservations: claims demote to on-demand when the
+      reservation lapses (billing falls back; the node keeps running).
+    - CAPACITY BLOCKS: prepaid time-boxed capacity — claims DRAIN starting
+      BLOCK_DRAIN_LEAD before the block's end (the hardware goes away),
+      and the block is marked expired cloud-side at its end time."""
 
     store: Store
     cloud: object
+    catalog: Optional[CatalogProvider] = None
+    termination: object = None
     name: str = "capacityreservation.expiration"
     requeue: float = 60.0
-    stats: Dict[str, int] = field(default_factory=lambda: {"demoted": 0})
+    stats: Dict[str, int] = field(default_factory=lambda: {
+        "demoted": 0, "blocks_drained": 0})
+
+    def _reservation_offerings(self) -> Dict[str, object]:
+        if self.catalog is None:
+            return {}
+        return {o.reservation_id: o for t in self.catalog.raw_types()
+                for o in t.offerings if o.reservation_id}
 
     def reconcile(self, now: float) -> float:
+        rids = self._reservation_offerings()
+        # blocks whose end time arrived are expired cloud-side (launch
+        # attempts into them fail from here on)
         expired = getattr(self.cloud, "expired_reservations", set())
-        if not expired:
-            return self.requeue
-        for claim in self.store.nodeclaims.values():
+        for rid, o in rids.items():
+            if (o.reservation_ends is not None and now >= o.reservation_ends
+                    and rid not in expired
+                    and hasattr(self.cloud, "expire_reservation")):
+                self.cloud.expire_reservation(rid)
+        for claim in list(self.store.nodeclaims.values()):
             rid = claim.annotations.get(RESERVATION_ANNOTATION)
-            if rid and rid in expired and claim.capacity_type == L.CAPACITY_RESERVED:
+            if not rid or claim.capacity_type != L.CAPACITY_RESERVED:
+                continue
+            o = rids.get(rid)
+            is_block = (o is not None
+                        and o.reservation_type == "capacity-block")
+            if is_block:
+                ends = o.reservation_ends
+                ending = ((ends is not None
+                           and now >= ends - BLOCK_DRAIN_LEAD)
+                          or rid in expired)
+                if (ending and not claim.is_deleting()
+                        and self.termination is not None):
+                    # blocks never demote: the prepaid hardware goes away,
+                    # so the claim drains ahead of (or at) the end
+                    self.termination.delete_nodeclaim(
+                        claim, now, "CapacityBlockExpiring")
+                    self.stats["blocks_drained"] += 1
+            elif rid in expired:
                 claim.capacity_type = L.CAPACITY_ON_DEMAND
                 claim.labels[L.CAPACITY_TYPE] = L.CAPACITY_ON_DEMAND
+                # demotion ends the reservation attachment — keeping the
+                # annotation would trip capacity-reservation drift on a
+                # node that is now a plain on-demand node
+                del claim.annotations[RESERVATION_ANNOTATION]
                 node = self.store.node_for_nodeclaim(claim)
                 if node is not None:
                     node.labels[L.CAPACITY_TYPE] = L.CAPACITY_ON_DEMAND

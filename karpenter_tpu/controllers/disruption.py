@@ -165,11 +165,25 @@ class DisruptionController:
                 budget -= 1
 
     # --- drift ---
+    def _live_reservation_ids(self) -> set:
+        """Reservation ids currently offered by the catalog, memoized per
+        catalog epoch (the drift pass asks once per node)."""
+        epoch = self.catalog.epoch
+        cached = getattr(self, "_res_ids_cache", None)
+        if cached is None or cached[0] != epoch:
+            ids = {o.reservation_id for t in self.catalog.raw_types()
+                   for o in t.offerings if o.reservation_id}
+            self._res_ids_cache = (epoch, ids)
+            return ids
+        return cached[1]
+
     def _is_drifted(self, v: NodeView, node_class) -> bool:
-        """Drift reasons (reference drift.go:35-76): static nodeclass-hash
-        mismatch; node image no longer in the resolved image set; node zone
-        no longer in the resolved zones; node network-group set diverged
-        from the resolved set (the security-group drift reason)."""
+        """Drift reasons (reference drift.go:35-41 — all five): static
+        nodeclass-hash mismatch; node image no longer in the resolved image
+        set; node zone no longer in the resolved zones; node network-group
+        set diverged from the resolved set (the security-group reason);
+        and a reserved node whose capacity reservation vanished from the
+        catalog (the capacity-reservation reason)."""
         if node_class is None:
             return False
         from ..models.nodepool import NODECLASS_HASH_VERSION
@@ -197,6 +211,10 @@ class DisruptionController:
                 and set(v.claim.network_groups)
                 != set(node_class.resolved_network_groups)):
             return True
+        if v.claim.capacity_type == L.CAPACITY_RESERVED:
+            rid = v.claim.annotations.get("karpenter.tpu/reservation-id")
+            if rid and rid not in self._live_reservation_ids():
+                return True
         return False
 
     # --- consolidation simulations ---

@@ -219,15 +219,21 @@ class Provisioner:
             claim.instance_type = launch.instance_type
             self.store.add_nodeclaim(claim)
             claims.append((claim, launch))
-            # reservation ids ride along so reserved launches can be
-            # attributed and counted against the reservation
-            res_ids = {(t.name, o.zone, o.capacity_type): o.reservation_id
+            # reservation ids + flavors ride along so reserved launches can
+            # be attributed, counted, and type-partitioned
+            res_ids = {(t.name, o.zone, o.capacity_type):
+                       (o.reservation_id, o.reservation_type)
                        for t in self.catalog.raw_types()
                        for o in t.offerings if o.reservation_id}
+            overrides = [
+                LaunchOverride(*o,
+                               reservation_id=res_ids.get(o[:3], (None, ""))[0],
+                               reservation_type=res_ids.get(o[:3],
+                                                            (None, "default"))[1])
+                for o in launch.overrides]
             requests.append(LaunchRequest(
                 nodeclaim_name=claim.name,
-                overrides=[LaunchOverride(*o, reservation_id=res_ids.get(o[:3]))
-                           for o in launch.overrides],
+                overrides=self._partition_reservation_overrides(overrides),
                 image_id=(node_class.resolved_images[0]
                           if node_class.resolved_images else "img-default"),
                 user_data=self._user_data(pool, node_class, launch),
@@ -318,6 +324,26 @@ class Provisioner:
             for (t, z, c) in err.offerings:
                 ICE_ERRORS.inc(capacity_type=c)
                 self.catalog.unavailable.mark_unavailable(t, z, c, reason="ICE")
+
+    @staticmethod
+    def _partition_reservation_overrides(
+            overrides: List[LaunchOverride]) -> List[LaunchOverride]:
+        """Reservation-type partition (reference filter.go:73-228): one
+        launch may not mix reservation flavors. When the committed row
+        (first override — the solver's pick) is a capacity block, the
+        request targets exactly the cheapest block's rows and nothing
+        else; otherwise capacity-block rows are dropped from the
+        alternates (blocks only serve launches that explicitly chose
+        them — a spot/OD launch must not spill into a prepaid block)."""
+        is_block = lambda o: (o.reservation_id is not None
+                              and o.reservation_type == "capacity-block")
+        blocks = [o for o in overrides if is_block(o)]
+        if not blocks:
+            return overrides
+        if overrides and is_block(overrides[0]):
+            best = min(blocks, key=lambda o: o.price).reservation_id
+            return [o for o in overrides if o.reservation_id == best]
+        return [o for o in overrides if not is_block(o)]
 
     def _apply_inflight_ip_accounting(self, requests: List[LaunchRequest],
                                       ) -> None:

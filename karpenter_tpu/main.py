@@ -84,7 +84,9 @@ def build_operator(options: Optional[Options] = None,
                                  repair, TaggingController(store=store, cloud=bcloud),
                                  DiscoveredCapacityController(store=store, catalog=catalog),
                                  CatalogRefreshController(catalog=catalog, store=store),
-                                 ReservationExpirationController(store=store, cloud=bcloud),
+                                 ReservationExpirationController(
+                                     store=store, cloud=bcloud,
+                                     catalog=catalog, termination=termination),
                                  SpotPricingController(catalog=catalog, cloud=bcloud)]
     controllers.append(bcloud.flusher())
     if opts.interruption_queue:

@@ -17,6 +17,10 @@ from .requirements import Operator, Requirement, Requirements, ValueSet
 from .resources import Resources
 
 
+RESERVATION_DEFAULT = "default"
+RESERVATION_CAPACITY_BLOCK = "capacity-block"
+
+
 @dataclass
 class Offering:
     zone: str
@@ -25,6 +29,13 @@ class Offering:
     available: bool = True
     reservation_id: Optional[str] = None
     reservation_capacity: int = 0  # remaining instances for reserved offerings
+    # reservation flavor (reference CapacityReservationType,
+    # filter.go:73-228): "default" ODCRs fall back freely; "capacity-block"
+    # reservations are prepaid time-boxed blocks — a launch targets exactly
+    # one block and its instances drain before the block ends
+    reservation_type: str = RESERVATION_DEFAULT
+    # absolute end time for capacity blocks (None = open-ended)
+    reservation_ends: Optional[float] = None
 
     def requirements(self) -> Requirements:
         r = Requirements(

@@ -113,7 +113,9 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     tagging = TaggingController(store=store, cloud=cloud)
     discovered = DiscoveredCapacityController(store=store, catalog=catalog)
     refresh = CatalogRefreshController(catalog=catalog, store=store)
-    res_exp = ReservationExpirationController(store=store, cloud=cloud)
+    res_exp = ReservationExpirationController(store=store, cloud=cloud,
+                                              catalog=catalog,
+                                              termination=termination)
     spot_pricing = SpotPricingController(catalog=catalog, cloud=cloud)
     engine = Engine(clock=clock).add(nodeclass_c, provisioner, lifecycle,
                                      binding, termination, disruption,

@@ -1,0 +1,168 @@
+"""Capacity-block reservations + the capacity-reservation drift reason.
+
+Reference parity: CapacityReservationType partition and capacity-block
+selection (pkg/providers/instance/filter/filter.go:73-228), block expiry
+semantics (capacityreservation controllers), and the fifth drift reason
+(pkg/cloudprovider/drift.go:35-41).
+"""
+
+from karpenter_tpu.catalog import small_catalog
+from karpenter_tpu.cloud.provider import LaunchOverride
+from karpenter_tpu.controllers.auxiliary import BLOCK_DRAIN_LEAD
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.requirements import Operator, Requirement
+from karpenter_tpu.models.resources import NVIDIA_GPU, Resources
+from karpenter_tpu.sim import make_sim
+
+BLOCK_TYPE, BLOCK_ZONE = "g5.4xlarge", "zone-b"
+BLOCK_ID = f"cb-{BLOCK_TYPE}-{BLOCK_ZONE}"
+
+
+def gpu_pods(sim, n, prefix="g"):
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": "2", "memory": "4Gi",
+                                          NVIDIA_GPU: 1}))
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def block_sim(**kw):
+    sim = make_sim(types=small_catalog(8), **kw)
+    return sim
+
+
+class TestPartitionFilter:
+    def _ov(self, price, rid=None, rtype="default"):
+        return LaunchOverride("t", "z", "reserved" if rid else "on-demand",
+                              price, reservation_id=rid,
+                              reservation_type=rtype)
+
+    def test_block_primary_targets_single_cheapest_block(self):
+        from karpenter_tpu.controllers.provisioner import Provisioner
+        rows = [self._ov(0.001, "cb-1", "capacity-block"),
+                self._ov(0.002, "cb-2", "capacity-block"),
+                self._ov(0.003, "cb-1", "capacity-block"),
+                self._ov(1.0),
+                self._ov(0.5, "cr-1")]
+        out = Provisioner._partition_reservation_overrides(rows)
+        assert all(o.reservation_id == "cb-1" for o in out)
+        assert len(out) == 2
+
+    def test_nonblock_primary_drops_block_rows(self):
+        from karpenter_tpu.controllers.provisioner import Provisioner
+        rows = [self._ov(0.5, "cr-1"),
+                self._ov(0.7, "cb-1", "capacity-block"),
+                self._ov(1.0)]
+        out = Provisioner._partition_reservation_overrides(rows)
+        assert [o.reservation_id for o in out] == ["cr-1", None]
+
+    def test_no_blocks_is_passthrough(self):
+        from karpenter_tpu.controllers.provisioner import Provisioner
+        rows = [self._ov(0.5, "cr-1"), self._ov(1.0)]
+        assert Provisioner._partition_reservation_overrides(rows) == rows
+
+
+class TestBlockLifecycle:
+    def test_gpu_pods_land_on_block_and_drain_before_end(self):
+        """The solver picks the near-zero-priced block; the expiration
+        controller drains its claims BLOCK_DRAIN_LEAD before end and the
+        cloud rejects launches into the ended block."""
+        pool = NodePool(name="gpu")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        sim = block_sim(nodepool=pool)
+        pods = gpu_pods(sim, 2)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        block_claims = [c for c in sim.store.nodeclaims.values()
+                       if c.annotations.get("karpenter.tpu/reservation-id")
+                       == BLOCK_ID]
+        assert block_claims, "solver did not commit the capacity block"
+        assert all(c.capacity_type == L.CAPACITY_RESERVED
+                   for c in block_claims)
+        # schedule the block's end
+        ends = sim.clock.now() + BLOCK_DRAIN_LEAD + 120
+        for t in sim.cloud.types.values():
+            for o in t.offerings:
+                if o.reservation_id == BLOCK_ID:
+                    o.reservation_ends = ends
+        sim.catalog.refresh()
+        # inside the lead window the claims drain
+        sim.engine.run_for(200, step=10)
+        res_exp = next(c for c in sim.engine.controllers
+                       if c.name == "capacityreservation.expiration")
+        assert res_exp.stats["blocks_drained"] >= 1
+        assert all(c.annotations.get("karpenter.tpu/reservation-id")
+                   != BLOCK_ID or c.is_deleting()
+                   for c in sim.store.nodeclaims.values())
+        # at the end time the block expires cloud-side
+        sim.engine.run_for(600, step=10)
+        assert BLOCK_ID in sim.cloud.expired_reservations
+
+    def test_expired_block_offering_unavailable_in_catalog(self):
+        sim = block_sim()
+        for t in sim.cloud.types.values():
+            for o in t.offerings:
+                if o.reservation_id == BLOCK_ID:
+                    o.reservation_ends = sim.clock.now() - 1
+        sim.catalog.refresh()
+        offs = [o for t in sim.catalog.list() for o in t.offerings
+                if o.reservation_id == BLOCK_ID]
+        assert offs and all(not o.available for o in offs)
+
+
+class TestReservationDrift:
+    def test_vanished_reservation_drifts_the_node(self):
+        """Fifth drift reason: a reserved node whose reservation left the
+        catalog is replaced (drift.go:35-41)."""
+        pool = NodePool(name="gpu")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        sim = block_sim(nodepool=pool)
+        pods = gpu_pods(sim, 2)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        reserved = [c for c in sim.store.nodeclaims.values()
+                    if c.capacity_type == L.CAPACITY_RESERVED]
+        assert reserved
+        # the reservation disappears from the cloud's catalog entirely
+        for t in sim.cloud.types.values():
+            t.offerings = [o for o in t.offerings
+                           if o.reservation_id != BLOCK_ID]
+        sim.catalog.refresh()
+        sim.engine.run_for(120, step=5)
+        assert sim.disruption.stats["drift"] >= 1
+        # drifted claims were replaced; survivors don't cite the dead block
+        for c in sim.store.nodeclaims.values():
+            if not c.is_deleting():
+                assert c.annotations.get(
+                    "karpenter.tpu/reservation-id") != BLOCK_ID
+
+    def test_demoted_claim_does_not_drift(self):
+        """Default-reservation expiry demotes to on-demand and clears the
+        annotation — the drift pass must NOT then roll the node."""
+        pool = NodePool(name="gpu")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        sim = block_sim(nodepool=pool)
+        # repaint the block as a DEFAULT reservation for this test
+        for t in sim.cloud.types.values():
+            for o in t.offerings:
+                if o.reservation_id == BLOCK_ID:
+                    o.reservation_type = "default"
+        sim.catalog.refresh()
+        pods = gpu_pods(sim, 2)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        names = {c.name for c in sim.store.nodeclaims.values()
+                 if c.capacity_type == L.CAPACITY_RESERVED}
+        assert names
+        sim.cloud.expire_reservation(BLOCK_ID)
+        sim.engine.run_for(300, step=10)
+        for name in names:
+            c = sim.store.nodeclaims.get(name)
+            assert c is not None and not c.is_deleting()
+            assert c.capacity_type == L.CAPACITY_ON_DEMAND
+            assert "karpenter.tpu/reservation-id" not in c.annotations
+        assert sim.disruption.stats["drift"] == 0

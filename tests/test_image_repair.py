@@ -126,12 +126,17 @@ class TestRepair:
 class TestReservations:
     def test_reserved_launch_and_expiry_demotion(self):
         from karpenter_tpu.catalog import generate_catalog
+        # demotion is DEFAULT-reservation semantics; capacity blocks drain
+        # instead (tests/test_capacity_blocks.py covers those)
         types = [t for t in generate_catalog()
-                 if any(o.capacity_type == "reserved" for o in t.offerings)]
+                 if any(o.capacity_type == "reserved"
+                        and o.reservation_type == "default"
+                        for o in t.offerings)]
         assert types
         sim = make_sim(types=types[:10])
         t = sim.catalog.raw_types()[0]
-        res_off = next(o for o in t.offerings if o.capacity_type == "reserved")
+        res_off = next(o for o in t.offerings if o.capacity_type == "reserved"
+                       and o.reservation_type == "default")
         # a pod pinned to reserved capacity on this type
         add_pods(sim, 1, cpu="1", mem="1Gi", prefix="resv",
                  node_selector={L.INSTANCE_TYPE: t.name,
