@@ -202,6 +202,15 @@ class Provisioner:
         """Returns (created_claims, pods_of_failed_launches)."""
         if not launches:
             return [], []
+        from ..ops.facade import min_values_floors
+        floors = min_values_floors(pool.requirements)
+        # reservation ids + flavors ride along so reserved launches can be
+        # attributed, counted, and type-partitioned; loop-invariant, built
+        # once per batch
+        res_ids = {(t.name, o.zone, o.capacity_type):
+                   (o.reservation_id, o.reservation_type)
+                   for t in self.catalog.raw_types()
+                   for o in t.offerings if o.reservation_id}
         requests, claims = [], []
         for launch in launches:
             claim = NodeClaim(
@@ -219,12 +228,6 @@ class Provisioner:
             claim.instance_type = launch.instance_type
             self.store.add_nodeclaim(claim)
             claims.append((claim, launch))
-            # reservation ids + flavors ride along so reserved launches can
-            # be attributed, counted, and type-partitioned
-            res_ids = {(t.name, o.zone, o.capacity_type):
-                       (o.reservation_id, o.reservation_type)
-                       for t in self.catalog.raw_types()
-                       for o in t.offerings if o.reservation_id}
             overrides = [
                 LaunchOverride(*o,
                                reservation_id=res_ids.get(o[:3], (None, ""))[0],
@@ -233,7 +236,8 @@ class Provisioner:
                 for o in launch.overrides]
             requests.append(LaunchRequest(
                 nodeclaim_name=claim.name,
-                overrides=self._partition_reservation_overrides(overrides),
+                overrides=self._partition_reservation_overrides(overrides,
+                                                                floors),
                 image_id=(node_class.resolved_images[0]
                           if node_class.resolved_images else "img-default"),
                 user_data=self._user_data(pool, node_class, launch),
@@ -249,7 +253,22 @@ class Provisioner:
                           claim.annotations["karpenter.tpu/nodeclass-hash-version"]},
                 network_groups=list(node_class.resolved_network_groups),
                 profile=node_class.resolved_profile))
+        # single launch-floor choke point (reference contract: Truncate +
+        # the whole filter chain run BEFORE CreateFleet, instance.go:293):
+        # any mutation downstream of override selection — here, in-flight
+        # IP accounting — that would drop a reachable minValues floor is
+        # rolled back, so no wire request ever ships below a floor its
+        # pre-mutation rows satisfied. (The reservation partition above is
+        # a hard cloud constraint and does its own floor-aware fallback.)
+        baseline = {req.nodeclaim_name: list(req.overrides)
+                    for req in requests} if floors else {}
         self._apply_inflight_ip_accounting(requests)
+        if floors:
+            for req in requests:
+                pre = baseline[req.nodeclaim_name]
+                if (self._floors_hold(pre, floors)
+                        and not self._floors_hold(req.overrides, floors)):
+                    req.overrides = pre
         results = self.cloud.create_fleet(requests)
 
         launched: List[NodeClaim] = []
@@ -326,24 +345,60 @@ class Provisioner:
                 self.catalog.unavailable.mark_unavailable(t, z, c, reason="ICE")
 
     @staticmethod
+    def _floors_hold(overrides: List[LaunchOverride],
+                     floors) -> bool:
+        """Do the override rows span every evaluable minValues floor?
+        Only the three offering-visible keys (instance-type, zone,
+        capacity-type) can be judged from wire rows; label-key floors
+        were already secured by the facade's constrained selection."""
+        for key, need in floors:
+            if key == L.INSTANCE_TYPE:
+                vals = {o.instance_type for o in overrides}
+            elif key == L.ZONE:
+                vals = {o.zone for o in overrides}
+            elif key == L.CAPACITY_TYPE:
+                vals = {o.capacity_type for o in overrides}
+            else:
+                continue
+            if len(vals) < need:
+                return False
+        return True
+
+    @staticmethod
     def _partition_reservation_overrides(
-            overrides: List[LaunchOverride]) -> List[LaunchOverride]:
+            overrides: List[LaunchOverride],
+            floors=()) -> List[LaunchOverride]:
         """Reservation-type partition (reference filter.go:73-228): one
         launch may not mix reservation flavors. When the committed row
         (first override — the solver's pick) is a capacity block, the
         request targets exactly the cheapest block's rows and nothing
         else; otherwise capacity-block rows are dropped from the
         alternates (blocks only serve launches that explicitly chose
-        them — a spot/OD launch must not spill into a prepaid block)."""
+        them — a spot/OD launch must not spill into a prepaid block).
+
+        floors: minValues floors of the launching pool. Collapsing to a
+        single block would ship one instance type; when that breaks a
+        floor the full list still satisfied, the launch falls back to
+        the drop-block-rows branch instead — flexibility floors outrank
+        block affinity (the reference never reaches this conflict: its
+        block filter only runs for explicitly reserved launches, which
+        don't carry type-flex floors)."""
         is_block = lambda o: (o.reservation_id is not None
                               and o.reservation_type == "capacity-block")
         blocks = [o for o in overrides if is_block(o)]
         if not blocks:
             return overrides
+        nonblock = [o for o in overrides if not is_block(o)]
         if overrides and is_block(overrides[0]):
             best = min(blocks, key=lambda o: o.price).reservation_id
-            return [o for o in overrides if o.reservation_id == best]
-        return [o for o in overrides if not is_block(o)]
+            kept = [o for o in overrides if o.reservation_id == best]
+            if (floors and nonblock
+                    and Provisioner._floors_hold(overrides, floors)
+                    and not Provisioner._floors_hold(kept, floors)
+                    and Provisioner._floors_hold(nonblock, floors)):
+                return nonblock
+            return kept
+        return nonblock
 
     def _apply_inflight_ip_accounting(self, requests: List[LaunchRequest],
                                       ) -> None:

@@ -93,6 +93,11 @@ class CatalogTensors:
     numeric_keys: List[str]               # [Ln]
     label_num: np.ndarray                 # f32 [T, Ln], nan where missing
     name_to_idx: Dict[str, int] = field(default_factory=dict)
+    # bool [T, Z, C]: the offering is a capacity-block reservation
+    # (reference CapacityReservationType capacity-block, filter.go:163-228
+    # — blocks only serve launches that explicitly target reserved
+    # capacity; the facade masks these out of `available` otherwise)
+    is_block: Optional[np.ndarray] = None
 
     @property
     def T(self) -> int:
@@ -152,6 +157,7 @@ def encode_catalog(types: Sequence[InstanceType],
     price = np.full((T, len(zs), len(CAPACITY_TYPES)), np.inf, np.float32)
     available = np.zeros((T, len(zs), len(CAPACITY_TYPES)), bool)
     reservation_cap = np.zeros((T, len(zs), len(CAPACITY_TYPES)), np.int32)
+    is_block = np.zeros((T, len(zs), len(CAPACITY_TYPES)), bool)
 
     for i, t in enumerate(types):
         for k in label_keys:
@@ -173,11 +179,17 @@ def encode_catalog(types: Sequence[InstanceType],
             price[i, zi, ci] = o.price
             available[i, zi, ci] = o.available
             reservation_cap[i, zi, ci] = o.reservation_capacity
+            # last-write-wins like the sibling per-cell fields — a sticky
+            # OR here could mark a colliding non-block reserved offering
+            # as a block and gate it away for unconstrained pools
+            is_block[i, zi, ci] = (o.reservation_id is not None
+                                   and o.reservation_type == "capacity-block")
 
     return CatalogTensors(
         names=[t.name for t in types], zones=zs, captypes=CAPACITY_TYPES,
         resources=tuple(resource_axis()), allocatable=allocatable, price=price,
         available=available, reservation_cap=reservation_cap,
+        is_block=is_block,
         label_keys=label_keys, vocab=vocab, label_val=label_val,
         numeric_keys=numeric_keys, label_num=label_num,
         name_to_idx={t.name: i for i, t in enumerate(types)},

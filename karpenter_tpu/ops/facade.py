@@ -39,8 +39,24 @@ MAX_OVERRIDES = 60  # reference MaxInstanceTypes (instance.go:62)
 _MESH_UNSET = object()
 
 
-def _min_values_floors(requirements: Optional[Requirements],
-                       ) -> List[Tuple[str, int]]:
+def targets_reserved(requirements: Optional[Requirements]) -> bool:
+    """Does a Requirements conjunction EXPLICITLY name the reserved
+    capacity type (an In requirement listing "reserved")? This is the
+    capacity-block gate of the reference launch filters
+    (filter.go:163-228 shouldFilter: requirements.Get(capacity-type)
+    .Has(reserved)): prepaid capacity blocks only serve launches that
+    opted into reserved capacity — an unconstrained pool must never
+    spill plain pods onto a block just because its price rounds to
+    zero. Exists / NotIn do not count: they don't *name* reserved."""
+    if requirements is None:
+        return False
+    vs = requirements.get(L.CAPACITY_TYPE)
+    return (vs is not None and not vs.complement
+            and L.CAPACITY_RESERVED in vs.values)
+
+
+def min_values_floors(requirements: Optional[Requirements],
+                      ) -> List[Tuple[str, int]]:
     """(key, minValues) floors of a Requirements conjunction — the single
     extraction both the node-opening caps and the override-row selection
     share, so the two enforcement points can't diverge."""
@@ -193,6 +209,17 @@ class Solver:
         cat = self.tensors(node_class)
         if cat.T == 0 or not pods:
             return SolveOutput([], {}, [_pod_key(p) for p in pods])
+        # capacity-block gate (reference filter.go:163-228): unless the
+        # pool explicitly targets reserved capacity, block offerings are
+        # removed from the availability tensor BEFORE the solve — the
+        # cost-argmin must never commit a prepaid block for a pool that
+        # didn't select it (and the override list can't resurrect one)
+        blocks_gated = False
+        if (cat.is_block is not None and cat.is_block.any()
+                and not targets_reserved(nodepool.requirements)):
+            from dataclasses import replace as _dc_replace
+            cat = _dc_replace(cat, available=cat.available & ~cat.is_block)
+            blocks_gated = True
         fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())
@@ -296,10 +323,11 @@ class Solver:
                 from .solver import device_catalog, solve_device
                 R = enc.requests.shape[1]
                 mesh = self.mesh() if backend == "mesh" else None
-                # keyed on (nodeclass hash, catalog epoch, R, placement) —
-                # NOT id(cat): a freed CatalogTensors' address can be
-                # reused by its successor
-                dkey = self._last_cat_key + (R, backend == "mesh")
+                # keyed on (nodeclass hash, catalog epoch, R, placement,
+                # block gating) — NOT id(cat): a freed CatalogTensors'
+                # address can be reused by its successor
+                dkey = self._last_cat_key + (R, backend == "mesh",
+                                             blocks_gated)
                 dcat = self._dcat_cache.get(dkey)
                 if dcat is None:
                     self._dcat_cache.clear()  # one epoch resident at a time
@@ -596,7 +624,7 @@ class Solver:
         prices = cat.price[t_idx, z_idx, c_idx]
         by_price = np.argsort(prices, kind="stable")
         order = self._floor_rows(cat, t_idx, z_idx, c_idx, by_price,
-                                 _min_values_floors(requirements))
+                                 min_values_floors(requirements))
         primary = node.type_idx
         # ensure the committed type's cheapest offering is first
         rows = [(cat.names[t_idx[j]], cat.zones[z_idx[j]],
@@ -616,7 +644,7 @@ class Solver:
         single-group nodes (the dominant dense case); mixed-group nodes can
         combine loads that narrow further, where the override floor stays
         best-effort."""
-        mv = _min_values_floors(requirements)
+        mv = min_values_floors(requirements)
         if not mv:
             return
         from .binpack import BIG, EPS

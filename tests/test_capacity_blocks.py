@@ -66,13 +66,58 @@ class TestPartitionFilter:
         assert Provisioner._partition_reservation_overrides(rows) == rows
 
 
-class TestBlockLifecycle:
-    def test_gpu_pods_land_on_block_and_drain_before_end(self):
-        """The solver picks the near-zero-priced block; the expiration
-        controller drains its claims BLOCK_DRAIN_LEAD before end and the
-        cloud rejects launches into the ended block."""
+class TestSolveTimeGate:
+    def test_untargeted_pool_never_lands_on_block(self):
+        """The solve-time gate (reference filter.go:163-228): a pool that
+        does not explicitly name reserved capacity must not commit a
+        capacity block even though block prices round to zero — its gpu
+        pods land on spot/on-demand and no launch override cites a block."""
         pool = NodePool(name="gpu")
         pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        sim = block_sim(nodepool=pool)
+        launches = []
+        orig = sim.cloud.create_fleet
+
+        def spy(requests):
+            launches.extend(requests)
+            return orig(requests)
+        sim.cloud.create_fleet = spy
+        pods = gpu_pods(sim, 2)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        assert launches
+        for req in launches:
+            for o in req.overrides:
+                assert o.reservation_type != "capacity-block"
+        for c in sim.store.nodeclaims.values():
+            assert "karpenter.tpu/reservation-id" not in c.annotations or \
+                not c.annotations["karpenter.tpu/reservation-id"].startswith("cb-")
+
+    def test_explicit_reserved_pool_uses_block(self):
+        """The same pods under a pool that names reserved capacity DO
+        land on the prepaid block — the gate opens on explicit intent."""
+        pool = NodePool(name="gpu")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        pool.requirements.add(Requirement(
+            L.CAPACITY_TYPE, Operator.IN, (L.CAPACITY_RESERVED,)))
+        sim = block_sim(nodepool=pool)
+        pods = gpu_pods(sim, 2)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        assert any(c.annotations.get("karpenter.tpu/reservation-id")
+                   == BLOCK_ID for c in sim.store.nodeclaims.values())
+
+
+class TestBlockLifecycle:
+    def test_gpu_pods_land_on_block_and_drain_before_end(self):
+        """A pool explicitly targeting reserved capacity lands on the
+        near-zero-priced block; the expiration controller drains its
+        claims BLOCK_DRAIN_LEAD before end and the cloud rejects
+        launches into the ended block."""
+        pool = NodePool(name="gpu")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        pool.requirements.add(Requirement(
+            L.CAPACITY_TYPE, Operator.IN, (L.CAPACITY_RESERVED,)))
         sim = block_sim(nodepool=pool)
         pods = gpu_pods(sim, 2)
         assert sim.engine.run_until(
@@ -120,6 +165,11 @@ class TestReservationDrift:
         catalog is replaced (drift.go:35-41)."""
         pool = NodePool(name="gpu")
         pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        # reserved named explicitly (opens the block gate) + on-demand so
+        # the drift replacement has somewhere to land once the block dies
+        pool.requirements.add(Requirement(
+            L.CAPACITY_TYPE, Operator.IN,
+            (L.CAPACITY_RESERVED, L.CAPACITY_ON_DEMAND)))
         sim = block_sim(nodepool=pool)
         pods = gpu_pods(sim, 2)
         assert sim.engine.run_until(
