@@ -116,16 +116,31 @@ class BatchingCloud:
             # individually-bad ids fail alone; per-id RETRYABLE failures
             # go back in the pending set for the next window (the GC sweep
             # remains the final backstop for anything that still leaks)
+            requeued = False
             for n, iid in enumerate(batch):
                 try:
                     self.inner.terminate([iid])
                 except CloudError as pe:
                     self.stats["terminate_errors"] += 1
                     if getattr(pe, "retryable", False):
+                        # raise the gate BEFORE requeueing: a full-size
+                        # remainder would otherwise trip terminate()'s
+                        # max_items immediate-flush check against the
+                        # still-cleared gate and re-hit the throttling
+                        # cloud in the same tick; wiping the gate after
+                        # would re-flush every half-idle tick — both are
+                        # the amplification the backoff exists to prevent
+                        now = self.clock.now()
+                        self._backoff = min(
+                            max(self._backoff * 2, self.idle), 30.0)
+                        self._retry_after = max(self._retry_after,
+                                                now + self._backoff)
                         self.terminate(batch[n:])  # requeue the remainder
+                        requeued = True
                         break
-            self._backoff = 0.0
-            self._retry_after = 0.0
+            if not requeued:
+                self._backoff = 0.0
+                self._retry_after = 0.0
             self._describe_cache.flush()
             return
         self._backoff = 0.0

@@ -240,8 +240,11 @@ class Pod:
         Grouping 100k pods by nested-tuple signatures re-hashes every tuple
         per solve; interning to a small int once per pod lifetime (the store
         does it at admission) makes solve-time grouping an int-dict pass.
-        Ids only ever grow — equal signatures always map to the same id, so
-        grouping by id is exactly grouping by signature.
+        Equal signatures map to the same id WITHIN one intern generation;
+        the table rotates at capacity, so pods admitted across a rotation
+        can hold different ids for equal signatures — group_pods merges
+        such split groups by signature afterwards, keeping grouping
+        exactly signature-equality.
         """
         gid = self._gid
         if gid is None:

@@ -229,6 +229,24 @@ def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
         else:
             lst.append(p)
     groups = [PodGroup(pods=v, representative=v[0]) for v in by_gid.values()]
+    if len(groups) > 1:
+        # intern-rotation safety: the gid table rotates at capacity, so
+        # pods admitted across a rotation can hold DIFFERENT gids for
+        # equal signatures; merge such split groups by the
+        # representatives' (cached) signatures so grouping stays exactly
+        # signature-equality — splitting one interchangeable set would
+        # silently weaken combined topology-spread/anti-affinity caps
+        by_sig: Dict[tuple, PodGroup] = {}
+        merged: List[PodGroup] = []
+        for g in groups:
+            sig = g.representative.constraint_signature()
+            prev = by_sig.get(sig)
+            if prev is None:
+                by_sig[sig] = g
+                merged.append(g)
+            else:
+                prev.pods.extend(g.pods)
+        groups = merged
     groups.sort(key=lambda g: (-g.representative.requests.get("cpu"),
                                -g.representative.requests.get("memory"),
                                g.representative.name))

@@ -191,7 +191,8 @@ class Solver:
               capacity_cap: Optional[Resources] = None,
               existing_pods: Optional[Dict[str, List[Pod]]] = None,
               spread_occupancy: Optional[
-                  List[Tuple[Optional[str], List[Pod]]]] = None) -> SolveOutput:
+                  List[Tuple[Optional[str], List[Pod]]]] = None,
+              _gate_blocks: bool = True) -> SolveOutput:
         """capacity_cap: only open nodes whose total capacity fits within it
         (the NodePool-limits headroom; the reference scheduler stops opening
         virtual nodes that would breach spec.limits the same way).
@@ -215,11 +216,12 @@ class Solver:
         # cost-argmin must never commit a prepaid block for a pool that
         # didn't select it (and the override list can't resurrect one)
         blocks_gated = False
-        if (cat.is_block is not None and cat.is_block.any()
+        if (_gate_blocks and cat.is_block is not None and cat.is_block.any()
                 and not targets_reserved(nodepool.requirements)):
             from dataclasses import replace as _dc_replace
             cat = _dc_replace(cat, available=cat.available & ~cat.is_block)
             blocks_gated = True
+        all_pods = list(pods)
         fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())
@@ -262,8 +264,11 @@ class Solver:
                 bundle_occupancy.append((cat.zones[zi], b.pods))
             pods = plan.remaining
             if not pods:
-                out = SolveOutput([], {}, [])
-                return self._merge_plan(out, plan, cat, nodepool)
+                out = self._merge_plan(SolveOutput([], {}, []), plan,
+                                       cat, nodepool)
+                return self._retry_reserved_unschedulable(
+                    out, blocks_gated, all_pods, nodepool, node_class,
+                    spread_occupancy)
         enc = encode_pods(pods, cat,
                           extra_requirements=nodepool.requirements,
                           taints=nodepool.taints + nodepool.startup_taints)
@@ -290,8 +295,11 @@ class Solver:
         enc = split_spread_groups(
             enc, cat, self._spread_constraints(enc, cat, occupancy))
         if enc.G == 0:
-            return self._merge_plan(SolveOutput([], {}, dropped), plan,
-                                    cat, nodepool)
+            out = self._merge_plan(SolveOutput([], {}, dropped), plan,
+                                   cat, nodepool)
+            return self._retry_reserved_unschedulable(
+                out, blocks_gated, all_pods, nodepool, node_class,
+                spread_occupancy)
         self._relax_infeasible_preferences(enc, cat)
 
         if existing and existing_pods:
@@ -330,7 +338,14 @@ class Solver:
                                              blocks_gated)
                 dcat = self._dcat_cache.get(dkey)
                 if dcat is None:
-                    self._dcat_cache.clear()  # one epoch resident at a time
+                    # one EPOCH resident at a time — but every variant of
+                    # the current epoch (both block-gating states, mesh vs
+                    # single) may stay, or mixed pools would thrash a full
+                    # host→device transfer on every alternate solve
+                    prefix = self._last_cat_key
+                    for k in [k for k in self._dcat_cache
+                              if k[:len(prefix)] != prefix]:
+                        del self._dcat_cache[k]
                     dcat = device_catalog(cat, R, mesh=mesh)
                     self._dcat_cache[dkey] = dcat
                 result = solve_device(cat, enc, existing, dcat=dcat,
@@ -339,7 +354,42 @@ class Solver:
         SOLVE_PODS.observe(float(enc.counts.sum()))
 
         out = self._decode(cat, enc, result, nodepool, dropped)
-        return self._merge_plan(out, plan, cat, nodepool)
+        out = self._merge_plan(out, plan, cat, nodepool)
+        return self._retry_reserved_unschedulable(
+            out, blocks_gated, all_pods, nodepool, node_class,
+            spread_occupancy)
+
+    def _retry_reserved_unschedulable(
+            self, out: SolveOutput, blocks_gated: bool, all_pods: List[Pod],
+            nodepool: NodePool, node_class: Optional[NodeClassSpec],
+            spread_occupancy) -> SolveOutput:
+        """Pods the gated solve left unschedulable that EXPLICITLY target
+        reserved capacity (a pod-level capacity-type selector naming
+        "reserved" under a pool that doesn't) get one ungated re-solve
+        onto fresh nodes: the reference gate evaluates the MERGED
+        nodeclaim requirements (filter.go shouldFilter), so a pod's own
+        reserved intent must open capacity blocks even when its pool
+        stays silent. Fresh nodes only — blocks never live on existing
+        capacity, and reusing the first solve's mutated node views would
+        double-count headroom."""
+        if not blocks_gated or not out.unschedulable:
+            return out
+        by_key = {_pod_key(p): p for p in all_pods}
+        retry = [by_key[k] for k in out.unschedulable
+                 if k in by_key
+                 and targets_reserved(by_key[k].scheduling_requirements())]
+        if not retry:
+            return out
+        second = self.solve(retry, nodepool, node_class,
+                            spread_occupancy=spread_occupancy,
+                            _gate_blocks=False)
+        retried = {_pod_key(p) for p in retry}
+        out.launches += second.launches
+        for name, keys in second.existing_placements.items():
+            out.existing_placements.setdefault(name, []).extend(keys)
+        out.unschedulable = [k for k in out.unschedulable
+                             if k not in retried] + second.unschedulable
+        return out
 
     def _merge_plan(self, out: SolveOutput, plan: Optional[ColocationPlan],
                     cat: CatalogTensors, nodepool: NodePool) -> SolveOutput:

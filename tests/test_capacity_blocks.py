@@ -93,6 +93,27 @@ class TestSolveTimeGate:
             assert "karpenter.tpu/reservation-id" not in c.annotations or \
                 not c.annotations["karpenter.tpu/reservation-id"].startswith("cb-")
 
+    def test_pod_level_reserved_selector_opens_gate(self):
+        """A pod that ITSELF selects reserved capacity under an
+        untargeted pool still reaches the block: the reference gate
+        evaluates merged nodeclaim requirements (filter.go shouldFilter),
+        so pod-level intent opens it — via the facade's ungated re-solve
+        of reserved-targeting unschedulable pods."""
+        pool = NodePool(name="gpu")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        sim = block_sim(nodepool=pool)
+        pods = [Pod(name=f"r-{i}",
+                    requests=Resources.parse({"cpu": "2", "memory": "4Gi",
+                                              NVIDIA_GPU: 1}),
+                    node_selector={L.CAPACITY_TYPE: L.CAPACITY_RESERVED})
+                for i in range(2)]
+        for p in pods:
+            sim.store.add_pod(p)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        assert any(c.annotations.get("karpenter.tpu/reservation-id")
+                   == BLOCK_ID for c in sim.store.nodeclaims.values())
+
     def test_explicit_reserved_pool_uses_block(self):
         """The same pods under a pool that names reserved capacity DO
         land on the prepaid block — the gate opens on explicit intent."""

@@ -85,6 +85,29 @@ class TestEncodePods:
         # FFD order: bigger cpu first
         assert groups[0].count == 30 and groups[1].count == 50
 
+    def test_grouping_survives_intern_rotation(self, monkeypatch):
+        """Review finding: the gid intern table rotates at capacity, so
+        equal signatures interned across a rotation get DIFFERENT gids.
+        Grouping must still yield one group per distinct signature."""
+        import karpenter_tpu.models.pod as pod_mod
+        early = [mk_pod(f"e-{i}") for i in range(10)]
+        for p in early:
+            p.group_key()  # interned pre-rotation
+        monkeypatch.setattr(pod_mod, "_SIG_INTERN_MAX", 1)
+        # distinct signature forces the rotation (table hits "capacity")
+        filler = mk_pod("filler", cpu="3")
+        filler.group_key()
+        late = [mk_pod(f"l-{i}") for i in range(10)]
+        for p in late:
+            p.group_key()  # same signature as `early`, post-rotation
+        assert early[0].group_key() != late[0].group_key(), \
+            "rotation did not split gids — test setup is stale"
+        groups = group_pods(early + late + [filler])
+        sizes = sorted(g.count for g in groups)
+        assert len(groups) == 2 and sizes == [1, 20], (
+            "equal-signature pods split across intern generations must "
+            "re-merge into one group")
+
     def test_encoded_fields(self):
         pods = ([mk_pod(f"a-{i}") for i in range(10)] +
                 [mk_pod(f"z-{i}", node_selector={L.ZONE: "zone-b"}) for i in range(5)] +

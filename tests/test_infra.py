@@ -151,6 +151,37 @@ class TestBatchingCloud:
         # exponential gaps, not one attempt per tick
         assert len(calls) <= 6
 
+    def test_per_id_retryable_remainder_keeps_backoff(self):
+        """Review finding (round 2, high): the per-id fallback used to
+        wipe _backoff/_retry_after after requeuing a retryable remainder,
+        hot-looping against the throttling cloud every flusher tick. The
+        requeued remainder must back off exponentially instead."""
+        from karpenter_tpu.cloud.provider import (NotFoundError,
+                                                  RateLimitedError)
+        cloud, clock = _mk_cloud()
+        batch_calls = []
+
+        def misbehaving(ids):
+            batch_calls.append((clock.now(), list(ids)))
+            if len(ids) > 1:
+                # batch path: NON-retryable → per-id fallback
+                raise NotFoundError("bad batch")
+            # per-id path: throttled → remainder requeued
+            raise RateLimitedError("throttle")
+        cloud.terminate = misbehaving
+        b = BatchingCloud(cloud, clock, idle=0.1)
+        b.terminate(["a", "b", "c"])
+        clock.step(0.2)
+        b.flush()  # batch fails non-retryably, id "a" throttles, requeue
+        assert b._pending == ["a", "b", "c"]
+        assert b._retry_after > clock.now()  # gate survived the fallback
+        first_attempts = len(batch_calls)
+        for _ in range(50):  # flusher ticking every 50ms for 2.5s
+            clock.step(0.05)
+            b.flush()
+        # exponential gaps: a wiped gate would attempt ~50 flushes
+        assert len(batch_calls) - first_attempts <= 12
+
     def test_runtime_concurrent_reconcilers_one_wire_call(self):
         """The wired path: N controllers under the async Runtime + the
         flusher task → one TerminateInstances wire call."""
