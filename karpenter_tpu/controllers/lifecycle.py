@@ -80,7 +80,7 @@ class LifecycleController:
             self.cloud.terminate([iid])
         for pod in self.store.pods.values():
             if pod.annotations.get(NOMINATED) == claim.name:
-                del pod.annotations[NOMINATED]
+                self.store.unnominate_pod(pod)
         self.store.delete_nodeclaim(claim.name)
 
 
@@ -104,7 +104,8 @@ class BindingController:
                 continue
             claim = claims_by_name.get(claim_name)
             if claim is None:
-                del pod.annotations[NOMINATED]  # claim gone: back to pending
+                # claim gone: back to pending (and the pending index)
+                self.store.unnominate_pod(pod)
                 continue
             if claim.phase in (Phase.REGISTERED, Phase.INITIALIZED) and claim.node_name:
                 node = self.store.nodes.get(claim.node_name)

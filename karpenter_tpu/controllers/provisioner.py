@@ -33,7 +33,7 @@ from ..models.resources import Resources
 from ..ops.facade import NodeLaunch, Solver, virtual_node_from_claim
 from ..state.store import Store
 
-NOMINATED = "karpenter.tpu/nominated-nodeclaim"
+NOMINATED = L.NOMINATED  # canonical home: models/labels.py
 
 
 @dataclass
@@ -49,15 +49,26 @@ class Provisioner:
         "solves": 0, "launches": 0, "ice_errors": 0, "unschedulable": 0})
 
     def reconcile(self, now: float) -> float:
-        pending = [p for p in self.store.pending_pods()
-                   if NOMINATED not in p.annotations]
-        if not pending:
+        # the store's admission-time index IS the pending-unnominated set,
+        # already bucketed by constraint signature — the first pool's
+        # encode skips its per-pod grouping pass entirely
+        groups = self.store.pending_unnominated_groups()
+        if not groups:
             return self.requeue
+        pending = [p for g in groups for p in g]
         remaining: List[Pod] = pending
+        pregrouped: Optional[List[List[Pod]]] = groups
         for pool in self.store.nodepools_by_weight():
             if not remaining:
                 break
-            remaining = self._provision_pool(pool, remaining, now)
+            out = self._provision_pool(pool, remaining, now, pregrouped)
+            if out is not remaining:
+                # the pool actually solved (a not-ready NodeClass gate
+                # returns the identical list object untouched — keep the
+                # index's grouping for the next pool in that case);
+                # leftovers of a real solve are regrouped, they're small
+                pregrouped = None
+            remaining = out
         self.stats["unschedulable"] = len(remaining)
         PODS_UNSCHEDULABLE.set(len(remaining))
         for p in remaining:
@@ -97,7 +108,9 @@ class Provisioner:
 
     # --- per-pool pass ---
     def _provision_pool(self, pool: NodePool, pods: List[Pod],
-                        now: float) -> List[Pod]:
+                        now: float,
+                        pregrouped: Optional[List[List[Pod]]] = None,
+                        ) -> List[Pod]:
         node_class = self.store.nodeclasses.get(pool.node_class) or NodeClassSpec()
         if not node_class.ready:
             return pods  # NodeClass readiness gate (cloudprovider.go:102-111)
@@ -118,7 +131,8 @@ class Provisioner:
             existing_pods[view.claim.name] = view.pods
         out = self.solver.solve(pods, pool, node_class, existing,
                                 existing_pods=existing_pods,
-                                spread_occupancy=spread_occupancy)
+                                spread_occupancy=spread_occupancy,
+                                pregrouped=pregrouped)
         self.stats["solves"] += 1
 
         by_key = {f"{p.namespace}/{p.name}": p for p in pods}
@@ -444,5 +458,5 @@ class Provisioner:
             custom_user_data=node_class.user_data))
 
     def _nominate(self, pod: Pod, claim: NodeClaim) -> None:
-        pod.annotations[NOMINATED] = claim.name
+        self.store.nominate_pod(pod, claim.name)
         PODS_SCHEDULED.inc()

@@ -64,18 +64,16 @@ class TerminationController:
                 # Keep nominations pointing at OTHER claims (a pre-spun
                 # consolidation replacement) — only clear ones aimed here.
                 for p in pods:
-                    p.node_name = None
-                    p.phase = "Pending"
                     if p.annotations.get(NOMINATED) == claim.name:
-                        p.annotations.pop(NOMINATED)
+                        self.store.unnominate_pod(p)
+                    self.store.unbind_pod(p)
                 return  # wait a tick for rescheduling before teardown
             self.store.delete_node(node.name)
         # un-nominate pods still pointing at this claim
         for p in self.store.pods.values():
             if p.annotations.get(NOMINATED) == claim.name:
-                del p.annotations[NOMINATED]
-                p.node_name = None
-                p.phase = "Pending"
+                self.store.unnominate_pod(p)
+                self.store.unbind_pod(p)
         if claim.provider_id:
             iid = claim.provider_id.rsplit("/", 1)[-1]
             self.cloud.terminate([iid])

@@ -19,6 +19,10 @@ REGION = "topology.kubernetes.io/region"
 HOSTNAME = "kubernetes.io/hostname"
 CAPACITY_TYPE = "karpenter.tpu/capacity-type"
 NODEPOOL = "karpenter.tpu/nodepool"
+# pod annotation: the NodeClaim a pending pod is nominated to (the
+# provisioner's in-flight placement marker; the store's pending-group
+# index keys off its presence)
+NOMINATED = "karpenter.tpu/nominated-nodeclaim"
 NODE_INITIALIZED = "karpenter.tpu/initialized"
 NODE_REGISTERED = "karpenter.tpu/registered"
 

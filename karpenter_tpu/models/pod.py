@@ -257,3 +257,57 @@ class Pod:
                 _sig_intern[sig] = gid
             self._gid = gid
         return gid
+
+
+def intern_pods(pods) -> None:
+    """Batch group_key over a pod sequence — the cold-encode fast path.
+
+    Semantically identical to calling p.group_key() per pod, but one
+    fused loop with no per-pod method-call frames, plus a batch-local
+    preliminary key for plain pods: the UNSORTED requests items-tuple.
+    Equal-content request dicts built in the same key order (the
+    overwhelmingly common case — one manifest stamped N times) hit the
+    prelim dict and skip signature canonicalization entirely, so the
+    sorted canonical tuple is built once per DISTINCT shape, not once
+    per pod. Dicts whose keys arrived in different orders miss prelim
+    and canonicalize — they still intern to the same gid (correctness
+    never depends on the prelim hit). This is the analogue of the
+    reference caching resolved instance types by hash so the hot path
+    never re-derives (instancetype.go:219-229)."""
+    intern = _sig_intern
+    prelim: Dict[Tuple, int] = {}
+    for p in pods:
+        if p._gid is not None:
+            continue
+        sig = p._sig
+        if sig is None:
+            if not (p.labels or p.node_selector or p.node_affinity
+                    or p.preferred_node_affinity or p.tolerations
+                    or p.topology_spread or p.affinity_terms):
+                it = tuple(p.requests.items())
+                key = (p.namespace, p.owner, it)
+                gid = prelim.get(key)
+                if gid is not None:
+                    p._gid = gid
+                    continue  # _sig stays lazy; constraint_signature()
+                    # recomputes it on demand from the same immutable data
+                sig = (p.namespace, p.owner,
+                       it if len(it) <= 1 else tuple(sorted(it)))
+                p._sig = sig
+                gid = intern.get(sig)
+                if gid is None:
+                    if len(intern) >= _SIG_INTERN_MAX:
+                        intern.clear()  # rotate; ids stay monotonic
+                    gid = next(_next_gid)
+                    intern[sig] = gid
+                p._gid = gid
+                prelim[key] = gid
+                continue
+            sig = p.constraint_signature()
+        gid = intern.get(sig)
+        if gid is None:
+            if len(intern) >= _SIG_INTERN_MAX:
+                intern.clear()  # rotate; ids stay monotonic
+            gid = next(_next_gid)
+            intern[sig] = gid
+        p._gid = gid

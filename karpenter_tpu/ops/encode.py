@@ -29,7 +29,7 @@ import numpy as np
 
 from ..models import labels as L
 from ..models.instancetype import InstanceType
-from ..models.pod import Pod, Taint, term_selects, tolerates_all
+from ..models.pod import Pod, Taint, intern_pods, term_selects, tolerates_all
 from ..models.requirements import (Operator, Requirement, Requirements,
                                    ValueSet, _tolerates_absence)
 from ..models.resources import Resources, num_resources, resource_axis
@@ -215,20 +215,31 @@ def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
     descending cpu-then-memory of the representative — the FFD 'decreasing'
     ordering (reference designs/bin-packing.md sorts pods by size desc).
 
-    Grouping keys on the interned int group id (Pod.group_key): for pods
-    the store already admitted this is one attribute read per pod, keeping
-    the 100k-pod steady-state encode off the Python signature path."""
+    Grouping keys on the interned int group id (Pod.group_key): pods the
+    store already admitted cost one attribute read each; raw pods go
+    through the batched intern_pods fast path first."""
+    intern_pods(pods)
     by_gid: Dict[int, List[Pod]] = {}
     for p in pods:
-        gid = p._gid
-        if gid is None:
-            gid = p.group_key()
-        lst = by_gid.get(gid)
+        lst = by_gid.get(p._gid)
         if lst is None:
-            by_gid[gid] = [p]
+            by_gid[p._gid] = [p]
         else:
             lst.append(p)
-    groups = [PodGroup(pods=v, representative=v[0]) for v in by_gid.values()]
+    return _finalize_groups(
+        [PodGroup(pods=v, representative=v[0]) for v in by_gid.values()])
+
+
+def groups_from_lists(lists: Sequence[Sequence[Pod]]) -> List[PodGroup]:
+    """PodGroups from pre-bucketed pod lists (the store's admission-time
+    pending-group index) — no per-pod pass. Each inner list must be one
+    signature-equal set; the lists are consumed (may be mutated)."""
+    return _finalize_groups(
+        [PodGroup(pods=list(ps) if not isinstance(ps, list) else ps,
+                  representative=ps[0]) for ps in lists if ps])
+
+
+def _finalize_groups(groups: List[PodGroup]) -> List[PodGroup]:
     if len(groups) > 1:
         # intern-rotation safety: the gid table rotates at capacity, so
         # pods admitted across a rotation can hold DIFFERENT gids for
@@ -289,6 +300,10 @@ class EncodedPods:
     # affinity.apply_zone_affinity, consumed by validate_solution — the
     # solvers themselves rely on the pre-pass's disjoint allow_zone masks)
     zone_conflict: Optional[np.ndarray] = None
+    # pod keys the taint filter dropped (whole signature-groups whose
+    # representative doesn't tolerate the NodePool taints) — the facade
+    # reads this instead of re-scanning O(pods) for the difference
+    dropped_keys: Optional[List[str]] = None
 
     @property
     def G(self) -> int:
@@ -384,18 +399,35 @@ def _axis_allow(reqs: Requirements, key: str, axis_values: Sequence[str]) -> np.
 
 def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
                 extra_requirements: Optional[Requirements] = None,
-                taints: Optional[List[Taint]] = None) -> EncodedPods:
+                taints: Optional[List[Taint]] = None,
+                pregrouped: Optional[Sequence[Sequence[Pod]]] = None,
+                ) -> EncodedPods:
     """Group + tensorize pods against a catalog.
 
     extra_requirements: the NodePool template requirements, conjoined into
     every group (the reference scheduler layers NodePool requirements onto
     pod requirements the same way, scheduling.md:17-31). Pods that don't
-    tolerate `taints` are dropped from the encoding (caller routes them to
-    another NodePool).
+    tolerate `taints` are dropped from the encoding per GROUP — tolerations
+    are part of the constraint signature, so the representative's verdict
+    is every member's verdict (caller routes dropped pods to another
+    NodePool via EncodedPods.dropped_keys).
+
+    pregrouped: optional pre-bucketed signature-equal pod lists (the
+    store's admission-time pending-group index) — skips the per-pod
+    grouping pass entirely; `pods` is then ignored for grouping.
     """
+    groups = (groups_from_lists(pregrouped) if pregrouped is not None
+              else group_pods(pods))
+    dropped_keys: List[str] = []
     if taints:
-        pods = [p for p in pods if tolerates_all(p.tolerations, taints)]
-    groups = group_pods(pods)
+        kept = []
+        for g in groups:
+            if tolerates_all(g.representative.tolerations, taints):
+                kept.append(g)
+            else:
+                dropped_keys.extend(f"{p.namespace}/{p.name}"
+                                    for p in g.pods)
+        groups = kept
 
     req_vecs = [g.representative.requests.to_vector() for g in groups]
     R = num_resources()
@@ -460,7 +492,8 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
                        conflict=build_conflicts(groups), spread_soft=spread_soft,
                        compat_hard=hard if (hard != compat).any() else None,
                        zone_hard=hard_z if (hard_z != allow_zone).any() else None,
-                       cap_hard=hard_c if (hard_c != allow_cap).any() else None)
+                       cap_hard=hard_c if (hard_c != allow_cap).any() else None,
+                       dropped_keys=dropped_keys or None)
 
 
 def _apply_preferred(rep: Pod, compat_row: np.ndarray, zone_row: np.ndarray,

@@ -192,6 +192,7 @@ class Solver:
               existing_pods: Optional[Dict[str, List[Pod]]] = None,
               spread_occupancy: Optional[
                   List[Tuple[Optional[str], List[Pod]]]] = None,
+              pregrouped: Optional[List[List[Pod]]] = None,
               _gate_blocks: bool = True) -> SolveOutput:
         """capacity_cap: only open nodes whose total capacity fits within it
         (the NodePool-limits headroom; the reference scheduler stops opening
@@ -221,7 +222,8 @@ class Solver:
             from dataclasses import replace as _dc_replace
             cat = _dc_replace(cat, available=cat.available & ~cat.is_block)
             blocks_gated = True
-        all_pods = list(pods)
+        all_pods = pods  # reference, captured before the colocation path
+        # rebinds the local; only read if the reserved retry fires
         fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())
@@ -230,10 +232,16 @@ class Solver:
                      for k, v in capacity_cap.items())
                  for t in types], bool)
         # required positive hostname affinity: the host-side co-location
-        # planner peels coupled pods off the tensor path (ops/colocate.py)
+        # planner peels coupled pods off the tensor path (ops/colocate.py).
+        # Positive affinity terms are part of the constraint signature, so
+        # with pre-bucketed input probing one representative per group is
+        # exact — no O(pods) scan
         plan = None
         bundle_occupancy: List[Tuple[Optional[str], List[Pod]]] = []
-        if has_colocation(pods):
+        colo_probe = ([ps[0] for ps in pregrouped if ps]
+                      if pregrouped is not None else pods)
+        if has_colocation(colo_probe):
+            pregrouped = None  # the planner consumes the raw pod list
             # the planner writes resident placements into the nodes' cum /
             # masks so the main solve sees consumed capacity — work on
             # copies: callers (disruption) reuse their VirtualNodes across
@@ -271,15 +279,15 @@ class Solver:
                     spread_occupancy)
         enc = encode_pods(pods, cat,
                           extra_requirements=nodepool.requirements,
-                          taints=nodepool.taints + nodepool.startup_taints)
+                          taints=nodepool.taints + nodepool.startup_taints,
+                          pregrouped=pregrouped)
         if fits_cap is not None:
             enc.compat &= fits_cap[None, :]
             if enc.compat_hard is not None:
                 enc.compat_hard = enc.compat_hard & fits_cap[None, :]
         self._apply_min_values_caps(enc, cat, nodepool.requirements)
         # pods dropped by the taint filter are unschedulable for this pool
-        enc_keys = {_pod_key(p) for g in enc.groups for p in g.pods}
-        dropped = [_pod_key(p) for p in pods if _pod_key(p) not in enc_keys]
+        dropped = list(enc.dropped_keys or ())
         occupancy = (list(spread_occupancy) if spread_occupancy is not None
                      else self._occupancy_from_existing(existing, existing_pods, cat))
         if plan is not None:

@@ -14,6 +14,7 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Callable, Dict, Iterable, List, Optional
 
+from ..models import labels as L
 from ..models.nodeclaim import Node, NodeClaim
 from ..models.nodepool import NodeClassSpec, NodePool
 from ..models.pod import Pod
@@ -22,6 +23,15 @@ from ..models.pod import Pod
 class Store:
     def __init__(self) -> None:
         self.pods: Dict[str, Pod] = {}
+        # admission-time pending-group index: gid -> {key -> pod} holding
+        # exactly the provisioner's input set (Pending, unbound,
+        # un-nominated). Maintained on every pod state transition so the
+        # solve-time encode never walks O(pods) Python objects — the
+        # delta-encode analogue of the reference caching resolved
+        # instance types by hash (instancetype.go:219-229). All pod
+        # state transitions MUST go through store methods (add/bind/
+        # unbind/nominate/unnominate/delete) or the index goes stale.
+        self._pending_groups: Dict[int, Dict[str, Pod]] = {}
         self.nodepools: Dict[str, NodePool] = {}
         self.nodeclasses: Dict[str, NodeClassSpec] = {}
         self.nodeclaims: Dict[str, NodeClaim] = {}
@@ -50,22 +60,54 @@ class Store:
     # --- pods ---
     def add_pod(self, pod: Pod) -> Pod:
         key = f"{pod.namespace}/{pod.name}"
+        old = self.pods.get(key)
+        if old is not None and old is not pod:
+            # same-key replacement: evict the old OBJECT from the index
+            # (its gid may differ — a stranded entry would be re-solved
+            # as a ghost pod every reconcile, forever)
+            self._index_discard(old, key)
         self.pods[key] = pod
         # amortize constraint-signature interning to admission time: the
         # solve-time encode then groups 100k pods by one int read per pod
         # instead of re-walking Python constraint objects every reconcile
         pod.group_key()
+        self._index_update(pod, key)
         self._notify("pod", "add", pod)
         return pod
 
+    def _index_update(self, pod: Pod, key: str) -> None:
+        """Insert/remove a pod from the pending-group index according to
+        its CURRENT state — the one reconciliation point every pod state
+        transition funnels through."""
+        if (pod.phase == "Pending" and pod.node_name is None
+                and L.NOMINATED not in pod.annotations):
+            self._pending_groups.setdefault(pod._gid, {})[key] = pod
+        else:
+            self._index_discard(pod, key)
+
+    def _index_discard(self, pod: Pod, key: str) -> None:
+        g = self._pending_groups.get(pod._gid)
+        if g is not None:
+            g.pop(key, None)
+            if not g:
+                del self._pending_groups[pod._gid]
+
     def delete_pod(self, namespace: str, name: str) -> None:
-        pod = self.pods.pop(f"{namespace}/{name}", None)
+        key = f"{namespace}/{name}"
+        pod = self.pods.pop(key, None)
         if pod:
+            self._index_discard(pod, key)
             self._notify("pod", "delete", pod)
 
     def pending_pods(self) -> List[Pod]:
         return [p for p in self.pods.values()
                 if p.phase == "Pending" and p.node_name is None]
+
+    def pending_unnominated_groups(self) -> List[List[Pod]]:
+        """The provisioner's input, pre-grouped by constraint signature
+        (gid) straight from the admission-time index — no per-pod pass.
+        Returns fresh lists; callers may consume/mutate them freely."""
+        return [list(g.values()) for g in self._pending_groups.values() if g]
 
     def pods_on_node(self, node_name: str) -> List[Pod]:
         return [p for p in self.pods.values() if p.node_name == node_name]
@@ -73,7 +115,24 @@ class Store:
     def bind_pod(self, pod: Pod, node_name: str) -> None:
         pod.node_name = node_name
         pod.phase = "Running"
+        self._index_update(pod, f"{pod.namespace}/{pod.name}")
         self._notify("pod", "bind", pod)
+
+    def unbind_pod(self, pod: Pod) -> None:
+        """Eviction: the pod returns to the pending pool (and the
+        pending-group index, unless still nominated elsewhere)."""
+        pod.node_name = None
+        pod.phase = "Pending"
+        self._index_update(pod, f"{pod.namespace}/{pod.name}")
+        self._notify("pod", "unbind", pod)
+
+    def nominate_pod(self, pod: Pod, claim_name: str) -> None:
+        pod.annotations[L.NOMINATED] = claim_name
+        self._index_update(pod, f"{pod.namespace}/{pod.name}")
+
+    def unnominate_pod(self, pod: Pod) -> None:
+        pod.annotations.pop(L.NOMINATED, None)
+        self._index_update(pod, f"{pod.namespace}/{pod.name}")
 
     # --- nodepools / nodeclasses (validated at admission, like the
     # reference's CEL rules on the CRDs) ---

@@ -1,0 +1,76 @@
+"""Pending-group index: the store's admission-time grouping that lets
+encode skip its per-pod pass (the delta-encode analogue of the reference
+caching resolved instance types by hash, instancetype.go:219-229).
+
+The index must mirror {pending, unbound, un-nominated} exactly through
+every pod state transition — a stale entry is a ghost pod the
+provisioner re-solves forever; a missing entry is a pod that never
+schedules.
+"""
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.state.store import Store
+
+
+def mk(name, cpu="500m"):
+    return Pod(name=name, requests=Resources.parse({"cpu": cpu,
+                                                    "memory": "1Gi"}))
+
+
+def indexed_keys(store):
+    return {k for g in store._pending_groups.values() for k in g}
+
+
+def truth_keys(store):
+    return {k for k, p in store.pods.items()
+            if p.phase == "Pending" and p.node_name is None
+            and L.NOMINATED not in p.annotations}
+
+
+class TestPendingGroupIndex:
+    def test_transitions_keep_index_exact(self):
+        s = Store()
+        pods = [s.add_pod(mk(f"p{i}")) for i in range(6)]
+        assert indexed_keys(s) == truth_keys(s)
+        s.nominate_pod(pods[0], "claim-a")
+        s.bind_pod(pods[1], "node-1")
+        s.delete_pod("default", pods[2].name)
+        assert indexed_keys(s) == truth_keys(s)
+        s.unnominate_pod(pods[0])
+        s.unbind_pod(pods[1])
+        assert indexed_keys(s) == truth_keys(s)
+        assert sum(len(g) for g in s.pending_unnominated_groups()) == 5
+
+    def test_same_key_replacement_evicts_old_object(self):
+        """Review finding: re-adding a pod under the same key with a
+        DIFFERENT signature must not strand the old object in the index
+        — a stranded entry is an unremovable ghost the provisioner would
+        launch capacity for every reconcile."""
+        s = Store()
+        s.add_pod(mk("a", cpu="1"))
+        s.add_pod(mk("a", cpu="2"))  # same key, different gid
+        assert indexed_keys(s) == {"default/a"}
+        groups = s.pending_unnominated_groups()
+        assert sum(len(g) for g in groups) == 1
+        assert groups[0][0].requests.get("cpu") == 2.0
+        s.delete_pod("default", "a")
+        assert not s._pending_groups
+
+    def test_groups_bucket_by_signature(self):
+        s = Store()
+        for i in range(10):
+            s.add_pod(mk(f"s{i}", cpu="250m"))
+        for i in range(4):
+            s.add_pod(mk(f"b{i}", cpu="2"))
+        sizes = sorted(len(g) for g in s.pending_unnominated_groups())
+        assert sizes == [4, 10]
+
+    def test_nominate_then_claim_failure_returns_pod(self):
+        s = Store()
+        p = s.add_pod(mk("x"))
+        s.nominate_pod(p, "claim-dead")
+        assert not indexed_keys(s)
+        s.unnominate_pod(p)
+        assert indexed_keys(s) == {"default/x"}
