@@ -276,7 +276,8 @@ class DisruptionController:
             return candidates
         enc = encode_pods(all_pods, cat,
                           extra_requirements=pool.requirements,
-                          taints=pool.taints + pool.startup_taints)
+                          taints=pool.taints + pool.startup_taints,
+                          template_labels=pool.template_labels())
         if enc.G == 0:
             return candidates
         sig_to_g = {g.representative.constraint_signature(): i
@@ -387,10 +388,28 @@ class DisruptionController:
 
     # --- budgets ---
     def _budget(self, pool: NodePool, views: List[NodeView], reason: str) -> int:
-        total = len(views)
-        allowed = pool.disruption.allowed_disruptions(reason, total)
-        disrupting = sum(1 for v in views if v.claim.is_deleting())
-        disrupting += sum(len(pd.victim_claims) for pd in self._pending)
+        # in-flight drains MUST count against the budget, and views can't
+        # show them — build_node_views excludes deleting claims — so read
+        # the store (found by the combined-disruption budget sentinel:
+        # every reconcile re-filled the budget, so a rolling drift took
+        # 3x the budget down at once; the reference counts deleting nodes
+        # from cluster state the same way)
+        disrupting = sum(1 for c in self.store.nodeclaims.values()
+                         if c.nodepool == pool.name and c.is_deleting())
+        # percent budgets use the pool's FULL size (live + deleting) as
+        # the denominator, like the reference — len(views) alone would
+        # shrink the allowance as a roll proceeds, throttling it below
+        # the configured rate
+        allowed = pool.disruption.allowed_disruptions(
+            reason, len(views) + disrupting)
+        # pending decisions whose victims haven't started draining yet,
+        # this pool's only — another pool's roll must not starve ours
+        for pd in self._pending:
+            for v in pd.victim_claims:
+                c = self.store.nodeclaims.get(v)
+                if (c is not None and c.nodepool == pool.name
+                        and not c.is_deleting()):
+                    disrupting += 1
         return max(0, allowed - disrupting)
 
     def _is_pending_victim(self, name: str) -> bool:

@@ -147,6 +147,17 @@ class NodePool:
         self.requirements.add(req)
         return self
 
+    def template_labels(self) -> Dict[str, str]:
+        """Node labels every launched node of this pool wears: spec
+        labels + single-valued requirements + the pool identity label.
+        The ONE definition shared by the launch path (actual node
+        labels) and the encoders (pod-selector resolution for keys the
+        catalog doesn't carry) — diverging the two would schedule pods
+        onto nodes that never match their selectors."""
+        from . import labels as L
+        return {**self.labels, **self.requirements.single_values(),
+                L.NODEPOOL: self.name}
+
     def within_limits(self, current_usage: Resources, adding: Resources) -> bool:
         if not self.limits:
             return True

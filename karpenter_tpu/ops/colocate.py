@@ -100,6 +100,7 @@ def plan_colocation(pods: Sequence[Pod], cat: CatalogTensors,
                     existing: Optional[List[VirtualNode]] = None,
                     existing_pods: Optional[Dict[str, List[Pod]]] = None,
                     type_cap: Optional[np.ndarray] = None,
+                    template_labels: Optional[Dict[str, str]] = None,
                     ) -> ColocationPlan:
     """Place every pod carrying a required positive hostname-affinity term;
     everything else (including consumed-target leftovers) goes back out via
@@ -149,7 +150,7 @@ def plan_colocation(pods: Sequence[Pod], cat: CatalogTensors,
             r = rep.scheduling_requirements()
             if extra_requirements is not None:
                 r = r.union_with(extra_requirements)
-            comp = compat_mask(r, cat)
+            comp = compat_mask(r, cat, template_labels)
             if type_cap is not None:
                 comp = comp & type_cap
             if exotic.any() and not wants_exotic(rep, r):

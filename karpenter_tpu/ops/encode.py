@@ -346,8 +346,17 @@ def _allowed_vector(vs: ValueSet, vocab: Dict[str, int]) -> np.ndarray:
     return out
 
 
-def _key_mask(vs: ValueSet, key: str, cat: CatalogTensors) -> np.ndarray:
-    """bool [T]: which instance types satisfy one requirement key."""
+def _key_mask(vs: ValueSet, key: str, cat: CatalogTensors,
+              template: Optional[Dict[str, str]] = None) -> np.ndarray:
+    """bool [T]: which instance types satisfy one requirement key.
+
+    template: NodePool-template node labels (spec labels + single-valued
+    requirements). A key NO instance type carries resolves against the
+    template — every launched node wears those labels, so a pod
+    nodeSelector on one must schedule (the reference satisfies pod
+    requirements from the NodeClaimTemplate the same way,
+    scheduling.md:17-31). Catalog-known keys ignore the template: node
+    labels never override instance properties."""
     T = cat.T
     absent_ok = _tolerates_absence(vs)
     has_bounds = vs.gt is not None or vs.lt is not None
@@ -364,6 +373,8 @@ def _key_mask(vs: ValueSet, key: str, cat: CatalogTensors) -> np.ndarray:
         absent = np.isnan(col)
         return np.where(absent, absent_ok, mask)
     if key not in cat.vocab or not cat.vocab[key]:
+        if template is not None and key in template:
+            return np.full(T, vs.contains(template[key]), bool)
         # key no instance type carries: satisfied only if absence tolerated
         return np.full(T, absent_ok, bool)
     return _categorical_mask(vs, key, cat)
@@ -379,14 +390,16 @@ def _categorical_mask(vs: ValueSet, key: str, cat: CatalogTensors,
     return mask
 
 
-def compat_mask(reqs: Requirements, cat: CatalogTensors) -> np.ndarray:
+def compat_mask(reqs: Requirements, cat: CatalogTensors,
+                template: Optional[Dict[str, str]] = None) -> np.ndarray:
     """bool [T]: types compatible with a Requirements conjunction
-    (zone/capacity-type keys excluded — they map to the offering axes)."""
+    (zone/capacity-type keys excluded — they map to the offering axes;
+    template = NodePool-template node labels, see _key_mask)."""
     mask = np.ones(cat.T, bool)
     for key in reqs.keys():
         if key in L.OFFERING_LABELS:
             continue
-        mask &= _key_mask(reqs.get(key), key, cat)
+        mask &= _key_mask(reqs.get(key), key, cat, template)
     return mask
 
 
@@ -401,6 +414,7 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
                 extra_requirements: Optional[Requirements] = None,
                 taints: Optional[List[Taint]] = None,
                 pregrouped: Optional[Sequence[Sequence[Pod]]] = None,
+                template_labels: Optional[Dict[str, str]] = None,
                 ) -> EncodedPods:
     """Group + tensorize pods against a catalog.
 
@@ -453,7 +467,7 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
         reqs = g.representative.scheduling_requirements()
         if extra_requirements is not None:
             reqs = reqs.union_with(extra_requirements)
-        compat[i] = compat_mask(reqs, cat)
+        compat[i] = compat_mask(reqs, cat, template_labels)
         if exotic.any() and not wants_exotic(g.representative, reqs):
             compat[i] &= ~exotic
         allow_zone[i] = _axis_allow(reqs, L.ZONE, cat.zones)
@@ -463,7 +477,7 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
         hard_c[i] = allow_cap[i]
         narrowed = _apply_preferred(g.representative, compat[i],
                                     allow_zone[i], allow_cap[i],
-                                    requests[i], cat)
+                                    requests[i], cat, template_labels)
         if narrowed is not None:
             compat[i], allow_zone[i], allow_cap[i] = narrowed
         if g.representative.has_self_anti_affinity():
@@ -499,6 +513,7 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
 def _apply_preferred(rep: Pod, compat_row: np.ndarray, zone_row: np.ndarray,
                      cap_row: np.ndarray, req: np.ndarray,
                      cat: CatalogTensors,
+                     template: Optional[Dict[str, str]] = None,
                      ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Narrow a group's (type, zone, captype) masks to its preferred
     node-affinity terms, greedily in descending weight, keeping each
@@ -532,7 +547,7 @@ def _apply_preferred(rep: Pod, compat_row: np.ndarray, zone_row: np.ndarray,
         elif term["key"] == L.CAPACITY_TYPE:
             cand_c = cur_c & _axis_allow(r, L.CAPACITY_TYPE, cat.captypes)
         else:
-            cand_t = cur_t & compat_mask(r, cat)
+            cand_t = cur_t & compat_mask(r, cat, template)
         feasible = (cat.available & (cand_t & fits)[:, None, None]
                     & cand_z[None, :, None] & cand_c[None, None, :]).any()
         if feasible:

@@ -224,6 +224,10 @@ class Solver:
             blocks_gated = True
         all_pods = pods  # reference, captured before the colocation path
         # rebinds the local; only read if the reserved retry fires
+        # NodePool-template node labels — pod selectors on keys the
+        # catalog doesn't carry resolve against these (every launched
+        # node wears them; NodePool.template_labels is the one source)
+        template = nodepool.template_labels()
         fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())
@@ -258,7 +262,7 @@ class Solver:
                 pods, cat, extra_requirements=nodepool.requirements,
                 taints=nodepool.taints + nodepool.startup_taints,
                 existing=existing, existing_pods=existing_pods,
-                type_cap=fits_cap)
+                type_cap=fits_cap, template_labels=template)
             for name, placed in plan.existing_placements.items():
                 # planner placements count as residents for the main solve's
                 # per-node caps and occupancy
@@ -280,7 +284,8 @@ class Solver:
         enc = encode_pods(pods, cat,
                           extra_requirements=nodepool.requirements,
                           taints=nodepool.taints + nodepool.startup_taints,
-                          pregrouped=pregrouped)
+                          pregrouped=pregrouped,
+                          template_labels=template)
         if fits_cap is not None:
             enc.compat &= fits_cap[None, :]
             if enc.compat_hard is not None:
@@ -795,9 +800,7 @@ class Solver:
 
     def _node_labels(self, cat: CatalogTensors, node: VirtualNode,
                      nodepool: NodePool) -> Dict[str, str]:
-        labels = dict(nodepool.labels)
-        labels.update(nodepool.requirements.single_values())
-        labels[L.NODEPOOL] = nodepool.name
+        labels = nodepool.template_labels()
         labels[L.INSTANCE_TYPE] = cat.names[node.type_idx]
         return labels
 

@@ -166,6 +166,147 @@ class TestScaleSuite:
         assert cost_after < cost_before
         assert all_bound(sim)
 
+    def test_combined_disruption_multi_pool(self):
+        """Consolidation + emptiness + expiration + drift active
+        SIMULTANEOUSLY across four NodePools with chaos kills running
+        (reference deprovisioning_test.go:128-140 'Multiple
+        Deprovisioners'). Pods route to their pool via nodeSelector on a
+        pool-template label + matching toleration, exactly like the
+        reference's deprovisioningTypeKey. Asserts: every mechanism
+        fired, the cluster converges, per-pool budgets are never
+        exceeded in-flight, and no claim leaks."""
+        from karpenter_tpu.models.nodeclaim import Phase
+        from karpenter_tpu.models.nodepool import (Budget, DisruptionSpec,
+                                                   NodeClassSpec, NodePool)
+        from karpenter_tpu.models.pod import Taint, Toleration
+
+        KEY = "disruption-type"
+        METHODS = ("consolidation", "emptiness", "expiration", "drift")
+        N_PER = 50   # anchor nodes per pool -> 200 nodes total
+        BUDGET = 12  # absolute per-pool budget
+        VOLUNTARY = {"Empty", "Drifted", "Expired", "Underutilized"}
+
+        def pool_for(v):
+            p = NodePool(
+                name=v, labels={KEY: v},
+                taints=[Taint(key=KEY, value=v, effect="NoSchedule")],
+                node_class="drift-nc" if v == "drift" else "default")
+            p.disruption = DisruptionSpec(
+                consolidation_policy=("WhenEmpty" if v == "emptiness"
+                                      else "WhenEmptyOrUnderutilized"),
+                budgets=[Budget(nodes=str(BUDGET))])
+            if v == "expiration":
+                p.expire_after = 1800.0
+            return p
+
+        sim = make_sim(nodepool=pool_for(METHODS[0]))
+        for v in METHODS[1:]:
+            sim.store.add_nodepool(pool_for(v))
+        sim.store.add_nodeclass(NodeClassSpec(name="drift-nc"))
+
+        def mk(v, name, cpu="500m", anti=True, extra_labels=None):
+            labels = {KEY: v, **(extra_labels or {})}
+            kw = dict(
+                name=name, labels=labels,
+                requests=Resources.parse({"cpu": cpu, "memory": "1Gi"}),
+                node_selector={KEY: v},
+                tolerations=[Toleration(key=KEY, value=v,
+                                        effect="NoSchedule")])
+            if anti:
+                kw["affinity_terms"] = [PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector={KEY: v, "role": "anchor"}, anti=True)]
+            return Pod(**kw)
+
+        anchors = {v: [sim.store.add_pod(
+            mk(v, f"{v}-a{i}", extra_labels={"role": "anchor"}))
+            for i in range(N_PER)] for v in METHODS}
+        fillers = [sim.store.add_pod(
+            mk("consolidation", f"fill-{i}", cpu="200m", anti=False))
+            for i in range(N_PER)]
+
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=3600)
+        claims_of = lambda v: [c for c in sim.store.nodeclaims.values()
+                               if c.nodepool == v]
+        build_counts = {v: len(claims_of(v)) for v in METHODS}
+        assert sum(build_counts.values()) >= 200
+        t0 = sim.clock.now()
+
+        # budget sentinel: voluntary victims in-flight per pool may never
+        # exceed the pool's absolute budget
+        voluntary: set = set()
+        orig_del = sim.termination.delete_nodeclaim
+
+        def spy_delete(claim, now, reason=""):
+            if reason in VOLUNTARY:
+                voluntary.add(claim.name)
+            return orig_del(claim, now, reason)
+        sim.termination.delete_nodeclaim = spy_delete
+        violations = []
+
+        def budget_hook(now):
+            for v in METHODS:
+                n = sum(1 for c in claims_of(v)
+                        if c.is_deleting() and c.name in voluntary)
+                if n > BUDGET:
+                    violations.append((now, v, n))
+        sim.engine.add_hook(budget_hook)
+
+        # fire all four mechanisms at once + chaos
+        for p in anchors["emptiness"]:
+            sim.store.delete_pod(p.namespace, p.name)       # -> Empty
+        for p in anchors["consolidation"]:
+            sim.store.delete_pod(p.namespace, p.name)       # -> packing
+        sim.store.nodeclasses["drift-nc"].user_data = "#!/bin/bash\nv2"
+        sim.start_chaos(interval=300, seed=7)               # kills anywhere
+        with RECORDER.measure("combined-disruption", sim_clock=sim.clock,
+                              nodes=sum(build_counts.values())):
+            sim.engine.run_for(2600, step=10)
+
+        assert not violations, f"budget exceeded: {violations[:5]}"
+        # every mechanism actually fired
+        s = sim.disruption.stats
+        assert s["empty"] >= N_PER // 2
+        assert s["drift"] >= 1 and s["expired"] >= 1
+        assert s["consolidated"] + s["multi_consolidated"] >= 1
+        from karpenter_tpu.metrics import DISRUPTION_DECISIONS
+        assert (DISRUPTION_DECISIONS.value(reason="Drifted",
+                                           consolidation_type="single")
+                + DISRUPTION_DECISIONS.value(reason="Expired",
+                                             consolidation_type="single")
+                ) >= 2
+        # emptiness pool fully reaped; drift pool rolled to the new hash;
+        # expiration pool rolled past the build-out generation
+        alive = [c for c in sim.store.nodeclaims.values()
+                 if not c.is_deleting()]
+        assert not [c for c in alive if c.nodepool == "emptiness"]
+        new_hash = sim.store.nodeclasses["drift-nc"].hash()
+        for c in alive:
+            if c.nodepool == "drift":
+                assert c.annotations["karpenter.tpu/nodeclass-hash"] == new_hash
+        for c in alive:
+            if c.nodepool == "expiration":
+                assert c.created_at > t0
+        # consolidation pool packed the fillers onto fewer nodes
+        assert len([c for c in alive if c.nodepool == "consolidation"]) \
+            < build_counts["consolidation"]
+        # convergence: every surviving pod bound, no claim leak (every
+        # live claim has a live instance; no failed/terminated residue)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=1200)
+        iids = {i.id for i in sim.cloud.instances.values()
+                if i.state == "running"}
+        for c in sim.store.nodeclaims.values():
+            assert c.phase not in (Phase.FAILED, Phase.TERMINATED)
+            if not c.is_deleting():
+                assert c.provider_id.rsplit("/", 1)[-1] in iids
+        # and the cloud holds no orphans the store forgot
+        sim.engine.run_for(120, step=5)  # let GC finish any sweep
+        claimed = {c.provider_id.rsplit("/", 1)[-1]
+                   for c in sim.store.nodeclaims.values() if c.provider_id}
+        leaked = [i.id for i in sim.cloud.instances.values()
+                  if i.state == "running" and i.id not in claimed]
+        assert not leaked, f"leaked instances: {leaked[:5]}"
+
     def test_interruption_throughput_1k(self):
         """1k queued interruption messages drain the right claims
         (reference interruption_benchmark_test.go shape)."""
