@@ -54,19 +54,25 @@ def main() -> None:
             for i in range(100_000)]
     enc = encode_pods(pods, cat)
 
-    baseline_nodes = None
+    # host oracle once: every device count must match it NODE-FOR-NODE
+    # (count equality alone can't see a wrong pad row or a shard-boundary
+    # off-by-one that trades one placement for another)
+    from karpenter_tpu.ops.binpack import solve_host
+    h = solve_host(cat, enc)
     for nd in (1, 2, 4, 8):
         mesh = make_mesh(nd)
         r = solve_device(cat, enc, mesh=mesh)  # compile
         t0 = time.perf_counter()
         r = solve_device(cat, enc, mesh=mesh)
         detail[f"solve_100k_{nd}dev_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
-        if baseline_nodes is None:
-            baseline_nodes = len(r.nodes)
-        assert len(r.nodes) == baseline_nodes, (
-            f"{nd}-device solve diverged: {len(r.nodes)} vs {baseline_nodes}")
+        assert len(r.nodes) == len(h.nodes), (
+            f"{nd}-device solve diverged: {len(r.nodes)} vs {len(h.nodes)}")
+        for a, b in zip(r.nodes, h.nodes):
+            assert (a.type_idx == b.type_idx
+                    and a.pods_by_group == b.pods_by_group), (
+                f"{nd}-device solve diverged from host node-for-node")
         assert not r.unschedulable
-    detail["solve_nodes"] = baseline_nodes
+    detail["solve_nodes"] = len(h.nodes)
 
     # 5k-node consolidation screen, sharded node axis
     N = 5000

@@ -47,12 +47,16 @@ class SimEnvironment:
     def start_chaos(self, interval: float = 60.0, seed: int = 0) -> None:
         """kwok kill-node-thread analog (kwok/ec2/ec2.go:253-282): kill a
         random running instance every `interval` sim-seconds; the state-
-        change interruption event + GC/liveness recover the cluster."""
+        change interruption event + GC/liveness recover the cluster.
+        stop_chaos() disarms it (tests quiesce before final invariants)."""
         import random
         rng = random.Random(seed)
         state = {"last": self.clock.now()}
+        self._chaos_on = True
 
         def hook(now: float) -> None:
+            if not getattr(self, "_chaos_on", False):
+                return
             if now - state["last"] >= interval:
                 state["last"] = now
                 running = [i for i in self.cloud.instances.values()
@@ -61,6 +65,9 @@ class SimEnvironment:
                     self.cloud.kill_instance(rng.choice(running).id,
                                              reason="chaos")
         self.engine.add_hook(hook)
+
+    def stop_chaos(self) -> None:
+        self._chaos_on = False
 
 
 def make_sim(types: Optional[List[InstanceType]] = None,

@@ -4,6 +4,7 @@ compile, run, and agree with the single-device kernel."""
 import numpy as np
 
 import jax
+import pytest
 
 from karpenter_tpu.catalog import small_catalog
 from karpenter_tpu.models.pod import Pod
@@ -113,6 +114,63 @@ def test_mesh_screen_parity():
     s2, sl2 = consolidation_screen(cat, enc, views, counts, mesh=mesh)
     assert (s1 == s2).all()
     np.testing.assert_allclose(sl1, sl2, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_mesh_parity_bench_shape():
+    """Result identity host-vs-mesh at a BENCH-LIKE shape — ~5k nodes x
+    128 groups x the full 800-type catalog — where padding/sharding edge
+    cases actually live (the small-shape tests above can't see a wrong
+    pad row or a shard-boundary off-by-one at N=37). The node-for-node
+    solve parity lives in __graft_entry__.bench_shape_parity (shared
+    with the driver's dryrun so the two stay one construction); the
+    sharded consolidation screen is checked here at [5k, 128] on top."""
+    import sys
+    sys.path.insert(0, "/root/repo")
+    from __graft_entry__ import bench_shape_parity
+    from karpenter_tpu.catalog import generate_catalog
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    mesh = make_mesh(8)
+    n_nodes, G = bench_shape_parity(mesh, n_groups=128, pods_per_group=40,
+                                    min_nodes=5000)
+    assert G == 128
+
+    # the sharded consolidation screen at the same magnitude: [5k, 128]
+    from karpenter_tpu.catalog import generate_catalog
+    from karpenter_tpu.models.nodeclaim import NodeClaim
+    from karpenter_tpu.ops.binpack import VirtualNode, solve_host
+    from karpenter_tpu.ops.consolidate import consolidation_screen
+    from karpenter_tpu.state.cluster import NodeView
+    cat = encode_catalog(generate_catalog())
+    pods = []
+    for k in range(128):
+        for i in range(40):
+            pods.append(Pod(
+                name=f"g{k}-{i}", labels={"app": f"g{k}"},
+                requests=Resources.parse({"cpu": ["6", "7"][k % 2],
+                                          "memory": "6Gi"}),
+                affinity_terms=[PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector={"app": f"g{k}"}, anti=True)]))
+    enc = encode_pods(pods, cat)
+    h = solve_host(cat, enc)
+    assert len(h.nodes) >= 5000
+    views, counts = [], np.zeros((len(h.nodes), enc.G), np.int32)
+    for i, n in enumerate(h.nodes):
+        views.append(NodeView(
+            claim=NodeClaim(name=f"n{i}", nodepool="p"), node=None, pods=[],
+            virtual=VirtualNode(type_idx=n.type_idx,
+                                zone_mask=np.asarray(n.zone_mask, bool),
+                                cap_mask=np.asarray(n.cap_mask, bool),
+                                cum=np.asarray(n.cum, np.float32)),
+            price=0.1))
+        for g, c in n.pods_by_group.items():
+            counts[i, g] = c
+    s1, sl1 = consolidation_screen(cat, enc, views, counts)
+    s2, sl2 = consolidation_screen(cat, enc, views, counts, mesh=mesh)
+    assert (s1 == s2).all()
+    np.testing.assert_allclose(sl1, sl2, rtol=1e-5)
 
 
 def test_graft_entry_contract():
