@@ -45,6 +45,15 @@ class LifecycleController:
                     self.store.record_event("nodeclaim", claim.name,
                                             "RegistrationTimeout", "reaping")
                     self._reap(claim)
+            elif (claim.phase == Phase.PENDING
+                  and now - claim.created_at > self.registration_ttl):
+                # safety net: a claim whose CreateFleet never succeeded
+                # (crash between claim creation and launch) must not
+                # live forever — the provisioner rolls these back on the
+                # throttle path, this covers anything else
+                self.store.record_event("nodeclaim", claim.name,
+                                        "LaunchTimeout", "reaping")
+                self._reap(claim)
             elif claim.phase == Phase.REGISTERED:
                 node = self.store.node_for_nodeclaim(claim)
                 if node is not None and node.ready:

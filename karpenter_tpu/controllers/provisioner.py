@@ -47,8 +47,10 @@ class Provisioner:
     requeue: float = 1.0
     stats: Dict[str, int] = field(default_factory=lambda: {
         "solves": 0, "launches": 0, "ice_errors": 0, "unschedulable": 0})
+    _throttled: bool = False  # set by a throttled _launch within a pass
 
     def reconcile(self, now: float) -> float:
+        self._throttled = False
         # the store's admission-time index IS the pending-unnominated set,
         # already bucketed by constraint signature — the first pool's
         # encode skips its per-pod grouping pass entirely
@@ -74,7 +76,9 @@ class Provisioner:
         for p in remaining:
             self.store.record_event("pod", f"{p.namespace}/{p.name}",
                                     "FailedScheduling", "no nodepool could schedule")
-        return self.requeue
+        # a throttled CreateFleet left pods pending on purpose: retry at
+        # the retryable backoff, not the normal cadence
+        return max(self.requeue, 2.0) if self._throttled else self.requeue
 
     def _cluster_occupancy(self, now: float):
         """Cluster-wide (zone, pods) per node — every pool's claims plus
@@ -283,7 +287,26 @@ class Provisioner:
                 if (self._floors_hold(pre, floors)
                         and not self._floors_hold(req.overrides, floors)):
                     req.overrides = pre
-        results = self.cloud.create_fleet(requests)
+        try:
+            results = self.cloud.create_fleet(requests)
+        except CloudError as e:
+            if not getattr(e, "retryable", False):
+                raise
+            # throttled/5xx batch: nothing reached the wire — roll back
+            # the claims (a PENDING claim with no instance would live
+            # forever; the liveness reaper only covers LAUNCHED ones) and
+            # leave the pods pending for the NEXT reconcile. They are
+            # deliberately NOT handed to later pools: that would re-solve
+            # and re-hammer the throttled cloud once per pool and record
+            # bogus FailedScheduling events for pods that are merely
+            # throttled. The reconcile requeues at the retryable backoff.
+            for claim, _launch in claims:
+                self.store.delete_nodeclaim(claim.name)
+            self.stats["throttled"] = self.stats.get("throttled", 0) + 1
+            self._throttled = True
+            self.store.record_event("provisioner", pool.name,
+                                    "CreateFleetThrottled", str(e))
+            return [], []
 
         launched: List[NodeClaim] = []
         failed_pods: List[Pod] = []

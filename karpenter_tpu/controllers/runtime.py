@@ -13,11 +13,15 @@ per 100k-pod solve.
 from __future__ import annotations
 
 import asyncio
+import logging
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from ..cloud.provider import CloudError
 from ..metrics import REGISTRY
 from ..utils.clock import RealClock
+
+log = logging.getLogger("karpenter_tpu.runtime")
 
 
 @dataclass
@@ -30,6 +34,9 @@ class Runtime:
     # retrying the lease (reference: controller-runtime leader election,
     # 2-replica Helm chart)
     elector: Optional[object] = None
+    # per-controller crash counter (reconcile exceptions survived) — the
+    # observable the soak test asserts stays zero
+    crash_counts: Dict[str, int] = field(default_factory=dict)
     _stop: Optional[asyncio.Event] = None
     _server: object = None
 
@@ -46,8 +53,9 @@ class Runtime:
                 try:
                     self.elector.tick(self.clock.now())
                 except Exception:
-                    import traceback
-                    traceback.print_exc()
+                    self.crash_counts["elector"] = \
+                        self.crash_counts.get("elector", 0) + 1
+                    log.exception("elector tick failed")
                 try:
                     await asyncio.wait_for(self._stop.wait(),
                                            timeout=self.elector.retry_period)
@@ -66,10 +74,20 @@ class Runtime:
                 continue
             try:
                 requeue = c.reconcile(self.clock.now())
-            except Exception as e:  # a crashing controller must not die silently
-                import traceback
-                traceback.print_exc()
-                requeue = 5.0
+            except Exception as e:
+                # same contract as the engine: RETRYABLE cloud errors
+                # (throttles, server errors) model transient conditions —
+                # back off and retry. Anything else is a crash the
+                # runtime survives, counts, and logs.
+                if isinstance(e, CloudError) and getattr(e, "retryable",
+                                                         False):
+                    requeue = 2.0
+                else:
+                    name = getattr(c, "name", type(c).__name__)
+                    self.crash_counts[name] = \
+                        self.crash_counts.get(name, 0) + 1
+                    log.exception("controller %s reconcile crashed", name)
+                    requeue = 5.0
             try:
                 await asyncio.wait_for(self._stop.wait(),
                                        timeout=max(0.01, requeue))

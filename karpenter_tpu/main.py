@@ -36,10 +36,15 @@ from .utils.options import Options
 
 def build_operator(options: Optional[Options] = None,
                    cloud: Optional[FakeCloud] = None,
-                   store: Optional[Store] = None):
-    """Construct the full controller set; returns (runtime, store, cloud)."""
+                   store: Optional[Store] = None,
+                   clock=None):
+    """Construct the full controller set; returns (runtime, store, cloud).
+
+    clock: defaults to the passed cloud's clock (mixed clocks would
+    desynchronize the batcher windows and TTL caches from the cloud's
+    boot delays), else wall clock."""
     opts = options or Options.parse()
-    clock = RealClock()
+    clock = clock or (cloud.clock if cloud is not None else RealClock())
     store = store or Store()
     cloud = cloud or FakeCloud(generate_catalog(
         GeneratorConfig(region=opts.region)), clock=clock)
