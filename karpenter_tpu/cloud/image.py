@@ -138,8 +138,36 @@ class MinimalFamily:
         return "\n".join(out)
 
 
+class ImperativeFamily:
+    """Imperative script-block bootstrap — the Windows analog (reference
+    amifamily/windows.go:40): a different script dialect, custom
+    userdata PREPENDED inside the same script block (Windows appends
+    into the <powershell> section rather than MIME-merging), and
+    amd64-only images. Proves the strategy registry extends past the
+    three stock shapes."""
+
+    name = "imperative"
+
+    def user_data(self, cfg: BootstrapConfig) -> str:
+        taints = ",".join(f"{t.key}={t.value}:{t.effect}" for t in cfg.taints)
+        labels = ",".join(f"{k}={v}" for k, v in sorted(cfg.labels.items()))
+        # ONE command: every flag must reach the same Register-Node
+        # invocation (a bare-newline split would orphan the flags)
+        cmd = (f"Register-Node -Cluster '{cfg.cluster_name}'"
+               f" -Endpoint '{cfg.cluster_endpoint}'"
+               f" -NodeLabels '{labels}' -Taints '{taints}'")
+        if cfg.kubelet_max_pods is not None:
+            cmd += f" -MaxPods {cfg.kubelet_max_pods}"
+        script = cmd
+        if cfg.custom_user_data:
+            # same block, user content first (windows.go UserData merge)
+            script = cfg.custom_user_data + "\n" + script
+        return f"<script>\n{script}\n</script>"
+
+
 FAMILIES: Dict[str, ImageFamily] = {
-    f.name: f for f in (StandardFamily(), DeclarativeFamily(), MinimalFamily())
+    f.name: f for f in (StandardFamily(), DeclarativeFamily(),
+                        MinimalFamily(), ImperativeFamily())
 }
 
 
@@ -159,10 +187,38 @@ def merge_mime(parts: Sequence[str]) -> str:
 class ImageProvider:
     """Image discovery: alias ('standard@latest', 'standard@v1.2'),
     explicit ids, or tag selectors; newest-first (reference ami.go:70,
-    types.go:48)."""
+    types.go:48).
 
-    def __init__(self, images: Sequence[Image]):
-        self._images = list(images)
+    Constructed either from a static snapshot (tests) or a live `lister`
+    with a TTL — the stale-alias invalidation analog (reference
+    providers/ssm/invalidation/controller.go:55 drops cached SSM AMI
+    params so an alias repoint takes effect without an operator
+    restart). invalidate() forces the next resolve to re-list; the
+    catalog refresh controller calls it each cycle, so a repoint lands
+    within one refresh period."""
+
+    def __init__(self, images: Optional[Sequence[Image]] = None,
+                 lister=None, clock=None, ttl: float = 300.0):
+        self._static = list(images) if images is not None else []
+        self._lister = lister
+        self._clock = clock
+        self._ttl = ttl
+        self._cached: Optional[List[Image]] = None
+        self._fetched_at = float("-inf")
+
+    @property
+    def _images(self) -> List[Image]:
+        if self._lister is None:
+            return self._static
+        now = self._clock.now() if self._clock is not None else 0.0
+        if self._cached is None or now - self._fetched_at >= self._ttl:
+            self._cached = list(self._lister())
+            self._fetched_at = now
+        return self._cached
+
+    def invalidate(self) -> None:
+        """Drop the cached listing; next resolve re-reads the cloud."""
+        self._fetched_at = float("-inf")
 
     def resolve(self, nc: NodeClassSpec) -> List[Image]:
         sel = nc.image_selector
@@ -203,8 +259,11 @@ class ImageProvider:
 def default_images(clock_now: float = 0.0) -> List[Image]:
     """The fake cloud's image catalog."""
     out = []
-    for fam in ("standard", "declarative", "minimal"):
-        for arch in ("amd64", "arm64"):
+    for fam in ("standard", "declarative", "minimal", "imperative"):
+        # imperative images are amd64-only, like the reference's Windows
+        # AMIs (windows.go)
+        for arch in (("amd64",) if fam == "imperative"
+                     else ("amd64", "arm64")):
             for ver, age in (("v1.30.1", 3000.0), ("v1.31.0", 2000.0),
                              ("v1.32.0", 1000.0)):
                 short = hashlib.sha256(f"{fam}{arch}{ver}".encode()).hexdigest()[:8]

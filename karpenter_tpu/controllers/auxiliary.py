@@ -104,6 +104,10 @@ class CatalogRefreshController:
 
     catalog: CatalogProvider
     store: Optional[Store] = None
+    # optional cloud.image.ImageProvider: invalidated every cycle so an
+    # alias repoint lands within one refresh period (the reference's SSM
+    # cache-invalidation controller, ssm/invalidation/controller.go:55)
+    images: Optional[object] = None
     name: str = "providers.refresh"
     requeue: float = 300.0
     pricing_interval: float = 12 * 3600
@@ -123,6 +127,8 @@ class CatalogRefreshController:
         if now - self._last_pricing >= self.pricing_interval:
             self.catalog.pricing.hydrate(types)
             self._last_pricing = now
+        if self.images is not None:
+            self.images.invalidate()  # alias repoints land next resolve
         return self.requeue
 
 

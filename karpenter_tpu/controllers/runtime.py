@@ -37,6 +37,10 @@ class Runtime:
     # per-controller crash counter (reconcile exceptions survived) — the
     # observable the soak test asserts stays zero
     crash_counts: Dict[str, int] = field(default_factory=dict)
+    # per-controller retryable-throttle counter: a controller leaking a
+    # retryable CloudError every cycle would otherwise spin silently,
+    # indistinguishable from healthy idle
+    backoff_counts: Dict[str, int] = field(default_factory=dict)
     _stop: Optional[asyncio.Event] = None
     _server: object = None
 
@@ -81,6 +85,10 @@ class Runtime:
                 # runtime survives, counts, and logs.
                 if isinstance(e, CloudError) and getattr(e, "retryable",
                                                          False):
+                    name = getattr(c, "name", type(c).__name__)
+                    self.backoff_counts[name] = \
+                        self.backoff_counts.get(name, 0) + 1
+                    log.debug("controller %s backing off on %s", name, e)
                     requeue = 2.0
                 else:
                     name = getattr(c, "name", type(c).__name__)

@@ -80,15 +80,17 @@ def build_operator(options: Optional[Options] = None,
                                         TaggingController)
     from .controllers.nodeclass import NodeClassController
     from .controllers.repair import NodeRepairController
+    images = ImageProvider(lister=cloud.describe_images, clock=clock)
     nodeclass_c = NodeClassController(store=store, cloud=bcloud,
-                                      images=ImageProvider(cloud.describe_images()))
+                                      images=images)
     repair = NodeRepairController(store=store, termination=termination,
                                   enabled=opts.gate("NodeRepair"))
     controllers: List[object] = [provisioner, lifecycle, binding, termination,
                                  disruption, gc, metrics_c, nodeclass_c,
                                  repair, TaggingController(store=store, cloud=bcloud),
                                  DiscoveredCapacityController(store=store, catalog=catalog),
-                                 CatalogRefreshController(catalog=catalog, store=store),
+                                 CatalogRefreshController(catalog=catalog, store=store,
+                                                          images=images),
                                  ReservationExpirationController(
                                      store=store, cloud=bcloud,
                                      catalog=catalog, termination=termination),

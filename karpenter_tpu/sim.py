@@ -114,12 +114,14 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     from .controllers.nodeclass import NodeClassController
     from .controllers.repair import NodeRepairController
     metrics_c = CloudProviderMetricsController(catalog=catalog, store=store)
+    images = ImageProvider(lister=cloud.describe_images, clock=clock)
     nodeclass_c = NodeClassController(store=store, cloud=cloud,
-                                      images=ImageProvider(cloud.describe_images()))
+                                      images=images)
     repair = NodeRepairController(store=store, termination=termination)
     tagging = TaggingController(store=store, cloud=cloud)
     discovered = DiscoveredCapacityController(store=store, catalog=catalog)
-    refresh = CatalogRefreshController(catalog=catalog, store=store)
+    refresh = CatalogRefreshController(catalog=catalog, store=store,
+                                       images=images)
     res_exp = ReservationExpirationController(store=store, cloud=cloud,
                                               catalog=catalog,
                                               termination=termination)

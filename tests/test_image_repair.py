@@ -53,6 +53,23 @@ class TestBootstrap:
         self.cfg.custom_user_data = "#!/bin/sh\necho x"
         assert "echo x" not in FAMILIES["minimal"].user_data(self.cfg)
 
+    def test_imperative_family_script_block(self):
+        """4th family (the Windows analog, amifamily/windows.go:40):
+        its own script dialect, custom userdata merged INSIDE the block
+        (not MIME), amd64-only images."""
+        ud = FAMILIES["imperative"].user_data(self.cfg)
+        assert ud.startswith("<script>") and ud.endswith("</script>")
+        assert "Register-Node" in ud and "-MaxPods 58" in ud
+        assert "t=v:NoSchedule" in ud
+        cfg2 = BootstrapConfig(**{**self.cfg.__dict__,
+                                  "custom_user_data": "Set-Thing -On"})
+        ud2 = FAMILIES["imperative"].user_data(cfg2)
+        assert "multipart" not in ud2  # same block, no MIME
+        assert ud2.index("Set-Thing") < ud2.index("Register-Node")
+        # the fake catalog ships it amd64-only, like Windows AMIs
+        imgs = [i for i in default_images(1000.0) if i.family == "imperative"]
+        assert imgs and all(i.arch == "amd64" for i in imgs)
+
     def test_mime_merge(self):
         self.cfg.custom_user_data = "#!/bin/sh\necho custom-first"
         ud = FAMILIES["standard"].user_data(self.cfg)
@@ -82,6 +99,40 @@ class TestImageProvider:
     def test_default_family(self):
         imgs = self.prov.resolve(NodeClassSpec(image_family="declarative"))
         assert imgs and all(i.family == "declarative" for i in imgs)
+
+
+class TestAliasInvalidation:
+    def test_alias_repoint_lands_within_one_refresh(self):
+        """Stale-alias invalidation (reference
+        ssm/invalidation/controller.go:55): a newer image published
+        cloud-side AFTER operator start must be resolved — and drift the
+        fleet onto it — within one catalog refresh period, no restart."""
+        sim = make_sim()
+        add_pods(sim, 3)
+        settle(sim)
+        nc = sim.store.nodeclasses["default"]
+        old_ids = set(nc.resolved_images)
+        assert old_ids
+        # the cloud publishes a newer standard image (alias repoint)
+        import hashlib
+        for arch in ("amd64", "arm64"):
+            short = hashlib.sha256(f"new{arch}".encode()).hexdigest()[:8]
+            sim.cloud.images.append(Image(
+                id=f"img-{short}", name=f"standard-{arch}-v1.33.0",
+                family="standard", arch=arch,
+                created_at=sim.clock.now() + 1.0,
+                tags={"family": "standard", "arch": arch,
+                      "version": "v1.33.0"}))
+        # one refresh period + a nodeclass reconcile: resolution moves
+        sim.engine.run_for(400, step=10)
+        assert set(nc.resolved_images) != old_ids
+        assert any(i.startswith("img-") and i not in old_ids
+                   for i in nc.resolved_images)
+        # and the image-rotation drift pass rolls nodes onto the new set
+        sim.engine.run_for(600, step=10)
+        for c in sim.store.nodeclaims.values():
+            if not c.is_deleting():
+                assert c.image_id in nc.resolved_images
 
 
 class TestNodeClassStatus:
