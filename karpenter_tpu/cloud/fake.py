@@ -162,8 +162,13 @@ class FakeCloud:
         exhausted = []
         no_ip_zones = set()
         outage_types = set()
-        # lowest-price strategy over the override list
-        for ov in sorted(req.overrides, key=lambda o: o.price):
+        # priority allocation: the list arrives prioritized by the
+        # provisioner (reserved rows first — the reference's explicit
+        # reserved→spot→OD capacity-type preference, instance.go:530-546
+        # — then the committed type's cheapest row, then price order), so
+        # walking in order IS the lowest-price strategy with the
+        # capacity-type preference layered on top
+        for ov in req.overrides:
             key = (ov.instance_type, ov.zone, ov.capacity_type)
             if ov.instance_type not in self.types:
                 continue

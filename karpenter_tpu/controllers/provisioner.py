@@ -254,8 +254,9 @@ class Provisioner:
                 for o in launch.overrides]
             requests.append(LaunchRequest(
                 nodeclaim_name=claim.name,
-                overrides=self._partition_reservation_overrides(overrides,
-                                                                floors),
+                overrides=self._prioritize_capacity_type(
+                    self._partition_reservation_overrides(overrides,
+                                                          floors)),
                 image_id=(node_class.resolved_images[0]
                           if node_class.resolved_images else "img-default"),
                 user_data=self._user_data(pool, node_class, launch),
@@ -402,6 +403,25 @@ class Provisioner:
         return True
 
     @staticmethod
+    def _prioritize_capacity_type(
+            overrides: List[LaunchOverride]) -> List[LaunchOverride]:
+        """Explicit reserved-capacity preference stage (reference
+        getCapacityType, instance.go:530-546, prioritizes reserved
+        before the market types): reserved rows lead the wire list
+        regardless of price — so a reserved offering whose price an
+        overlay distorted still wins over spot/OD. Before this stage the
+        preference was only an artifact of reserved prices rounding to
+        zero. Spot-vs-on-demand stays with the solver's cost argmin (the
+        committed row leads the remainder): unlike the reference's
+        blanket spot-first rule, this framework's contract is
+        cost-optimal placement, and paying 20x for a spot drought to
+        honor a market-type preference would invert that contract. The
+        sort is stable — price order survives within each class — and
+        the cloud's allocation walks the list in order."""
+        return sorted(overrides,
+                      key=lambda o: o.capacity_type != L.CAPACITY_RESERVED)
+
+    @staticmethod
     def _partition_reservation_overrides(
             overrides: List[LaunchOverride],
             floors=()) -> List[LaunchOverride]:
@@ -441,7 +461,9 @@ class Provisioner:
                                       ) -> None:
         """In-flight address accounting across one launch batch (reference
         subnet.go:183-230 UpdateInflightIPs): walk the batch in order,
-        predict each request's zone (its cheapest surviving override) and
+        predict each request's zone (its FIRST surviving override — the
+        cloud allocates in priority order, so after the reserved-first
+        stage this may not be the cheapest row) and
         decrement that zone's free-address budget; once a zone's budget is
         consumed by earlier requests in the SAME batch, later requests drop
         their overrides in that zone so a burst can't exhaust it mid-batch.
@@ -462,7 +484,9 @@ class Provisioner:
             if kept and len(kept) < len(req.overrides):
                 req.overrides = kept
             if req.overrides:
-                pick = min(req.overrides, key=lambda o: o.price)
+                # the cloud walks the list in priority order, so the
+                # first surviving row IS the predicted allocation
+                pick = req.overrides[0]
                 if free.get(pick.zone, math.inf) != math.inf:
                     free[pick.zone] -= 1
 

@@ -689,10 +689,19 @@ class Solver:
         order = self._floor_rows(cat, t_idx, z_idx, c_idx, by_price,
                                  min_values_floors(requirements))
         primary = node.type_idx
-        # ensure the committed type's cheapest offering is first
         rows = [(cat.names[t_idx[j]], cat.zones[z_idx[j]],
                  cat.captypes[c_idx[j]], float(prices[j])) for j in order]
-        rows.sort(key=lambda r: (r[0] != cat.names[primary], r[3]))
+        # ONE row of the committed type — its cheapest — leads (the
+        # solver's pick); every alternate stays in global price order.
+        # The cloud walks the list in order, so leading with ALL of the
+        # committed type's rows would make an ICE fallback pay for a
+        # pricier sibling of the committed type while a cheaper viable
+        # row of another type sits further down.
+        rows.sort(key=lambda r: r[3])
+        for j, r in enumerate(rows):
+            if r[0] == cat.names[primary]:
+                rows.insert(0, rows.pop(j))
+                break
         return rows[:MAX_OVERRIDES]
 
     @staticmethod

@@ -129,6 +129,85 @@ class TestSolveTimeGate:
                    == BLOCK_ID for c in sim.store.nodeclaims.values())
 
 
+class TestCapacityTypePreference:
+    def test_expensive_reserved_still_preferred(self):
+        """Explicit reserved→spot→OD preference (reference
+        getCapacityType, instance.go:530-546): even when a reserved
+        offering's price is DISTORTED above on-demand (overlay), a pool
+        targeting reserved capacity still lands on the reservation —
+        the preference is structural, not a near-zero-price artifact."""
+        sim = block_sim()
+        # repaint the block as a default ODCR priced ABOVE on-demand
+        for t in sim.cloud.types.values():
+            for o in t.offerings:
+                if o.reservation_id == BLOCK_ID:
+                    o.reservation_type = "default"
+                    o.price = 99.0
+        sim.catalog.refresh()
+        pool = NodePool(name="gpu")
+        pool.requirements.add(Requirement(L.ZONE, Operator.IN, (BLOCK_ZONE,)))
+        pool.requirements.add(Requirement(
+            L.CAPACITY_TYPE, Operator.IN,
+            (L.CAPACITY_RESERVED, L.CAPACITY_ON_DEMAND)))
+        sim.store.add_nodepool(pool)
+        sim.store.nodepools.pop("default", None)
+        pods = gpu_pods(sim, 2)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        reserved = [c for c in sim.store.nodeclaims.values()
+                    if c.capacity_type == L.CAPACITY_RESERVED]
+        assert reserved, "distorted-price reservation was not preferred"
+
+    def test_prioritize_stage_leads_with_reserved(self):
+        from karpenter_tpu.controllers.provisioner import Provisioner
+        rows = [LaunchOverride("a", "z", "on-demand", 0.5),
+                LaunchOverride("b", "z", "spot", 0.2),
+                LaunchOverride("c", "z", "reserved", 42.0,
+                               reservation_id="cr-1"),
+                LaunchOverride("d", "z", "spot", 0.1),
+                LaunchOverride("e", "z", "on-demand", 0.3)]
+        out = Provisioner._prioritize_capacity_type(rows)
+        # reserved first even at a distorted price; the rest keep their
+        # solver-chosen (committed-first, then price) order — spot vs OD
+        # stays a cost decision, not a market preference
+        assert [o.instance_type for o in out] == ["c", "a", "b", "d", "e"]
+
+    def test_ice_fallback_takes_global_cheapest(self):
+        """Review finding: with in-order allocation, the wire list must
+        hold global price order after the leading committed row — an
+        exhausted committed pick falls back to the cheapest viable row
+        of ANY type, never a pricier sibling of the committed type."""
+        from karpenter_tpu.cloud.provider import LaunchRequest
+        sim = block_sim()
+        sim.cloud.capacity_pools[("m5.large", "zone-a", "spot")] = 0
+        req = LaunchRequest(
+            nodeclaim_name="x",
+            overrides=[  # facade contract: committed row, then price order
+                LaunchOverride("m5.large", "zone-a", "spot", 0.5),
+                LaunchOverride("c5.large", "zone-a", "spot", 0.1),
+                LaunchOverride("m5.large", "zone-a", "on-demand", 2.0)])
+        (inst,) = sim.cloud.create_fleet([req])
+        assert inst.instance_type == "c5.large" and inst.price == 0.1
+
+    def test_launch_overrides_price_ordered_after_primary(self):
+        """The facade's wire list: one committed row first, then global
+        price order (the cloud walks in order)."""
+        sim = block_sim()
+        seen = []
+        orig = sim.cloud.create_fleet
+        sim.cloud.create_fleet = lambda r: (seen.extend(r), orig(r))[1]
+        pods = [Pod(name=f"o-{i}",
+                    requests=Resources.parse({"cpu": "1", "memory": "2Gi"}))
+                for i in range(2)]
+        for p in pods:
+            sim.store.add_pod(p)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=60)
+        for req in seen:
+            tail = [o.price for o in req.overrides[1:]]
+            assert tail == sorted(tail)
+
+
 class TestBlockLifecycle:
     def test_gpu_pods_land_on_block_and_drain_before_end(self):
         """A pool explicitly targeting reserved capacity lands on the
