@@ -105,11 +105,21 @@ class DisruptionController:
         if not views:
             return
         budget_for = lambda reason: self._budget(pool, views, reason)
+        # PDB gate for voluntary disruption (reference: candidates with
+        # blocking PDBs are excluded from the disruption passes).
+        # disruptionsAllowed computed once per pool pass — O(pods) per
+        # PDB, not per candidate — then DECREMENTED as this pass commits
+        # victims (in _replace): otherwise one pass could disrupt N
+        # nodes against a budget of 1 and the drains would collide
+        self._pdb_allowed = {key: self.store.pdb_disruptions_allowed(pdb)
+                             for key, pdb in self.store.pdbs.items()}
 
         # 1. drift (nodeclass hash mismatch) + expiration
         for v in views:
             if budget_for("Drifted") <= 0:
                 break
+            if self._pdb_blocked(v):
+                continue
             if self._is_drifted(v, node_class):
                 self._replace(pool, [v], "Drifted", now, cat, views)
             elif (pool.expire_after is not None
@@ -136,6 +146,7 @@ class DisruptionController:
             and v.pods
             and not v.claim.is_deleting()
             and not self._is_pending_victim(v.name)
+            and not self._pdb_blocked(v)
             and now - v.claim.initialized_at >= settle]
         candidates.sort(key=lambda v: v.disruption_cost())
         if not candidates:
@@ -233,7 +244,8 @@ class DisruptionController:
         out = self.solver.solve(
             pods, pool, node_class,
             existing=[v.virtual for v in others],
-            existing_pods={v.name: v.pods for v in others})
+            existing_pods={v.name: v.pods for v in others},
+            daemonsets=list(self.store.daemonsets.values()))
         if out.unschedulable:
             return out, False
         if max_new_price is not None:
@@ -251,6 +263,8 @@ class DisruptionController:
         for v in ordered:
             if done >= budget or sims >= max_sims:
                 break
+            if self._pdb_blocked(v):  # earlier commits consumed budget
+                continue
             sims += 1
             out, ok = self._simulate_removal(pool, [v], cat, views, v.price)
             if not ok:
@@ -258,6 +272,7 @@ class DisruptionController:
             if out.launches and not self._spot_floor_ok(v, out, cat):
                 continue
             self._execute(pool, [v], out, "Underutilized", now)
+            self._pdb_commit([v])
             self.stats["consolidated"] += 1
             done += 1
 
@@ -326,7 +341,10 @@ class DisruptionController:
         if best is None:
             return False
         victims, out = best
+        if self._pdb_blocked_set(victims):
+            return False  # collectively over the remaining allowance
         self._execute(pool, victims, out, "Underutilized", now)
+        self._pdb_commit(victims)
         self.stats["multi_consolidated"] += 1
         return True
 
@@ -348,15 +366,50 @@ class DisruptionController:
         return True
 
     # --- execution: pre-spin replacement, then drain victims ---
+    # --- PDB gate state for the current pool pass ---
+    def _pdb_blocked(self, v: NodeView) -> bool:
+        return self._pdb_blocked_set([v])
+
+    def _pdb_blocked_set(self, victims: List[NodeView]) -> bool:
+        """Would disrupting these victims TOGETHER exceed any PDB's
+        remaining allowance this pass? Collective, not per-node: with
+        allowed=1, two one-pod nodes each pass alone but not jointly."""
+        allowed = getattr(self, "_pdb_allowed", None)
+        if not allowed:
+            return False
+        for key, pdb in self.store.pdbs.items():
+            n = sum(1 for v in victims for p in v.pods if pdb.matches(p))
+            if n and n > allowed.get(key, 0):
+                return True
+        return False
+
+    def _pdb_commit(self, victims: List[NodeView]) -> None:
+        """Charge a committed disruption against this pass's remaining
+        PDB allowances, so later candidates in the SAME pass see the
+        reduced budget."""
+        allowed = getattr(self, "_pdb_allowed", None)
+        if not allowed:
+            return
+        for key, pdb in self.store.pdbs.items():
+            n = sum(1 for v in victims for p in v.pods if pdb.matches(p))
+            if n and key in allowed:
+                allowed[key] = max(0, allowed[key] - n)
+
     def _replace(self, pool: NodePool, victims: List[NodeView], reason: str,
                  now: float, cat, views: List[NodeView],
                  stat: str = "drift") -> None:
         if self._is_pending_victim(victims[0].name) or victims[0].claim.is_deleting():
             return
+        # final PDB check: the consolidation candidate list was filtered
+        # with the allowances as of the top of the pass; earlier commits
+        # in this pass may have consumed them
+        if self._pdb_blocked_set(victims):
+            return
         out, ok = self._simulate_removal(pool, victims, cat, views, None)
         if not ok:
             return
         self._execute(pool, victims, out, reason, now)
+        self._pdb_commit(victims)
         self.stats[stat if stat in self.stats else "drift"] += 1
 
     def _execute(self, pool: NodePool, victims: List[NodeView], out,

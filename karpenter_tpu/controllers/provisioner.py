@@ -133,10 +133,12 @@ class Provisioner:
                 continue
             existing.append(view.virtual)
             existing_pods[view.claim.name] = view.pods
+        daemonsets = list(self.store.daemonsets.values())
         out = self.solver.solve(pods, pool, node_class, existing,
                                 existing_pods=existing_pods,
                                 spread_occupancy=spread_occupancy,
-                                pregrouped=pregrouped)
+                                pregrouped=pregrouped,
+                                daemonsets=daemonsets)
         self.stats["solves"] += 1
 
         by_key = {f"{p.namespace}/{p.name}": p for p in pods}
@@ -169,7 +171,8 @@ class Provisioner:
                     for l in launches]
                 out2 = self.solver.solve(over_limit_pods, pool, node_class,
                                          capacity_cap=headroom,
-                                         spread_occupancy=occ2)
+                                         spread_occupancy=occ2,
+                                         daemonsets=daemonsets)
                 by_key2 = {f"{p.namespace}/{p.name}": p for p in over_limit_pods}
                 by_key.update(by_key2)
                 l2, over_limit_pods, usage = self._filter_by_limits(

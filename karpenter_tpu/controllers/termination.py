@@ -63,11 +63,34 @@ class TerminationController:
                 # evict: unbind, pods return to pending for rescheduling.
                 # Keep nominations pointing at OTHER claims (a pre-spun
                 # consolidation replacement) — only clear ones aimed here.
+                # PDB pacing: each budget releases only disruptionsAllowed
+                # pods per pass; blocked pods stay bound until the evicted
+                # ones reschedule and restore health (k8s eviction-API
+                # semantics). After `grace` the force path tears down
+                # regardless — terminationGracePeriod outranks PDBs, as in
+                # the reference.
+                allowed = {name: self.store.pdb_disruptions_allowed(pdb)
+                           for name, pdb in self.store.pdbs.items()}
                 for p in pods:
+                    matching = [n for n, pdb in self.store.pdbs.items()
+                                if pdb.matches(p)]
+                    if any(allowed[m] <= 0 for m in matching):
+                        continue  # blocked this pass; retry next reconcile
+                    for m in matching:
+                        allowed[m] -= 1
                     if p.annotations.get(NOMINATED) == claim.name:
                         self.store.unnominate_pod(p)
                     self.store.unbind_pod(p)
                 return  # wait a tick for rescheduling before teardown
+            # grace expired (or node empty): force path. Any pod still
+            # bound — e.g. held through grace by a zero PDB budget — is
+            # force-evicted NOW; deleting the node without unbinding
+            # would strand it Running on a ghost node forever, silently
+            # counting as healthy in every future PDB decision
+            for p in self.store.pods_on_node(node.name):
+                if p.annotations.get(NOMINATED) == claim.name:
+                    self.store.unnominate_pod(p)
+                self.store.unbind_pod(p)
             self.store.delete_node(node.name)
         # un-nominate pods still pointing at this claim
         for p in self.store.pods.values():

@@ -259,6 +259,65 @@ class Pod:
         return gid
 
 
+@dataclass
+class DaemonSet:
+    """A per-node workload whose pods run on every compatible node —
+    the scheduler reserves its requests on each virtual node BEFORE
+    placing workloads (reference core: daemonset overhead in the
+    scheduling simulation; the scale suite's GetDaemonSetCount adjusts
+    density expectations for it, test/suites/scale)."""
+
+    name: str
+    requests: Resources = field(default_factory=Resources)
+    namespace: str = "default"
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def scheduling_requirements(self) -> Requirements:
+        return Requirements.from_labels(self.node_selector)
+
+
+@dataclass
+class PodDisruptionBudget:
+    """Voluntary-disruption guard for a workload (the k8s PDB the
+    reference core consults: nodes whose pods' PDBs would be violated
+    are excluded from disruption candidates, and eviction during drain
+    is paced to disruptionsAllowed — SURVEY §3 disruption call stack).
+
+    Exactly one of min_available / max_unavailable should be set; each
+    is an absolute count or a percent string over the matching-pod
+    total."""
+
+    name: str
+    label_selector: Dict[str, str]
+    namespace: str = "default"
+    min_available: Optional[object] = None   # int | "50%"
+    max_unavailable: Optional[object] = None
+
+    def matches(self, pod: "Pod") -> bool:
+        return (pod.namespace == self.namespace
+                and all(pod.labels.get(k) == v
+                        for k, v in self.label_selector.items()))
+
+    @staticmethod
+    def _abs(value, total: int) -> int:
+        if isinstance(value, str) and value.endswith("%"):
+            import math
+            return math.ceil(total * float(value[:-1]) / 100.0)
+        return int(value)
+
+    def disruptions_allowed(self, total: int, healthy: int) -> int:
+        """k8s semantics: healthy − desiredHealthy (never negative)."""
+        if self.max_unavailable is not None:
+            desired = total - self._abs(self.max_unavailable, total)
+        elif self.min_available is not None:
+            desired = self._abs(self.min_available, total)
+        else:
+            return total  # no constraint
+        return max(0, healthy - desired)
+
+
 def intern_pods(pods) -> None:
     """Batch group_key over a pod sequence — the cold-encode fast path.
 

@@ -39,6 +39,50 @@ MAX_OVERRIDES = 60  # reference MaxInstanceTypes (instance.go:62)
 _MESH_UNSET = object()
 
 
+def daemonset_overhead(cat: CatalogTensors, daemonsets, nodepool: NodePool,
+                       template: Dict[str, str]) -> Optional[np.ndarray]:
+    """f32 [T, R]: per-instance-type resource reservation for daemonset
+    pods that would run on this pool's nodes (reference core: the
+    scheduler adds daemonset pods to every virtual node before placing
+    workloads). Per-type, not per-pool: a gpu-selector daemonset
+    reserves only on gpu-carrying types. Each compatible daemonset also
+    consumes one pod slot. Returns None when nothing applies."""
+    from ..models.pod import tolerates_all
+    from ..models.resources import PODS, Resources
+    from .encode import compat_mask
+    taints = nodepool.taints + nodepool.startup_taints
+    pool_zvs = nodepool.requirements.get(L.ZONE)
+    R = cat.allocatable.shape[1]
+    out = None
+    for ds in daemonsets:
+        if taints and not tolerates_all(ds.tolerations, taints):
+            continue
+        reqs = ds.scheduling_requirements()
+        # zone-keyed selectors: the overhead tensor is per-TYPE, not
+        # per-offering, so a zone-pinned daemonset is skipped when its
+        # zones can't intersect the pool's; on partial overlap it is
+        # reserved everywhere — conservative (may under-pack a
+        # multi-zone pool slightly, never overcommits a node)
+        ds_zvs = reqs.get(L.ZONE)
+        if ds_zvs is not None:
+            possible = [z for z in cat.zones
+                        if ds_zvs.contains(z)
+                        and (pool_zvs is None or pool_zvs.contains(z))]
+            if not possible:
+                continue
+        mask = compat_mask(reqs, cat, template)
+        if not mask.any():
+            continue
+        vec = ds.requests.add(Resources({PODS: 1.0})).to_vector()
+        v = np.zeros(R, np.float32)
+        n = min(len(vec), R)
+        v[:n] = vec[:n]
+        if out is None:
+            out = np.zeros((cat.T, R), np.float32)
+        out[mask] += v
+    return out
+
+
 def targets_reserved(requirements: Optional[Requirements]) -> bool:
     """Does a Requirements conjunction EXPLICITLY name the reserved
     capacity type (an In requirement listing "reserved")? This is the
@@ -193,6 +237,7 @@ class Solver:
               spread_occupancy: Optional[
                   List[Tuple[Optional[str], List[Pod]]]] = None,
               pregrouped: Optional[List[List[Pod]]] = None,
+              daemonsets: Optional[list] = None,
               _gate_blocks: bool = True) -> SolveOutput:
         """capacity_cap: only open nodes whose total capacity fits within it
         (the NodePool-limits headroom; the reference scheduler stops opening
@@ -228,6 +273,20 @@ class Solver:
         # catalog doesn't carry resolve against these (every launched
         # node wears them; NodePool.template_labels is the one source)
         template = nodepool.template_labels()
+        # daemonset overhead: reserve per-node resources for daemonset
+        # pods BEFORE placing workloads, by shrinking the allocatable
+        # tensor (equivalent to starting every node's cum at the
+        # overhead; covers every backend uniformly, and existing-node
+        # views see the same reduced headroom since their daemonsets
+        # run too)
+        ds_fp = 0
+        if daemonsets:
+            ovh = daemonset_overhead(cat, daemonsets, nodepool, template)
+            if ovh is not None:
+                from dataclasses import replace as _dc_replace
+                cat = _dc_replace(cat, allocatable=np.maximum(
+                    cat.allocatable - ovh, 0.0))
+                ds_fp = hash(ovh.tobytes())
         fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())
@@ -280,7 +339,7 @@ class Solver:
                                        cat, nodepool)
                 return self._retry_reserved_unschedulable(
                     out, blocks_gated, all_pods, nodepool, node_class,
-                    spread_occupancy)
+                    spread_occupancy, daemonsets)
         enc = encode_pods(pods, cat,
                           extra_requirements=nodepool.requirements,
                           taints=nodepool.taints + nodepool.startup_taints,
@@ -312,7 +371,7 @@ class Solver:
                                    cat, nodepool)
             return self._retry_reserved_unschedulable(
                 out, blocks_gated, all_pods, nodepool, node_class,
-                spread_occupancy)
+                spread_occupancy, daemonsets)
         self._relax_infeasible_preferences(enc, cat)
 
         if existing and existing_pods:
@@ -348,7 +407,7 @@ class Solver:
                 # block gating) — NOT id(cat): a freed CatalogTensors'
                 # address can be reused by its successor
                 dkey = self._last_cat_key + (R, backend == "mesh",
-                                             blocks_gated)
+                                             blocks_gated, ds_fp)
                 dcat = self._dcat_cache.get(dkey)
                 if dcat is None:
                     # one EPOCH resident at a time — but every variant of
@@ -370,12 +429,13 @@ class Solver:
         out = self._merge_plan(out, plan, cat, nodepool)
         return self._retry_reserved_unschedulable(
             out, blocks_gated, all_pods, nodepool, node_class,
-            spread_occupancy)
+            spread_occupancy, daemonsets)
 
     def _retry_reserved_unschedulable(
             self, out: SolveOutput, blocks_gated: bool, all_pods: List[Pod],
             nodepool: NodePool, node_class: Optional[NodeClassSpec],
-            spread_occupancy) -> SolveOutput:
+            spread_occupancy, daemonsets: Optional[list] = None,
+            ) -> SolveOutput:
         """Pods the gated solve left unschedulable that EXPLICITLY target
         reserved capacity (a pod-level capacity-type selector naming
         "reserved" under a pool that doesn't) get one ungated re-solve
@@ -395,7 +455,7 @@ class Solver:
             return out
         second = self.solve(retry, nodepool, node_class,
                             spread_occupancy=spread_occupancy,
-                            _gate_blocks=False)
+                            daemonsets=daemonsets, _gate_blocks=False)
         retried = {_pod_key(p) for p in retry}
         out.launches += second.launches
         for name, keys in second.existing_placements.items():

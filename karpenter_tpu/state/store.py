@@ -36,6 +36,8 @@ class Store:
         self.nodeclasses: Dict[str, NodeClassSpec] = {}
         self.nodeclaims: Dict[str, NodeClaim] = {}
         self.nodes: Dict[str, Node] = {}
+        self.daemonsets: Dict[str, object] = {}
+        self.pdbs: Dict[str, object] = {}
         self._watchers: Dict[str, List[Callable]] = defaultdict(list)
         self.events: List[tuple] = []  # (kind, object-name, reason, message)
         # set by state.rehydrate.rehydrate(); until then the store may be a
@@ -133,6 +135,41 @@ class Store:
     def unnominate_pod(self, pod: Pod) -> None:
         pod.annotations.pop(L.NOMINATED, None)
         self._index_update(pod, f"{pod.namespace}/{pod.name}")
+
+    # --- daemonsets (namespaced, like the pod index — name-only keys
+    # would let team-b's "agent" silently replace team-a's) ---
+    def add_daemonset(self, ds) -> object:
+        self.daemonsets[f"{ds.namespace}/{ds.name}"] = ds
+        self._notify("daemonset", "add", ds)
+        return ds
+
+    def delete_daemonset(self, name: str,
+                         namespace: str = "default") -> None:
+        ds = self.daemonsets.pop(f"{namespace}/{name}", None)
+        if ds is not None:
+            self._notify("daemonset", "delete", ds)
+
+    # --- pod disruption budgets (namespaced, same rationale) ---
+    def add_pdb(self, pdb) -> object:
+        self.pdbs[f"{pdb.namespace}/{pdb.name}"] = pdb
+        self._notify("pdb", "add", pdb)
+        return pdb
+
+    def delete_pdb(self, name: str, namespace: str = "default") -> None:
+        pdb = self.pdbs.pop(f"{namespace}/{name}", None)
+        if pdb is not None:
+            self._notify("pdb", "delete", pdb)
+
+    def pdb_disruptions_allowed(self, pdb) -> int:
+        """Live disruptionsAllowed for one PDB: matching pods across the
+        cluster, healthy = bound + Running."""
+        total = healthy = 0
+        for p in self.pods.values():
+            if pdb.matches(p):
+                total += 1
+                if p.node_name is not None and p.phase == "Running":
+                    healthy += 1
+        return pdb.disruptions_allowed(total, healthy)
 
     # --- nodepools / nodeclasses (validated at admission, like the
     # reference's CEL rules on the CRDs) ---

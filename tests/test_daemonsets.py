@@ -1,0 +1,136 @@
+"""DaemonSet overhead: per-node resources reserved before workload
+placement (reference core: the scheduler adds daemonset pods to every
+virtual node in the simulation; the scale suite's GetDaemonSetCount
+adjusts density expectations accordingly)."""
+
+import numpy as np
+
+from karpenter_tpu.catalog import small_catalog
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import DaemonSet, Pod, Taint, Toleration
+from karpenter_tpu.models.resources import NVIDIA_GPU, PODS, Resources
+from karpenter_tpu.ops.encode import encode_catalog
+from karpenter_tpu.ops.facade import daemonset_overhead
+from karpenter_tpu.sim import make_sim
+
+
+def small_pods(sim, n, cpu="900m"):
+    pods = [Pod(name=f"p{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": "512Mi"}))
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+class TestOverheadMatrix:
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog(8))
+        self.pool = NodePool(name="default")
+
+    def test_plain_daemonset_reserves_on_every_type(self):
+        ovh = daemonset_overhead(
+            self.cat, [DaemonSet(name="logging",
+                                 requests=Resources.parse({"cpu": "500m"}))],
+            self.pool, self.pool.template_labels())
+        assert ovh is not None and (ovh > 0).any()
+        cpu_col = self.cat.resources.index("cpu")
+        pods_col = self.cat.resources.index(PODS)
+        assert np.allclose(ovh[:, cpu_col], 0.5)
+        assert np.allclose(ovh[:, pods_col], 1.0)  # one pod slot each
+
+    def test_gpu_selector_daemonset_reserves_only_on_gpu_types(self):
+        ovh = daemonset_overhead(
+            self.cat, [DaemonSet(
+                name="gpu-agent",
+                requests=Resources.parse({"cpu": "1"}),
+                node_selector={L.INSTANCE_GPU_MANUFACTURER: "nvidia"})],
+            self.pool, self.pool.template_labels())
+        assert ovh is not None
+        gpu_types = self.cat.allocatable[
+            :, self.cat.resources.index(NVIDIA_GPU)] > 0
+        cpu_col = self.cat.resources.index("cpu")
+        assert (ovh[gpu_types, cpu_col] == 1.0).all()
+        assert (ovh[~gpu_types, cpu_col] == 0.0).all()
+
+    def test_intolerant_daemonset_skipped_on_tainted_pool(self):
+        pool = NodePool(name="tainted", taints=[
+            Taint(key="team", value="x", effect="NoSchedule")])
+        ds = DaemonSet(name="plain",
+                       requests=Resources.parse({"cpu": "1"}))
+        assert daemonset_overhead(self.cat, [ds], pool,
+                                  pool.template_labels()) is None
+        tol = DaemonSet(name="tolerant",
+                        requests=Resources.parse({"cpu": "1"}),
+                        tolerations=[Toleration(key="team", value="x",
+                                                effect="NoSchedule")])
+        assert daemonset_overhead(self.cat, [tol], pool,
+                                  pool.template_labels()) is not None
+
+
+class TestEndToEnd:
+    def test_density_drops_under_daemonset_overhead(self):
+        """The same workload needs MORE nodes once a fat daemonset
+        reserves per-node capacity — and never overcommits: real pod
+        usage + overhead fits every node's allocatable."""
+        from karpenter_tpu.models.requirements import (Operator,
+                                                       Requirement,
+                                                       Requirements)
+        # pin the type so density is deterministic (the solver would
+        # otherwise absorb the overhead by sizing up)
+        pin = Requirements(Requirement(L.INSTANCE_TYPE, Operator.IN,
+                                       ("m5.xlarge",)))
+        base = make_sim(nodepool=NodePool(name="default",
+                                          requirements=pin.copy()))
+        small_pods(base, 24)
+        assert base.engine.run_until(
+            lambda: all(p.node_name for p in base.store.pods.values()),
+            timeout=120)
+        n_without = len(base.store.nodes)
+
+        sim = make_sim(nodepool=NodePool(name="default",
+                                         requirements=pin.copy()))
+        ds = DaemonSet(name="fat-agent",
+                       requests=Resources.parse({"cpu": "2",
+                                                 "memory": "2Gi"}))
+        sim.store.add_daemonset(ds)
+        small_pods(sim, 24)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=120)
+        assert len(sim.store.nodes) > n_without
+        # no node overcommitted once overhead is charged
+        for claim in sim.store.nodeclaims.values():
+            if not claim.node_name:
+                continue
+            used = Resources()
+            for p in sim.store.pods_on_node(claim.node_name):
+                used = used.add(p.requests)
+            used = used.add(ds.requests)
+            assert used.fits(claim.allocatable), (
+                f"{claim.name} overcommitted: {used} vs {claim.allocatable}")
+
+    def test_consolidation_respects_overhead(self):
+        """The consolidation re-solve must also charge daemonset
+        overhead — replacements sized without it would overcommit."""
+        sim = make_sim()
+        ds = DaemonSet(name="agent",
+                       requests=Resources.parse({"cpu": "2",
+                                                 "memory": "2Gi"}))
+        sim.store.add_daemonset(ds)
+        pods = small_pods(sim, 12)
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=120)
+        # free up half the load; consolidation repacks
+        for p in pods[6:]:
+            sim.store.delete_pod(p.namespace, p.name)
+        sim.engine.run_for(900, step=10)
+        for claim in sim.store.nodeclaims.values():
+            if claim.is_deleting() or not claim.node_name:
+                continue
+            used = Resources()
+            for p in sim.store.pods_on_node(claim.node_name):
+                used = used.add(p.requests)
+            used = used.add(ds.requests)
+            assert used.fits(claim.allocatable)

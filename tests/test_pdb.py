@@ -1,0 +1,164 @@
+"""PodDisruptionBudgets: voluntary-disruption candidate gating and
+eviction pacing (reference core disruption call stack — SURVEY §3:
+'candidates = disruptable nodes (PDB/do-not-disrupt/budget filters)')."""
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import (Pod, PodAffinityTerm,
+                                      PodDisruptionBudget)
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def guarded_pods(sim, n, prefix="g"):
+    pods = [Pod(name=f"{prefix}-{i}", labels={"app": "web"},
+                requests=Resources.parse({"cpu": "1", "memory": "2Gi"}))
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def all_bound(sim):
+    return all(p.node_name for p in sim.store.pods.values())
+
+
+class TestBudgetMath:
+    def test_min_available_absolute_and_percent(self):
+        pdb = PodDisruptionBudget(name="x", label_selector={"app": "web"},
+                                  min_available=3)
+        assert pdb.disruptions_allowed(total=4, healthy=4) == 1
+        assert pdb.disruptions_allowed(total=4, healthy=3) == 0
+        pct = PodDisruptionBudget(name="y", label_selector={"app": "web"},
+                                  min_available="50%")
+        assert pct.disruptions_allowed(total=4, healthy=4) == 2
+
+    def test_max_unavailable(self):
+        pdb = PodDisruptionBudget(name="x", label_selector={"app": "web"},
+                                  max_unavailable=1)
+        assert pdb.disruptions_allowed(total=4, healthy=4) == 1
+        assert pdb.disruptions_allowed(total=4, healthy=3) == 0
+
+
+class TestDisruptionGating:
+    def _spread_sim(self):
+        """4 guarded pods forced onto 4 nodes (anti-affinity), then the
+        anti-affinity anchors removed so consolidation wants to pack."""
+        sim = make_sim()
+        anchors = [Pod(name=f"a-{i}", labels={"role": "anchor"},
+                       requests=Resources.parse({"cpu": "1",
+                                                 "memory": "2Gi"}),
+                       affinity_terms=[PodAffinityTerm(
+                           topology_key="kubernetes.io/hostname",
+                           label_selector={"role": "anchor"}, anti=True)])
+                   for i in range(4)]
+        for p in anchors:
+            sim.store.add_pod(p)
+        guarded = guarded_pods(sim, 4)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        for p in anchors:
+            sim.store.delete_pod(p.namespace, p.name)
+        return sim, guarded
+
+    def test_zero_budget_blocks_consolidation(self):
+        sim, guarded = self._spread_sim()
+        sim.store.add_pdb(PodDisruptionBudget(
+            name="web", label_selector={"app": "web"},
+            min_available=len(guarded)))  # allowed = 0
+        hosting = {p.node_name for p in guarded}
+        sim.engine.run_for(600, step=10)
+        # empty anchor nodes may be reaped (no pods -> no PDB), but the
+        # guarded pods' nodes are untouched and no consolidation fired
+        assert sim.disruption.stats["consolidated"] == 0
+        assert sim.disruption.stats["multi_consolidated"] == 0
+        assert {p.node_name for p in guarded} == hosting, \
+            "guarded pods were moved past a zero PDB budget"
+        # relax the budget: consolidation proceeds
+        sim.store.pdbs["default/web"].min_available = 1
+        sim.engine.run_for(900, step=10)
+        assert (sim.disruption.stats["consolidated"]
+                + sim.disruption.stats["multi_consolidated"]
+                + sim.disruption.stats["empty"]) >= 1
+        assert all_bound(sim)
+
+    def test_zero_budget_blocks_drift(self):
+        sim = make_sim()
+        guarded = guarded_pods(sim, 3)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        sim.store.add_pdb(PodDisruptionBudget(
+            name="web", label_selector={"app": "web"},
+            max_unavailable=0))
+        old = set(sim.store.nodeclaims)
+        sim.store.nodeclasses["default"].user_data = "v2"
+        sim.engine.run_for(400, step=10)
+        assert set(sim.store.nodeclaims) & old == old, \
+            "drift rolled nodes past a zero PDB budget"
+        sim.store.pdbs["default/web"].max_unavailable = 3
+        sim.engine.run_for(900, step=10)
+        assert not (set(sim.store.nodeclaims) & old)
+        assert all_bound(sim)
+
+
+class TestPassAccounting:
+    def test_one_pass_cannot_disrupt_past_budget(self):
+        """Review finding: with allowed=1 and several drifted one-pod
+        nodes, one reconcile pass must commit only ONE disruption — the
+        snapshot is decremented as victims commit, not re-read."""
+        sim = make_sim()
+        pods = [Pod(name=f"d-{i}", labels={"app": "web", "role": "anchor"},
+                    requests=Resources.parse({"cpu": "1", "memory": "2Gi"}),
+                    affinity_terms=[PodAffinityTerm(
+                        topology_key="kubernetes.io/hostname",
+                        label_selector={"role": "anchor"}, anti=True)])
+                for i in range(3)]
+        for p in pods:
+            sim.store.add_pod(p)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        sim.store.add_pdb(PodDisruptionBudget(
+            name="web", label_selector={"app": "web"},
+            min_available=2))  # allowed = 1
+        sim.store.nodeclasses["default"].user_data = "v2"
+        # drive exactly one disruption reconcile
+        sim.disruption.reconcile(sim.clock.now())
+        committing = (sum(len(pd.victim_claims)
+                          for pd in sim.disruption._pending)
+                      + sum(1 for c in sim.store.nodeclaims.values()
+                            if c.is_deleting()))
+        assert committing <= 1, \
+            f"one pass committed {committing} victims against allowed=1"
+
+    def test_namespaced_pdbs_do_not_collide(self):
+        from karpenter_tpu.state.store import Store
+        s = Store()
+        s.add_pdb(PodDisruptionBudget(name="web", namespace="team-a",
+                                      label_selector={"app": "a"},
+                                      max_unavailable=0))
+        s.add_pdb(PodDisruptionBudget(name="web", namespace="team-b",
+                                      label_selector={"app": "b"},
+                                      max_unavailable=1))
+        assert len(s.pdbs) == 2
+
+
+class TestEvictionPacing:
+    def test_drain_releases_at_most_allowed_per_step(self):
+        """max_unavailable=1: during a drain, never more than one
+        matching pod is unbound at any instant; the node still empties
+        as evicted pods reschedule and restore health."""
+        sim = make_sim()
+        guarded = guarded_pods(sim, 4)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=120)
+        sim.store.add_pdb(PodDisruptionBudget(
+            name="web", label_selector={"app": "web"},
+            max_unavailable=1))
+        peak = {"n": 0}
+        sim.engine.add_hook(lambda now: peak.__setitem__(
+            "n", max(peak["n"], sum(1 for p in sim.store.pods.values()
+                                    if p.node_name is None))))
+        victim = next(c for c in sim.store.nodeclaims.values()
+                      if sim.store.pods_on_node(c.node_name))
+        sim.termination.delete_nodeclaim(victim, sim.clock.now(), "test")
+        ok = sim.engine.run_until(
+            lambda: victim.name not in sim.store.nodeclaims
+            and all_bound(sim), timeout=600)
+        assert ok, "drain did not complete under PDB pacing"
+        assert peak["n"] <= 1, f"{peak['n']} pods unbound at once"
