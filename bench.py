@@ -175,6 +175,22 @@ def main() -> None:
     detail["c4_5k_node_screen_ms"] = round(
         timeit(lambda: consolidation_screen(cat, enc4, views, counts),
                repeats=3) * 1e3, 1)
+    # opt-in Pallas k-kernel comparison (KARPENTER_TPU_PALLAS=1 + probe):
+    # reported only when the path can actually run on this rig
+    from karpenter_tpu.ops.pallas_screen import available as pallas_ok
+    if pallas_ok():
+        import os as _os
+        _os.environ["KARPENTER_TPU_PALLAS"] = "0"
+        import karpenter_tpu.ops.pallas_screen as _ps
+        _ps._status = False  # force XLA path
+        detail["c4_screen_xla_ms"] = round(
+            timeit(lambda: consolidation_screen(cat, enc4, views, counts),
+                   repeats=3) * 1e3, 1)
+        _os.environ["KARPENTER_TPU_PALLAS"] = "1"
+        _ps._status = True
+        detail["c4_screen_pallas_ms"] = round(
+            timeit(lambda: consolidation_screen(cat, enc4, views, counts),
+                   repeats=3) * 1e3, 1)
 
     # --- config 6: interruption throughput, 15k queued messages ---
     # (reference interruption_benchmark_test.go:58-75 benches 100/1k/5k/15k

@@ -36,19 +36,20 @@ from .encode import CatalogTensors, EncodedPods, align_resources
 
 def _screen_kernel_impl(alloc, avail, node_type, node_cum, node_zmask,
                         node_cmask, node_active, group_req, compat,
-                        allow_zone, allow_cap, node_groups):
+                        allow_zone, allow_cap, node_groups,
+                        use_pallas: bool = False,
+                        pallas_interpret: bool = False):
     """Returns ONE packed f32 vector: [0:N] screen (1.0 = candidate may
     consolidate), [N:N+N*G] headroom slack (others' capacity minus need,
     row-major [N, G]) — consolidation_screen unpacks it after a single
-    host read."""
+    host read.
+
+    use_pallas: route the k-cap reduction through the VMEM-resident
+    Pallas kernel (ops/pallas_screen) instead of materializing the
+    [N, G, R] ratio tensor in HBM — same math, chosen by availability
+    + measurement at the call site."""
     talloc = alloc[node_type]                                 # [N, R]
     headroom = talloc - node_cum                              # [N, R]
-    with_req = jnp.where(group_req > 0, group_req, 1.0)       # [G, R]
-    # k_cap[m, g] = min over r of floor(headroom[m,r] / req[g,r])
-    ratios = jnp.where(group_req[None, :, :] > 0,
-                       jnp.floor(headroom[:, None, :] / with_req[None, :, :] + EPS),
-                       jnp.asarray(BIG, jnp.float32))         # [N, G, R]
-    k = jnp.maximum(ratios.min(axis=2), 0.0)                  # [N, G]
     # eligibility: compat + an available offering surviving both masks
     ok_t = compat[:, node_type].T                             # [N, G]
     a = avail[node_type]                                      # [N, Z, C]
@@ -56,7 +57,19 @@ def _screen_kernel_impl(alloc, avail, node_type, node_cum, node_zmask,
                      node_zmask.astype(jnp.float32), allow_zone.astype(jnp.float32),
                      node_cmask.astype(jnp.float32), allow_cap.astype(jnp.float32),
                      a.astype(jnp.float32)) > 0               # [N, G]
-    k = jnp.where(ok_t & off & node_active[:, None], k, 0.0)  # [N, G]
+    elig = ok_t & off & node_active[:, None]                  # [N, G]
+    if use_pallas:
+        from .pallas_screen import screen_k
+        k = screen_k(headroom, group_req, elig,
+                     interpret=pallas_interpret)              # [N, G]
+    else:
+        with_req = jnp.where(group_req > 0, group_req, 1.0)   # [G, R]
+        # k_cap[m, g] = min over r of floor(headroom[m,r] / req[g,r])
+        ratios = jnp.where(group_req[None, :, :] > 0,
+                           jnp.floor(headroom[:, None, :]
+                                     / with_req[None, :, :] + EPS),
+                           jnp.asarray(BIG, jnp.float32))     # [N, G, R]
+        k = jnp.where(elig, jnp.maximum(ratios.min(axis=2), 0.0), 0.0)
     total = k.sum(axis=0)                                     # [G]
     others = total[None, :] - k                               # [N, G]
     need = node_groups.astype(jnp.float32)                    # [N, G]
@@ -68,7 +81,8 @@ def _screen_kernel_impl(alloc, avail, node_type, node_cum, node_zmask,
                             (others - need).reshape(-1)])
 
 
-_screen_kernel = jax.jit(_screen_kernel_impl)
+_screen_kernel = jax.jit(_screen_kernel_impl,
+                         static_argnames=("use_pallas", "pallas_interpret"))
 
 # mesh-jitted screens, keyed on the (hashable) Mesh itself and capped —
 # id() keys break under address reuse and pin dead meshes forever
@@ -133,7 +147,20 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         packed = _mesh_screen_fn(mesh)(
             *(jax.device_put(np.asarray(a), s) for a, s in zip(args, sharded)))
     else:
-        packed = _screen_kernel(*(jnp.asarray(a) for a in args))
+        # single-device path may route the k-cap reduction through the
+        # opt-in Pallas kernel; the mesh path above stays fused-XLA (the
+        # kernel is not GSPMD-partitioned — flag is inert there). A
+        # failure at the REAL shape (the probe compiles a toy one) falls
+        # back to the XLA path, as the pallas_screen contract promises.
+        from .pallas_screen import available as pallas_ok
+        jargs = [jnp.asarray(a) for a in args]
+        if pallas_ok():
+            try:
+                packed = _screen_kernel(*jargs, use_pallas=True)
+            except Exception:
+                packed = _screen_kernel(*jargs)
+        else:
+            packed = _screen_kernel(*jargs)
     buf = np.asarray(packed)  # ONE host read
     screen = buf[:N] > 0.5
     slack = buf[Np: Np + N * enc.G].reshape(N, enc.G)

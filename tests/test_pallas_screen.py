@@ -1,0 +1,77 @@
+"""Pallas consolidation-screen kernel: interpreter-mode parity with the
+fused-XLA path (CI has no TPU; the real-chip path is opt-in via
+KARPENTER_TPU_PALLAS=1, probed by ops/pallas_screen.available, and
+bench.py reports the pallas-vs-XLA comparison when the probe passes)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from karpenter_tpu.catalog import small_catalog
+from karpenter_tpu.models.nodeclaim import NodeClaim
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.ops.binpack import BIG, EPS, VirtualNode
+from karpenter_tpu.ops.consolidate import _screen_kernel
+from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+from karpenter_tpu.ops.pallas_screen import screen_k
+from karpenter_tpu.state.cluster import NodeView
+
+
+def _oracle_k(head, req, elig):
+    N, R = head.shape
+    G = req.shape[0]
+    k = np.full((N, G), BIG, np.float32)
+    for r in range(R):
+        q = req[:, r]
+        ratio = np.where(q[None, :] > 0,
+                         np.floor(head[:, r][:, None]
+                                  / np.where(q > 0, q, 1.0)[None, :] + EPS),
+                         BIG).astype(np.float32)
+        k = np.minimum(k, ratio)
+    return np.where(elig, np.maximum(k, 0.0), 0.0)
+
+
+def test_k_kernel_parity_random_shapes():
+    rng = np.random.default_rng(7)
+    for (N, G, R) in [(300, 37, 6), (8, 1, 1), (257, 129, 9), (64, 128, 4)]:
+        head = rng.uniform(-2.0, 12.0, (N, R)).astype(np.float32)
+        req = rng.uniform(0.0, 3.0, (G, R)).astype(np.float32)
+        req[rng.random((G, R)) < 0.3] = 0.0  # zero-request columns
+        elig = rng.random((N, G)) < 0.8
+        got = np.asarray(screen_k(jnp.asarray(head), jnp.asarray(req),
+                                  jnp.asarray(elig), interpret=True))
+        want = _oracle_k(head, req, elig)
+        np.testing.assert_allclose(got, want, rtol=0, atol=0,
+                                   err_msg=f"shape {(N, G, R)}")
+
+
+def test_full_screen_kernel_pallas_vs_xla():
+    """The packed screen output must be IDENTICAL between the Pallas
+    k-path (interpreted) and the fused-XLA path on a realistic problem
+    built through the normal encode."""
+    cat = encode_catalog(small_catalog())
+    pods = [Pod(name=f"s{i}",
+                requests=Resources.parse({"cpu": ["500m", "1", "2"][i % 3],
+                                          "memory": "1Gi"}))
+            for i in range(120)]
+    enc = encode_pods(pods, cat)
+    N = 41
+    rng = np.random.default_rng(3)
+    node_type = rng.integers(0, cat.T, N).astype(np.int32)
+    node_cum = np.zeros((N, enc.requests.shape[1]), np.float32)
+    node_cum[:, 0] = rng.uniform(0, 8, N)
+    zmask = np.ones((N, cat.Z), bool)
+    cmask = np.ones((N, cat.C), bool)
+    active = np.ones(N, bool)
+    active[-2:] = False  # padding rows
+    counts = rng.integers(0, 3, (N, enc.G)).astype(np.int32)
+    from karpenter_tpu.ops.encode import align_resources
+    args = (align_resources(cat.allocatable, enc.requests.shape[1]),
+            cat.available, node_type, node_cum, zmask, cmask, active,
+            enc.requests.astype(np.float32), enc.compat, enc.allow_zone,
+            enc.allow_cap, counts)
+    xla = np.asarray(_screen_kernel(*(jnp.asarray(a) for a in args)))
+    pls = np.asarray(_screen_kernel(*(jnp.asarray(a) for a in args),
+                                    use_pallas=True, pallas_interpret=True))
+    np.testing.assert_allclose(xla, pls, rtol=0, atol=0)
