@@ -176,17 +176,14 @@ def main() -> None:
         timeit(lambda: consolidation_screen(cat, enc4, views, counts),
                repeats=3) * 1e3, 1)
     # opt-in Pallas k-kernel comparison (KARPENTER_TPU_PALLAS=1 + probe):
-    # reported only when the path can actually run on this rig
-    from karpenter_tpu.ops.pallas_screen import available as pallas_ok
-    if pallas_ok():
-        import os as _os
-        _os.environ["KARPENTER_TPU_PALLAS"] = "0"
-        import karpenter_tpu.ops.pallas_screen as _ps
+    # reported only when the path can actually run on this rig. The
+    # probe result latches in _status, so force each path through it.
+    import karpenter_tpu.ops.pallas_screen as _ps
+    if _ps.available():
         _ps._status = False  # force XLA path
         detail["c4_screen_xla_ms"] = round(
             timeit(lambda: consolidation_screen(cat, enc4, views, counts),
                    repeats=3) * 1e3, 1)
-        _os.environ["KARPENTER_TPU_PALLAS"] = "1"
         _ps._status = True
         detail["c4_screen_pallas_ms"] = round(
             timeit(lambda: consolidation_screen(cat, enc4, views, counts),

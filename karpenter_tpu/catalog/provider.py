@@ -83,12 +83,24 @@ class CatalogProvider:
             return cached
         from ..models.overlay import apply_overlays
         resolved = []
+        from ..models.resources import EPHEMERAL_STORAGE, Resources
+        gib = 1024.0 ** 3
+        block_bytes = (nc.block_device_gib or 0.0) * gib
         for t in self.raw_types():
             offerings = self._inject_offerings(t, nc)
             if not offerings:
                 continue
+            capacity = t.capacity
+            # NodeClass block-device size IS the node's ephemeral-storage
+            # capacity (reference: the instancetype resolver derives
+            # ephemeral-storage from the EC2NodeClass blockDeviceMappings,
+            # types.go ephemeralStorage); the per-NodeClass resolved cache
+            # key already covers it via nc.hash()
+            if block_bytes and capacity.get(EPHEMERAL_STORAGE) != block_bytes:
+                capacity = Resources(capacity)
+                capacity[EPHEMERAL_STORAGE] = block_bytes
             resolved.append(InstanceType(
-                name=t.name, requirements=t.requirements, capacity=t.capacity,
+                name=t.name, requirements=t.requirements, capacity=capacity,
                 overhead=t.overhead, offerings=offerings))
         # overlays apply LAST so price adjustments act on the live injected
         # prices, not the raw catalog's

@@ -66,18 +66,21 @@ class StandardFamily:
     def user_data(self, cfg: BootstrapConfig) -> str:
         taints = ",".join(f"{t.key}={t.value}:{t.effect}" for t in cfg.taints)
         labels = ",".join(f"{k}={v}" for k, v in sorted(cfg.labels.items()))
-        lines = [
-            "#!/bin/bash -xe",
-            f"/etc/node/bootstrap.sh --cluster '{cfg.cluster_name}' \\",
-            f"  --endpoint '{cfg.cluster_endpoint}' \\",
-            f"  --node-labels '{labels}' \\",
-            f"  --register-taints '{taints}'",
-        ]
+        # ONE command, continuations derived from the arg list — the old
+        # hand-written lines dropped the backslash before an appended
+        # --max-pods, leaving it outside the bootstrap invocation (found
+        # by the golden-userdata tests)
+        args = [f"--cluster '{cfg.cluster_name}'",
+                f"--endpoint '{cfg.cluster_endpoint}'",
+                f"--node-labels '{labels}'",
+                f"--register-taints '{taints}'"]
         if cfg.kubelet_max_pods is not None:
-            lines.append(f"  --max-pods {cfg.kubelet_max_pods}")
+            args.append(f"--max-pods {cfg.kubelet_max_pods}")
+        body = ("#!/bin/bash -xe\n/etc/node/bootstrap.sh "
+                + " \\\n  ".join(args))
         if cfg.custom_user_data:
-            return merge_mime([cfg.custom_user_data, "\n".join(lines)])
-        return "\n".join(lines)
+            return merge_mime([cfg.custom_user_data, body])
+        return body
 
 
 class DeclarativeFamily:

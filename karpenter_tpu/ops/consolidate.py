@@ -152,12 +152,16 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         # kernel is not GSPMD-partitioned — flag is inert there). A
         # failure at the REAL shape (the probe compiles a toy one) falls
         # back to the XLA path, as the pallas_screen contract promises.
-        from .pallas_screen import available as pallas_ok
+        from . import pallas_screen
         jargs = [jnp.asarray(a) for a in args]
-        if pallas_ok():
+        if pallas_screen.available():
             try:
                 packed = _screen_kernel(*jargs, use_pallas=True)
             except Exception:
+                # latch OFF: jit does not cache failed compiles, so
+                # re-attempting every screen would pay a failed Mosaic
+                # compile on each disruption cycle
+                pallas_screen._status = False
                 packed = _screen_kernel(*jargs)
         else:
             packed = _screen_kernel(*jargs)

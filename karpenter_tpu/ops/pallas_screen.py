@@ -38,8 +38,6 @@ from __future__ import annotations
 import functools
 import os
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 

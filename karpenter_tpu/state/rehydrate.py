@@ -44,7 +44,24 @@ def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0) -> Dict[str, 
             store.add_node(node)
             stats["nodes_adopted"] += 1
     nodes_by_pid = {n.provider_id: n for n in store.nodes.values()}
-    types = {t.name: t for t in catalog.raw_types()} if catalog is not None else {}
+    # capacity must come from the instance's NODECLASS view of the
+    # catalog, not the raw catalog: per-NodeClass overrides (block-device
+    # ephemeral storage) would otherwise vanish on every restart and the
+    # adopted node would appear over-committed
+    types_by_nc: Dict[str, Dict[str, object]] = {}
+
+    def types_for(nc_name: str) -> Dict[str, object]:
+        hit = types_by_nc.get(nc_name)
+        if hit is None:
+            if catalog is None:
+                hit = {}
+            else:
+                nc = store.nodeclasses.get(nc_name)
+                src = (catalog.list(nc) if nc is not None
+                       else catalog.raw_types())
+                hit = {t.name: t for t in src}
+            types_by_nc[nc_name] = hit
+        return hit
     claimed_pids = {c.provider_id for c in store.nodeclaims.values()
                     if c.provider_id}
     # 2. instances → NodeClaims via adoption tags (untagged = not ours)
@@ -56,7 +73,8 @@ def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0) -> Dict[str, 
         if not name:
             continue
         claim = _adopt(store, inst, name, nodes_by_pid.get(inst.provider_id),
-                       types, now)
+                       types_for(inst.tags.get(TAG_NODECLASS, "default")),
+                       now)
         store.add_nodeclaim(claim)
         store.record_event("nodeclaim", claim.name, "Adopted",
                            f"rehydrated from instance {inst.id}")

@@ -1,0 +1,169 @@
+"""Storage capacity from the NodeClass block device + golden userdata.
+
+Reference parity: the instancetype resolver derives a node's
+ephemeral-storage capacity from the EC2NodeClass blockDeviceMappings
+(types.go ephemeralStorage); the launchtemplate suite pins exact
+bootstrap documents as goldens (suite_test.go, 2.6k lines of them —
+substring asserts let a malformed document pass, goldens don't)."""
+
+from karpenter_tpu.cloud.image import FAMILIES, BootstrapConfig
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodeClassSpec, NodePool
+from karpenter_tpu.models.pod import Pod, Taint
+from karpenter_tpu.models.resources import EPHEMERAL_STORAGE, Resources
+from karpenter_tpu.sim import make_sim
+
+_GIB = 1024.0 ** 3
+
+
+class TestBlockDeviceStorage:
+    def test_block_device_sets_ephemeral_capacity(self):
+        sim = make_sim()
+        sim.store.add_nodeclass(NodeClassSpec(name="big",
+                                              block_device_gib=500.0))
+        small = sim.catalog.list(sim.store.nodeclasses["default"])
+        big = sim.catalog.list(sim.store.nodeclasses["big"])
+        assert all(t.capacity.get(EPHEMERAL_STORAGE) == 100.0 * _GIB
+                   for t in small)
+        assert all(t.capacity.get(EPHEMERAL_STORAGE) == 500.0 * _GIB
+                   for t in big)
+
+    def test_storage_hungry_pod_needs_bigger_block_device(self):
+        """A pod requesting more ephemeral storage than the default
+        block device stays pending; a NodeClass with a bigger device
+        schedules it — and the claim's capacity reflects the device."""
+        sim = make_sim()
+        p = sim.store.add_pod(Pod(
+            name="fat",
+            requests=Resources.parse({"cpu": "1",
+                                      EPHEMERAL_STORAGE: "150Gi"})))
+        sim.engine.run_for(15, step=1)
+        assert p.node_name is None  # 100Gi default can't hold 150Gi
+        sim.store.add_nodeclass(NodeClassSpec(name="big",
+                                              block_device_gib=400.0))
+        sim.store.add_nodepool(NodePool(name="storage", weight=10,
+                                        node_class="big"))
+        assert sim.engine.run_until(lambda: p.node_name is not None,
+                                    timeout=60)
+        claim = next(c for c in sim.store.nodeclaims.values()
+                     if c.node_name == p.node_name)
+        assert claim.capacity.get(EPHEMERAL_STORAGE) == 400.0 * _GIB
+
+
+class TestRestartKeepsBlockDeviceCapacity:
+    def test_adopted_claim_uses_nodeclass_catalog_view(self):
+        """Review finding: adoption resolved capacity from the RAW
+        catalog, so a 400Gi block-device node came back from restart
+        reporting 100Gi and its 150Gi pod looked like an overcommit."""
+        from karpenter_tpu.state.rehydrate import rehydrate
+        from karpenter_tpu.state.store import Store
+        sim = make_sim()
+        sim.store.add_nodeclass(NodeClassSpec(name="big",
+                                              block_device_gib=400.0))
+        sim.store.add_nodepool(NodePool(name="storage", weight=10,
+                                        node_class="big"))
+        p = sim.store.add_pod(Pod(
+            name="fat",
+            requests=Resources.parse({"cpu": "1",
+                                      EPHEMERAL_STORAGE: "150Gi"})))
+        assert sim.engine.run_until(lambda: p.node_name is not None,
+                                    timeout=60)
+        # operator restart: CRDs (nodeclasses) re-read first, then the
+        # fleet is adopted from the cloud's durable state
+        fresh = Store()
+        fresh.add_nodeclass(NodeClassSpec(name="big",
+                                          block_device_gib=400.0))
+        fresh.add_nodepool(NodePool(name="storage", node_class="big"))
+        rehydrate(fresh, sim.cloud, sim.catalog, sim.clock.now())
+        adopted = [c for c in fresh.nodeclaims.values()
+                   if c.node_class == "big"]
+        assert adopted
+        for c in adopted:
+            assert c.capacity.get(EPHEMERAL_STORAGE) == 400.0 * _GIB, \
+                "restart lost the block-device capacity override"
+
+
+GOLDEN_CFG = BootstrapConfig(
+    cluster_name="c1", cluster_endpoint="https://ep",
+    labels={"team": "web"},
+    taints=[Taint(key="t", value="v", effect="NoSchedule")],
+    kubelet_max_pods=58, kube_reserved={})
+
+
+class TestGoldenUserdata:
+    """Exact-document goldens: any byte drift in a bootstrap generator
+    is a node-bootstrap break, not a style change."""
+
+    def test_standard_golden(self):
+        """Every arg rides the SAME bootstrap invocation — a dropped
+        continuation before --max-pods shipped it as a separate (broken)
+        shell command until this golden pinned the document."""
+        assert FAMILIES["standard"].user_data(GOLDEN_CFG) == (
+            "#!/bin/bash -xe\n"
+            "/etc/node/bootstrap.sh --cluster 'c1' \\\n"
+            "  --endpoint 'https://ep' \\\n"
+            "  --node-labels 'team=web' \\\n"
+            "  --register-taints 't=v:NoSchedule' \\\n"
+            "  --max-pods 58")
+
+    def test_declarative_golden(self):
+        assert FAMILIES["declarative"].user_data(GOLDEN_CFG) == (
+            "apiVersion: node.karpenter.tpu/v1\n"
+            "kind: NodeConfig\n"
+            "spec:\n"
+            "  cluster:\n"
+            "    name: c1\n"
+            "    endpoint: https://ep\n"
+            "  kubelet:\n"
+            "    maxPods: 58\n"
+            "    nodeLabels:\n"
+            "      team: 'web'\n"
+            "    registerWithTaints:\n"
+            "      - key: t\n"
+            "        value: 'v'\n"
+            "        effect: NoSchedule")
+
+    def test_minimal_golden(self):
+        assert FAMILIES["minimal"].user_data(GOLDEN_CFG) == (
+            "[settings.kubernetes]\n"
+            'cluster-name = "c1"\n'
+            'api-server = "https://ep"\n'
+            "max-pods = 58\n"
+            "[settings.kubernetes.node-labels]\n"
+            '"team" = "web"\n'
+            "[settings.kubernetes.node-taints]\n"
+            '"t" = "v:NoSchedule"')
+
+    def test_imperative_golden(self):
+        assert FAMILIES["imperative"].user_data(GOLDEN_CFG) == (
+            "<script>\n"
+            "Register-Node -Cluster 'c1' -Endpoint 'https://ep'"
+            " -NodeLabels 'team=web' -Taints 't=v:NoSchedule'"
+            " -MaxPods 58\n"
+            "</script>")
+
+    def test_mime_merge_golden(self):
+        cfg = BootstrapConfig(**{**GOLDEN_CFG.__dict__,
+                                 "custom_user_data": "#!/bin/sh\necho hi"})
+        ud = FAMILIES["standard"].user_data(cfg)
+        assert ud == (
+            'Content-Type: multipart/mixed; '
+            'boundary="KARPENTER-TPU-BOUNDARY"\n'
+            "MIME-Version: 1.0\n"
+            "\n"
+            "//KARPENTER-TPU-BOUNDARY\n"
+            'Content-Type: text/x-shellscript; charset="us-ascii"\n'
+            "\n"
+            "#!/bin/sh\necho hi\n"
+            "\n"
+            "//KARPENTER-TPU-BOUNDARY\n"
+            'Content-Type: text/x-shellscript; charset="us-ascii"\n'
+            "\n"
+            "#!/bin/bash -xe\n"
+            "/etc/node/bootstrap.sh --cluster 'c1' \\\n"
+            "  --endpoint 'https://ep' \\\n"
+            "  --node-labels 'team=web' \\\n"
+            "  --register-taints 't=v:NoSchedule' \\\n"
+            "  --max-pods 58\n"
+            "\n"
+            "//KARPENTER-TPU-BOUNDARY--")
