@@ -83,22 +83,34 @@ class CatalogProvider:
             return cached
         from ..models.overlay import apply_overlays
         resolved = []
+        from ..models import labels as L
         from ..models.resources import EPHEMERAL_STORAGE, Resources
         gib = 1024.0 ** 3
         block_bytes = (nc.block_device_gib or 0.0) * gib
+        raid0 = nc.instance_store_policy == "raid0"
         for t in self.raw_types():
             offerings = self._inject_offerings(t, nc)
             if not offerings:
                 continue
             capacity = t.capacity
-            # NodeClass block-device size IS the node's ephemeral-storage
-            # capacity (reference: the instancetype resolver derives
-            # ephemeral-storage from the EC2NodeClass blockDeviceMappings,
-            # types.go ephemeralStorage); the per-NodeClass resolved cache
-            # key already covers it via nc.hash()
-            if block_bytes and capacity.get(EPHEMERAL_STORAGE) != block_bytes:
+            # ephemeral-storage capacity per NodeClass (reference
+            # types.go ephemeralStorage): instanceStorePolicy=raid0 on a
+            # type with local NVMe uses the NVMe array's size; otherwise
+            # the block-device size. The per-NodeClass resolved cache
+            # key covers both via nc.hash()
+            eph = block_bytes
+            if raid0:
+                nvme = t.requirements.get(L.INSTANCE_LOCAL_NVME)
+                if (nvme is not None and not nvme.complement
+                        and len(nvme.values) == 1):
+                    # single-valued only: a multi-valued label from a
+                    # custom backend falls back to the block device
+                    # rather than crashing the whole catalog list()
+                    (v,) = nvme.values
+                    eph = float(v) * gib
+            if eph and capacity.get(EPHEMERAL_STORAGE) != eph:
                 capacity = Resources(capacity)
-                capacity[EPHEMERAL_STORAGE] = block_bytes
+                capacity[EPHEMERAL_STORAGE] = eph
             resolved.append(InstanceType(
                 name=t.name, requirements=t.requirements, capacity=capacity,
                 overhead=t.overhead, offerings=offerings))

@@ -89,6 +89,10 @@ class NodeClassSpec:
     tags: Dict[str, str] = field(default_factory=dict)
     block_device_gib: float = 100.0
     kubelet_max_pods: Optional[int] = None
+    # instance-store policy (reference spec.instanceStorePolicy,
+    # ec2nodeclass.go:441-448): "raid0" = nodes with local NVMe expose
+    # the NVMe array as ephemeral storage instead of the block device
+    instance_store_policy: str = ""  # "" | "raid0"
     kubelet_system_reserved: Dict[str, str] = field(default_factory=dict)
     kubelet_kube_reserved: Dict[str, str] = field(default_factory=dict)
     kubelet_eviction_hard: Dict[str, str] = field(default_factory=dict)
@@ -113,6 +117,7 @@ class NodeClassSpec:
             "user_data": self.user_data,
             "tags": dict(sorted(self.tags.items())),
             "block_device_gib": self.block_device_gib,
+            "instance_store_policy": self.instance_store_policy,
             "kubelet": [self.kubelet_max_pods, dict(sorted(self.kubelet_system_reserved.items())),
                         dict(sorted(self.kubelet_kube_reserved.items())),
                         dict(sorted(self.kubelet_eviction_hard.items()))],

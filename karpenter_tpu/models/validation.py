@@ -101,6 +101,8 @@ def validate_nodeclass(nc: NodeClassSpec) -> None:
         errors.append(f"invalid nodeclass name {nc.name!r}")
     if nc.block_device_gib <= 0:
         errors.append("blockDevice size must be positive")
+    if nc.instance_store_policy not in ("", "raid0"):
+        errors.append("instanceStorePolicy must be '' or 'raid0'")
     if nc.kubelet_max_pods is not None and not 1 <= nc.kubelet_max_pods <= 1024:
         errors.append("kubelet maxPods must be in [1, 1024]")
     if nc.metadata_http_tokens not in ("required", "optional"):

@@ -50,6 +50,44 @@ class TestBlockDeviceStorage:
         assert claim.capacity.get(EPHEMERAL_STORAGE) == 400.0 * _GIB
 
 
+class TestInstanceStorePolicy:
+    def test_raid0_uses_local_nvme_size(self):
+        """instanceStorePolicy=raid0 (reference ec2nodeclass.go:441-448,
+        types.go ephemeralStorage): NVMe-carrying types expose the array
+        size as ephemeral storage; types without local disks keep the
+        block-device size."""
+        from karpenter_tpu.catalog import GeneratorConfig, generate_catalog
+        sim = make_sim(types=generate_catalog(GeneratorConfig(
+            families=["cn6", "m5"])))  # cn = nvme family, m5 = not
+        sim.store.add_nodeclass(NodeClassSpec(
+            name="local", instance_store_policy="raid0",
+            block_device_gib=100.0))
+        types = {t.name: t for t in sim.catalog.list(
+            sim.store.nodeclasses["local"])}
+        nvme = [t for n, t in types.items() if n.startswith("cn6.")]
+        plain = [t for n, t in types.items() if n.startswith("m5.")]
+        assert nvme and plain
+        for t in plain:
+            assert t.capacity.get(EPHEMERAL_STORAGE) == 100.0 * _GIB
+        from karpenter_tpu.models import labels as L
+        for t in nvme:
+            (declared,) = t.requirements.get(L.INSTANCE_LOCAL_NVME).values
+            assert t.capacity.get(EPHEMERAL_STORAGE) == float(declared) * _GIB
+
+    def test_policy_change_is_static_drift(self):
+        a = NodeClassSpec(name="x")
+        b = NodeClassSpec(name="x", instance_store_policy="raid0")
+        assert a.hash() != b.hash()
+
+    def test_policy_validated(self):
+        import pytest
+        from karpenter_tpu.models.validation import (ValidationError,
+                                                     validate_nodeclass)
+        with pytest.raises(ValidationError):
+            validate_nodeclass(NodeClassSpec(name="x",
+                                             instance_store_policy="raid5"))
+
+
 class TestRestartKeepsBlockDeviceCapacity:
     def test_adopted_claim_uses_nodeclass_catalog_view(self):
         """Review finding: adoption resolved capacity from the RAW
