@@ -103,11 +103,17 @@ class CatalogProvider:
                 nvme = t.requirements.get(L.INSTANCE_LOCAL_NVME)
                 if (nvme is not None and not nvme.complement
                         and len(nvme.values) == 1):
-                    # single-valued only: a multi-valued label from a
-                    # custom backend falls back to the block device
-                    # rather than crashing the whole catalog list()
+                    # a malformed label from a custom backend (multi-
+                    # valued, non-numeric, non-positive) falls back to
+                    # the block device rather than crashing the whole
+                    # catalog list()
                     (v,) = nvme.values
-                    eph = float(v) * gib
+                    try:
+                        size = float(v)
+                    except ValueError:
+                        size = 0.0
+                    if size > 0:
+                        eph = size * gib
             if eph and capacity.get(EPHEMERAL_STORAGE) != eph:
                 capacity = Resources(capacity)
                 capacity[EPHEMERAL_STORAGE] = eph

@@ -104,7 +104,7 @@ class DisruptionController:
                  if v.claim.nodepool == pool.name]
         if not views:
             return
-        budget_for = lambda reason: self._budget(pool, views, reason)
+        budget_for = lambda reason: self._budget(pool, views, reason, now)
         # PDB gate for voluntary disruption (reference: candidates with
         # blocking PDBs are excluded from the disruption passes).
         # disruptionsAllowed computed once per pool pass — O(pods) per
@@ -162,7 +162,7 @@ class DisruptionController:
     # --- emptiness ---
     def _empty_pass(self, pool: NodePool, views: List[NodeView],
                     now: float) -> None:
-        budget = self._budget(pool, views, "Empty")
+        budget = self._budget(pool, views, "Empty", now)
         settle = pool.disruption.consolidate_after
         for v in views:
             if budget <= 0:
@@ -322,7 +322,7 @@ class DisruptionController:
         """Binary-search the largest prefix of cost-ordered candidates whose
         pods re-solve onto the rest + at most one cheaper replacement
         (reference multi-node consolidation, disruption.md:96-103)."""
-        budget = self._budget(pool, views, "Underutilized")
+        budget = self._budget(pool, views, "Underutilized", now)
         hi = min(len(candidates), max(budget, 0))
         if hi < 2:
             return False
@@ -440,7 +440,8 @@ class DisruptionController:
                                 reason, f"replacements: {repl_names}")
 
     # --- budgets ---
-    def _budget(self, pool: NodePool, views: List[NodeView], reason: str) -> int:
+    def _budget(self, pool: NodePool, views: List[NodeView], reason: str,
+                now: Optional[float] = None) -> int:
         # in-flight drains MUST count against the budget, and views can't
         # show them — build_node_views excludes deleting claims — so read
         # the store (found by the combined-disruption budget sentinel:
@@ -454,7 +455,7 @@ class DisruptionController:
         # shrink the allowance as a roll proceeds, throttling it below
         # the configured rate
         allowed = pool.disruption.allowed_disruptions(
-            reason, len(views) + disrupting)
+            reason, len(views) + disrupting, now=now)
         # pending decisions whose victims haven't started draining yet,
         # this pool's only — another pool's roll must not starve ours
         for pd in self._pending:

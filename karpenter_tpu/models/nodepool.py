@@ -27,7 +27,7 @@ from .resources import Resources
 # re-stamps (instead of rolling the fleet) on nodes whose stored version
 # differs (reference: karpenter.k8s.aws/ec2nodeclass-hash-version,
 # ec2nodeclass.go:480 hash version v4 + the hash controller's migration).
-NODECLASS_HASH_VERSION = "v2"
+NODECLASS_HASH_VERSION = "v3"  # v3: instance_store_policy joined the blob
 
 
 @dataclass
@@ -47,6 +47,17 @@ class Budget:
     def allows(self, reason: str) -> bool:
         return self.reasons is None or reason in self.reasons
 
+    def is_active(self, now: Optional[float]) -> bool:
+        """A budget with a schedule constrains disruption only inside
+        an open cron window (reference karpenter.sh_nodepools.yaml:126);
+        schedule-less budgets are always active."""
+        if self.schedule is None:
+            return True
+        if now is None or self.duration is None:
+            return True  # window undecidable: stay conservative (active)
+        from ..utils.cron import in_window
+        return in_window(self.schedule, self.duration, now)
+
     def max_disruptions(self, total_nodes: int) -> int:
         s = self.nodes.strip()
         if s.endswith("%"):
@@ -63,8 +74,10 @@ class DisruptionSpec:
     consolidate_after: float = 0.0  # seconds; pods must be stable this long
     budgets: List[Budget] = field(default_factory=lambda: [Budget()])
 
-    def allowed_disruptions(self, reason: str, total_nodes: int) -> int:
-        vals = [b.max_disruptions(total_nodes) for b in self.budgets if b.allows(reason)]
+    def allowed_disruptions(self, reason: str, total_nodes: int,
+                            now: Optional[float] = None) -> int:
+        vals = [b.max_disruptions(total_nodes) for b in self.budgets
+                if b.allows(reason) and b.is_active(now)]
         return min(vals) if vals else total_nodes
 
 

@@ -85,6 +85,18 @@ def validate_nodepool(pool: NodePool) -> None:
                     errors.append(f"budget {s!r} must be >= 0")
             except ValueError:
                 errors.append(f"invalid budget {s!r}")
+        # reference CEL: "'schedule' must be set with 'duration'"
+        # (karpenter.sh_nodepools.yaml:140-141)
+        if (b.schedule is None) != (b.duration is None):
+            errors.append("budget schedule must be set with duration")
+        if b.schedule is not None:
+            from ..utils.cron import CronError, parse
+            try:
+                parse(b.schedule)
+            except CronError as e:
+                errors.append(f"invalid budget schedule: {e}")
+        if b.duration is not None and b.duration <= 0:
+            errors.append("budget duration must be positive")
     if pool.expire_after is not None and pool.expire_after <= 0:
         errors.append("expireAfter must be positive")
     if pool.disruption.consolidation_policy not in (

@@ -1,0 +1,102 @@
+"""Scheduled disruption-budget windows (reference NodePool budgets with
+schedule + duration, karpenter.sh_nodepools.yaml:78-160): a budget
+constrains disruption only while its cron window is open."""
+
+import time
+
+import pytest
+
+from karpenter_tpu.models.nodepool import (Budget, DisruptionSpec,
+                                           NodePool)
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.models.validation import (ValidationError,
+                                             validate_nodepool)
+from karpenter_tpu.sim import make_sim
+from karpenter_tpu.utils.cron import CronError, in_window, matches, parse
+
+
+def _epoch(y, mo, d, h, mi):
+    return time.mktime((y, mo, d, h, mi, 0, 0, 0, 0)) - time.timezone
+
+
+class TestCronMatcher:
+    def test_basic_fields(self):
+        t = _epoch(2026, 7, 29, 9, 30)  # a Wednesday
+        assert matches("30 9 * * *", t)
+        assert matches("*/15 * * * *", t)
+        assert not matches("0 9 * * *", t)
+        assert matches("30 9 29 7 *", t)
+        assert matches("30 9 * * 3", t)       # Wednesday = 3
+        assert not matches("30 9 * * 0", t)   # not Sunday
+
+    def test_ranges_lists_steps(self):
+        t = _epoch(2026, 7, 29, 14, 45)
+        assert matches("40-50 9-17 * * 1-5", t)
+        assert matches("45 8,14,20 * * *", t)
+        assert matches("15-55/10 * * * *", t)  # 15,25,35,45,55
+        assert not matches("0-40/10 * * * *", t)
+
+    def test_dom_dow_or_rule(self):
+        # July 29 2026 is a Wednesday; both fields restricted: OR
+        t = _epoch(2026, 7, 29, 0, 0)
+        assert matches("0 0 1 * 3", t)   # dom=1 misses, dow=Wed hits
+        assert matches("0 0 29 * 0", t)  # dom hits, dow misses
+        assert not matches("0 0 1 * 0", t)
+
+    def test_rejects_garbage(self):
+        for bad in ("* * * *", "61 * * * *", "a * * * *", "*/0 * * * *"):
+            with pytest.raises(CronError):
+                parse(bad)
+
+    def test_window(self):
+        start = _epoch(2026, 7, 29, 9, 0)
+        assert in_window("0 9 * * *", 3600, start + 1800)
+        assert in_window("0 9 * * *", 3600, start)
+        assert not in_window("0 9 * * *", 3600, start + 3600)
+        assert not in_window("0 9 * * *", 3600, start - 60)
+
+
+class TestBudgetWindows:
+    def test_scheduled_zero_budget_blocks_only_in_window(self):
+        """nodes:'0' during a daily window freezes drift inside it and
+        releases it outside (the reference's maintenance-freeze
+        pattern)."""
+        pool = NodePool(name="default")
+        pool.disruption = DisruptionSpec(budgets=[
+            Budget(nodes="0", schedule="0 0 * * *", duration=3600.0),
+            Budget(nodes="10")])
+        sim = make_sim(nodepool=pool)
+        pods = [sim.store.add_pod(Pod(
+            name=f"p{i}", requests=Resources.parse({"cpu": "7"})))
+            for i in range(4)]
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in pods), timeout=120)
+        old = set(sim.store.nodeclaims)
+
+        # jump the fake clock INTO the freeze window (fake epoch ~1e6;
+        # align to the next 00:00 UTC after now)
+        now = sim.clock.now()
+        next_midnight = (int(now) // 86400 + 1) * 86400
+        sim.clock.step(next_midnight - now + 60)  # 00:01, inside freeze
+        sim.store.nodeclasses["default"].user_data = "v2"  # drift all
+        sim.engine.run_for(1800, step=30)  # stays within the 1h window
+        assert set(sim.store.nodeclaims) & old == old, \
+            "drift rolled nodes inside the frozen window"
+        # leave the window: the roll proceeds under the 10-node budget
+        sim.engine.run_for(3600, step=30)
+        sim.engine.run_for(1200, step=10)
+        assert not (set(sim.store.nodeclaims) & old)
+        assert all(p.node_name for p in pods)
+
+    def test_validation(self):
+        bad = NodePool(name="x")
+        bad.disruption = DisruptionSpec(budgets=[
+            Budget(nodes="1", schedule="0 0 * * *")])  # no duration
+        with pytest.raises(ValidationError):
+            validate_nodepool(bad)
+        bad2 = NodePool(name="x")
+        bad2.disruption = DisruptionSpec(budgets=[
+            Budget(nodes="1", schedule="not cron", duration=60.0)])
+        with pytest.raises(ValidationError):
+            validate_nodepool(bad2)
