@@ -138,8 +138,24 @@ def build_operator(options: Optional[Options] = None,
 
 def main() -> None:
     runtime, _store, _cloud = build_operator()
+
+    async def _run() -> None:
+        # SIGTERM is what the kubelet sends on pod termination: a leader
+        # that dies without runtime.stop() holds its lease until expiry,
+        # stalling standby failover for the whole lease duration. Route
+        # both signals through the clean-shutdown path (which releases
+        # the lease in the elector task's finally).
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, runtime.stop)
+            except (NotImplementedError, RuntimeError):
+                pass  # platforms without unix signal support
+        await runtime.start()
+
     try:
-        asyncio.run(runtime.start())
+        asyncio.run(_run())
     except KeyboardInterrupt:
         runtime.stop()
 

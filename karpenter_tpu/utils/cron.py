@@ -78,15 +78,26 @@ def matches(expr: str, t: float) -> bool:
     return dom_ok and dow_ok
 
 
-def in_window(expr: str, duration: float, now: float) -> bool:
-    """Is `now` inside a window opened by the most recent matching
-    minute? (A window opens at every matching minute and stays open for
-    `duration` seconds.)"""
-    start_minute = (int(now) // 60) * 60
+@lru_cache(maxsize=4096)
+def _in_window_bucket(expr: str, duration: float, minute: int) -> bool:
+    start_minute = minute * 60
     for i in range(int(duration // 60) + 1):
         t = start_minute - i * 60
-        if t + duration <= now:
+        if t + duration <= start_minute:
             break
         if matches(expr, t):
             return True
     return False
+
+
+def in_window(expr: str, duration: float, now: float) -> bool:
+    """Is `now` inside a window opened by the most recent matching
+    minute? (A window opens at every matching minute and stays open for
+    `duration` seconds.) Memoized per minute: the scan is linear in
+    duration, and disruption evaluates budgets once per candidate per
+    pass — a month-long freeze must not cost 43k gmtime calls per
+    candidate. Minute granularity: a non-minute-aligned duration's
+    close rounds up to the end of its minute (cron windows are
+    minute-grained; erring open is the conservative side for a
+    freeze)."""
+    return _in_window_bucket(expr, float(duration), int(now) // 60)
