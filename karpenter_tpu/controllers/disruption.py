@@ -289,6 +289,14 @@ class DisruptionController:
         all_pods = [p for v in views for p in v.pods]
         if not all_pods:
             return candidates
+        # the screen judges other nodes' headroom — charge daemonset
+        # overhead to their allocatable exactly like the solve does
+        # (shared transform), or the screen over-admits candidates the
+        # re-solve then rejects (wasted exact solves)
+        from ..ops.facade import apply_daemonset_overhead
+        cat = apply_daemonset_overhead(
+            cat, list(self.store.daemonsets.values()), pool,
+            pool.template_labels())
         enc = encode_pods(all_pods, cat,
                           extra_requirements=pool.requirements,
                           taints=pool.taints + pool.startup_taints,

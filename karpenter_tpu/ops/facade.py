@@ -83,6 +83,23 @@ def daemonset_overhead(cat: CatalogTensors, daemonsets, nodepool: NodePool,
     return out
 
 
+def apply_daemonset_overhead(cat: CatalogTensors, daemonsets,
+                             nodepool: NodePool,
+                             template: Dict[str, str]) -> CatalogTensors:
+    """Shrink the catalog's allocatable by the pool's daemonset overhead
+    — the ONE transformation both the solve and the consolidation screen
+    apply, so their headroom views can't diverge. Returns `cat` itself
+    when nothing applies."""
+    if not daemonsets:
+        return cat
+    ovh = daemonset_overhead(cat, daemonsets, nodepool, template)
+    if ovh is None:
+        return cat
+    from dataclasses import replace as _dc_replace
+    return _dc_replace(cat, allocatable=np.maximum(
+        cat.allocatable - ovh, 0.0))
+
+
 def targets_reserved(requirements: Optional[Requirements]) -> bool:
     """Does a Requirements conjunction EXPLICITLY name the reserved
     capacity type (an In requirement listing "reserved")? This is the
@@ -281,12 +298,11 @@ class Solver:
         # run too)
         ds_fp = 0
         if daemonsets:
-            ovh = daemonset_overhead(cat, daemonsets, nodepool, template)
-            if ovh is not None:
-                from dataclasses import replace as _dc_replace
-                cat = _dc_replace(cat, allocatable=np.maximum(
-                    cat.allocatable - ovh, 0.0))
-                ds_fp = hash(ovh.tobytes())
+            reduced = apply_daemonset_overhead(cat, daemonsets, nodepool,
+                                               template)
+            if reduced is not cat:
+                cat = reduced
+                ds_fp = hash(cat.allocatable.tobytes())
         fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())

@@ -182,42 +182,6 @@ class TestBatchingCloud:
         # exponential gaps: a wiped gate would attempt ~50 flushes
         assert len(batch_calls) - first_attempts <= 12
 
-    def test_sigterm_releases_leader_lease(self):
-        """kubelet pod termination (SIGTERM) must route through the
-        clean-shutdown path so the leader's lease is released for the
-        standby — dying with the lease held stalls failover for the
-        whole lease duration."""
-        import asyncio
-        import os
-        import signal
-        from karpenter_tpu.controllers.runtime import Runtime
-        from karpenter_tpu.utils.clock import RealClock
-        from karpenter_tpu.utils.leaderelection import (Elector,
-                                                        InMemoryLeaseBackend)
-        backend = InMemoryLeaseBackend()
-        clock = RealClock()
-        elector = Elector(backend=backend, identity="replica-a")
-        runtime = Runtime(clock=clock, elector=elector)
-
-        async def drive():
-            loop = asyncio.get_running_loop()
-            loop.add_signal_handler(signal.SIGTERM, runtime.stop)
-            task = asyncio.create_task(runtime.start())
-            for _ in range(100):  # wait for leadership
-                if elector.is_leader():
-                    break
-                await asyncio.sleep(0.05)
-            assert elector.is_leader()
-            os.kill(os.getpid(), signal.SIGTERM)
-            await asyncio.wait_for(task, timeout=5)
-
-        asyncio.run(drive())
-        # lease released: a fresh replica acquires immediately, without
-        # waiting out the old lease duration
-        fresh = Elector(backend=backend, identity="replica-b")
-        fresh.tick(clock.now())
-        assert fresh.is_leader(), "lease not released on SIGTERM"
-
     def test_runtime_concurrent_reconcilers_one_wire_call(self):
         """The wired path: N controllers under the async Runtime + the
         flusher task → one TerminateInstances wire call."""
@@ -349,6 +313,42 @@ class TestOptions:
 
 
 class TestRuntime:
+    def test_sigterm_releases_leader_lease(self):
+        """kubelet pod termination (SIGTERM) must route through the
+        clean-shutdown path so the leader's lease is released for the
+        standby — dying with the lease held stalls failover for the
+        whole lease duration."""
+        import asyncio
+        import os
+        import signal
+        from karpenter_tpu.controllers.runtime import Runtime
+        from karpenter_tpu.utils.clock import RealClock
+        from karpenter_tpu.utils.leaderelection import (Elector,
+                                                        InMemoryLeaseBackend)
+        backend = InMemoryLeaseBackend()
+        clock = RealClock()
+        elector = Elector(backend=backend, identity="replica-a")
+        runtime = Runtime(clock=clock, elector=elector)
+
+        async def drive():
+            loop = asyncio.get_running_loop()
+            loop.add_signal_handler(signal.SIGTERM, runtime.stop)
+            task = asyncio.create_task(runtime.start())
+            for _ in range(100):  # wait for leadership
+                if elector.is_leader():
+                    break
+                await asyncio.sleep(0.05)
+            assert elector.is_leader()
+            os.kill(os.getpid(), signal.SIGTERM)
+            await asyncio.wait_for(task, timeout=5)
+
+        asyncio.run(drive())
+        # lease released: a fresh replica acquires immediately, without
+        # waiting out the old lease duration
+        fresh = Elector(backend=backend, identity="replica-b")
+        fresh.tick(clock.now())
+        assert fresh.is_leader(), "lease not released on SIGTERM"
+
     def test_async_runtime_drives_controllers(self):
         from karpenter_tpu.controllers.runtime import Runtime
 
