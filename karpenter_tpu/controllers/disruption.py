@@ -294,13 +294,13 @@ class DisruptionController:
         # (shared transform), or the screen over-admits candidates the
         # re-solve then rejects (wasted exact solves)
         from ..ops.facade import apply_daemonset_overhead
+        template = pool.template_labels()
         cat = apply_daemonset_overhead(
-            cat, list(self.store.daemonsets.values()), pool,
-            pool.template_labels())
+            cat, list(self.store.daemonsets.values()), pool, template)
         enc = encode_pods(all_pods, cat,
                           extra_requirements=pool.requirements,
                           taints=pool.taints + pool.startup_taints,
-                          template_labels=pool.template_labels())
+                          template_labels=template)
         if enc.G == 0:
             return candidates
         sig_to_g = {g.representative.constraint_signature(): i

@@ -362,7 +362,42 @@ def intern_pods(pods) -> None:
                 p._gid = gid
                 prelim[key] = gid
                 continue
+            # decorated pods (labels/affinity/spread/…): same prelim trick
+            # with an UNSORTED content key. Sound on hit — equal insertion-
+            # order content implies equal canonical signature — and hit by
+            # the common fleet shape (one manifest stamped N times builds
+            # every dict/list in the same order). Misses (same content,
+            # different order) just canonicalize and intern to the same gid.
+            key = (p.namespace, p.owner, tuple(p.labels.items()),
+                   tuple(p.requests.items()), tuple(p.node_selector.items()),
+                   tuple((t["key"], t["operator"], tuple(t.get("values", ())))
+                         for t in p.node_affinity),
+                   tuple((t["key"], t["operator"], tuple(t.get("values", ())),
+                          t.get("weight", 1))
+                         for t in p.preferred_node_affinity),
+                   tuple((t.key, t.operator, t.value, t.effect)
+                         for t in p.tolerations),
+                   tuple((c.topology_key, c.max_skew, c.when_unsatisfiable,
+                          None if c.label_selector is None
+                          else tuple(c.label_selector.items()))
+                         for c in p.topology_spread),
+                   tuple((t.topology_key, t.anti, t.required,
+                          tuple(t.label_selector.items()))
+                         for t in p.affinity_terms))
+            gid = prelim.get(key)
+            if gid is not None:
+                p._gid = gid
+                continue
             sig = p.constraint_signature()
+            gid = intern.get(sig)
+            if gid is None:
+                if len(intern) >= _SIG_INTERN_MAX:
+                    intern.clear()  # rotate; ids stay monotonic
+                gid = next(_next_gid)
+                intern[sig] = gid
+            p._gid = gid
+            prelim[key] = gid
+            continue
         gid = intern.get(sig)
         if gid is None:
             if len(intern) >= _SIG_INTERN_MAX:

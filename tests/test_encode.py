@@ -108,6 +108,34 @@ class TestEncodePods:
             "equal-signature pods split across intern generations must "
             "re-merge into one group")
 
+    def test_decorated_prelim_key_sound(self):
+        """intern_pods' unsorted prelim key for decorated pods: equal
+        content in a different insertion order must still land in ONE
+        group (canonicalization on prelim miss), and distinct content
+        must never merge."""
+        from karpenter_tpu.models.pod import PodAffinityTerm, intern_pods
+        a = [mk_pod(f"a-{i}") for i in range(4)]
+        for p in a:
+            p.labels = {"app": "web", "tier": "fe"}
+            p.affinity_terms = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": "web"}, anti=True)]
+        b = [mk_pod(f"b-{i}") for i in range(4)]
+        for p in b:
+            p.labels = {"tier": "fe", "app": "web"}  # reversed order
+            p.affinity_terms = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": "web"}, anti=True)]
+        c = [mk_pod(f"c-{i}") for i in range(3)]
+        for p in c:
+            p.labels = {"app": "db", "tier": "fe"}  # distinct content
+        intern_pods(a + b + c)
+        groups = group_pods(a + b + c)
+        sizes = sorted(g.count for g in groups)
+        assert len(groups) == 2 and sizes == [3, 8], (
+            "insertion-order variants of equal content must merge; "
+            "distinct content must not")
+
     def test_encoded_fields(self):
         pods = ([mk_pod(f"a-{i}") for i in range(10)] +
                 [mk_pod(f"z-{i}", node_selector={L.ZONE: "zone-b"}) for i in range(5)] +
