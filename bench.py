@@ -175,6 +175,11 @@ def main() -> None:
     detail["c4_5k_node_screen_ms"] = round(
         timeit(lambda: consolidation_screen(cat, enc4, views, counts),
                repeats=3) * 1e3, 1)
+    # honest chip time for the screen (pipelined, RTT amortized — same
+    # methodology as c5_kernel_device_ms)
+    from karpenter_tpu.ops.consolidate import screen_device_time
+    detail["c4_screen_device_ms"] = round(
+        screen_device_time(cat, enc4, views, counts) * 1e3, 2)
     # opt-in Pallas k-kernel comparison (KARPENTER_TPU_PALLAS=1 + probe):
     # reported only when the path can actually run on this rig. The
     # probe result latches in _status, so force each path through it.

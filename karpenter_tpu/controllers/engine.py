@@ -9,10 +9,12 @@ injectable clock, so tests step simulated time; the async runtime
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Protocol
 
 from ..cloud.provider import CloudError
+from ..metrics import RECONCILE_DURATION, RECONCILE_ERRORS
 
 
 class Controller(Protocol):
@@ -55,6 +57,7 @@ class Engine:
                 return
         for c in self.controllers:
             if now >= self._next_run.get(c.name, 0.0):
+                t0 = _time.perf_counter()
                 try:
                     requeue = c.reconcile(now)
                 except CloudError as e:
@@ -63,7 +66,12 @@ class Engine:
                     # way real clients do. Anything else is a bug — crash.
                     if not getattr(e, "retryable", False):
                         raise
+                    RECONCILE_ERRORS.inc(controller=c.name,
+                                         disposition="backoff")
                     requeue = 2.0
+                finally:
+                    RECONCILE_DURATION.observe(_time.perf_counter() - t0,
+                                               controller=c.name)
                 self._next_run[c.name] = now + max(0.0, requeue)
 
     def run_for(self, seconds: float, step: float = 0.5) -> None:

@@ -14,7 +14,8 @@ from typing import Optional
 
 from ..catalog.provider import CatalogProvider
 from ..metrics import (CLUSTER_NODES, CLUSTER_PODS, CLUSTER_UTILIZATION,
-                       OFFERING_AVAILABLE, OFFERING_PRICE)
+                       NODEPOOL_LIMIT, NODEPOOL_USAGE, OFFERING_AVAILABLE,
+                       OFFERING_PRICE)
 from ..state.store import Store
 
 
@@ -69,3 +70,20 @@ class CloudProviderMetricsController:
             CLUSTER_UTILIZATION.set(
                 100.0 * requested.get(k, 0.0) / total if total else 0.0,
                 resource=k)
+        # per-pool usage vs spec.limits (reference karpenter_nodepools_usage
+        # / _limit) — same accounting as the provisioner's limit gate
+        # (claim capacity summed per pool)
+        NODEPOOL_USAGE.clear()
+        NODEPOOL_LIMIT.clear()
+        usage: dict = {}
+        for claim in self.store.nodeclaims.values():
+            if claim.is_deleting():
+                continue
+            per = usage.setdefault(claim.nodepool, {})
+            for k, v in claim.capacity.items():
+                per[k] = per.get(k, 0.0) + v
+        for pool in self.store.nodepools.values():
+            for k, v in usage.get(pool.name, {}).items():
+                NODEPOOL_USAGE.set(v, nodepool=pool.name, resource=k)
+            for k, v in pool.limits.items():
+                NODEPOOL_LIMIT.set(v, nodepool=pool.name, resource=k)

@@ -14,11 +14,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..cloud.provider import CloudError
-from ..metrics import REGISTRY
+from ..metrics import (RECONCILE_DURATION, RECONCILE_ERRORS, REGISTRY)
 from ..utils.clock import RealClock
 
 log = logging.getLogger("karpenter_tpu.runtime")
@@ -76,6 +77,8 @@ class Runtime:
                 except asyncio.TimeoutError:
                     pass
                 continue
+            name = getattr(c, "name", type(c).__name__)
+            t0 = _time.perf_counter()
             try:
                 requeue = c.reconcile(self.clock.now())
             except Exception as e:
@@ -85,17 +88,22 @@ class Runtime:
                 # runtime survives, counts, and logs.
                 if isinstance(e, CloudError) and getattr(e, "retryable",
                                                          False):
-                    name = getattr(c, "name", type(c).__name__)
                     self.backoff_counts[name] = \
                         self.backoff_counts.get(name, 0) + 1
+                    RECONCILE_ERRORS.inc(controller=name,
+                                         disposition="backoff")
                     log.debug("controller %s backing off on %s", name, e)
                     requeue = 2.0
                 else:
-                    name = getattr(c, "name", type(c).__name__)
                     self.crash_counts[name] = \
                         self.crash_counts.get(name, 0) + 1
+                    RECONCILE_ERRORS.inc(controller=name,
+                                         disposition="crash")
                     log.exception("controller %s reconcile crashed", name)
                     requeue = 5.0
+            finally:
+                RECONCILE_DURATION.observe(_time.perf_counter() - t0,
+                                           controller=name)
             try:
                 await asyncio.wait_for(self._stop.wait(),
                                        timeout=max(0.01, requeue))

@@ -51,10 +51,16 @@ def build_operator(options: Optional[Options] = None,
     # every controller speaks to the batching wrapper: terminations from
     # termination+gc+lifecycle coalesce into one wire call per window,
     # describe sweeps within a window share one call (reference
-    # pkg/batcher/); the raw cloud stays the simulation/tick seam
+    # pkg/batcher/); the raw cloud stays the simulation/tick seam. The
+    # metering middleware sits BELOW the batcher — one coalesced wire
+    # call = one observation (aws-sdk-go-prometheus, operator.go:98)
     from .cloud.batcher import BatchingCloud
-    bcloud = BatchingCloud(cloud, clock)
-    catalog = CatalogProvider(lambda: cloud.describe_types(), clock=clock)
+    from .cloud.metering import MeteredCloud
+    mcloud = MeteredCloud(cloud)
+    bcloud = BatchingCloud(mcloud, clock)
+    # catalog refresh hits the wire too — meter it (DescribeInstanceTypes
+    # is the reference middleware's dominant series)
+    catalog = CatalogProvider(lambda: mcloud.describe_types(), clock=clock)
     catalog.raw_types()  # sync hydrate before controllers start
     solver = Solver(catalog, backend=opts.solver_backend,
                     profile_dir=opts.profile_dir)

@@ -9,6 +9,11 @@ Parity map (reference website/docs reference/metrics.md):
   karpenter_pods_*                  -> pods_scheduled/unschedulable
   batcher histograms (pkg/batcher/metrics.go) -> batch_size
   interruption messages             -> interruption_messages
+  controller-runtime workqueue/reconcile families -> reconcile_duration/
+                                       reconcile_errors (both drivers)
+  aws-sdk-go-prometheus middleware (operator.go:98) -> cloud_api_duration/
+                                       cloud_api_errors (cloud/metering.py)
+  karpenter_nodepools_usage/_limit  -> nodepool_usage / nodepool_limit
 """
 
 from .registry import (Counter, Gauge, Histogram, Registry, DEFAULT_BUCKETS)
@@ -69,5 +74,35 @@ CLUSTER_UTILIZATION = REGISTRY.gauge(
 BATCH_SIZE = REGISTRY.histogram(
     "karpenter_tpu_cloud_batcher_batch_size", "requests per wire call",
     ("op",), buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500))
+RECONCILE_DURATION = REGISTRY.histogram(
+    "karpenter_tpu_controller_reconcile_duration_seconds",
+    "Per-controller reconcile pass wall time (the controller-runtime "
+    "workqueue/reconcile families, reference metrics.md workqueue group)",
+    ("controller",),
+    buckets=(.0005, .001, .005, .01, .05, .1, .5, 1, 5, 30))
+RECONCILE_ERRORS = REGISTRY.counter(
+    "karpenter_tpu_controller_reconcile_errors_total",
+    "Reconcile passes that raised, by disposition (backoff = retryable "
+    "cloud throttle, crash = survived unexpected error)",
+    ("controller", "disposition"))
+CLOUD_API_DURATION = REGISTRY.histogram(
+    "karpenter_tpu_cloudprovider_api_duration_seconds",
+    "Wire-level cloud API call duration (the aws-sdk-go-prometheus "
+    "middleware the reference wires at operator.go:98; sits BELOW the "
+    "batcher, so one coalesced wire call = one observation)",
+    ("method",),
+    buckets=(.0005, .001, .005, .01, .05, .1, .5, 1, 5))
+CLOUD_API_ERRORS = REGISTRY.counter(
+    "karpenter_tpu_cloudprovider_api_errors_total",
+    "Wire-level cloud API errors (raised, or returned in-band by "
+    "create_fleet), by exception class", ("method", "error"))
+NODEPOOL_USAGE = REGISTRY.gauge(
+    "karpenter_nodepool_usage",
+    "Resources consumed by a NodePool's claims (reference "
+    "karpenter_nodepools_usage)", ("nodepool", "resource"))
+NODEPOOL_LIMIT = REGISTRY.gauge(
+    "karpenter_nodepool_limit",
+    "A NodePool's spec.limits (reference karpenter_nodepools_limit)",
+    ("nodepool", "resource"))
 
 __all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram"]

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .encode import (CatalogTensors, EncodedPods, align_resources,
-                     build_conflicts, feasible_zones)
+                     align_zone_overhead, build_conflicts, feasible_zones)
 
 BIG = 10**9
 
@@ -311,6 +311,9 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
     alloc = align_resources(cat.allocatable, R)
     avail = cat.available  # [T, Z, C]
     price = cat.price
+    # zone-varying daemonset reservation: a node charges the elementwise
+    # max over its remaining zone mask (narrowing zones restores headroom)
+    zovh = align_zone_overhead(cat, R)
 
     for n in (existing or []):
         assert len(n.cum) <= R, (
@@ -351,7 +354,12 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
             cmask = n.cap_mask & enc.allow_cap[g]
             if not (avail[t] & zmask[:, None] & cmask[None, :]).any():
                 continue
-            take = min(_fit_count(alloc[t], n.cum, req),
+            alloc_t = alloc[t]
+            if zovh is not None:
+                # post-take zone mask (zmask): taking the pod commits the
+                # node to it, so the reservation maxes over exactly those
+                alloc_t = alloc_t - zovh[t][zmask].max(axis=0)
+            take = min(_fit_count(alloc_t, n.cum, req),
                        cap_per_node - n.prior_by_group.get(g, 0)
                        - n.pods_by_group.get(g, 0), rem)
             if take < 1:
@@ -369,8 +377,15 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
                & enc.allow_zone[g][None, :, None]
                & enc.allow_cap[g][None, None, :])
         with_req = np.where(req > 0, req, np.float32(1.0))
+        alloc_eff = alloc
+        if zovh is not None:
+            # a new node's zone mask becomes gzone & type-available zones;
+            # reserve the max over exactly those (same as the kernel)
+            zm_open = enc.allow_zone[g][None, :] & avail.any(axis=2)  # [T, Z]
+            alloc_eff = alloc - np.where(zm_open[:, :, None], zovh,
+                                         np.float32(0.0)).max(axis=1)
         slots_t = np.where(req[None, :] > 0,
-                           np.floor(alloc / with_req[None, :] + EPS),
+                           np.floor(alloc_eff / with_req[None, :] + EPS),
                            np.float32(BIG)).min(axis=1)
         slots_t = np.minimum(np.maximum(slots_t, 0.0).astype(np.int64), cap_per_node)
         feasible = adm & (slots_t[:, None, None] >= 1)
@@ -429,6 +444,7 @@ def validate_solution(cat: CatalogTensors, enc: EncodedPods,
     errors = []
     R = enc.requests.shape[1]
     alloc = align_resources(cat.allocatable, R)
+    zovh = align_zone_overhead(cat, R)
     placed_per_group: Dict[int, int] = {}
     for idx, n in enumerate(result.nodes):
         t = n.type_idx
@@ -453,8 +469,13 @@ def validate_solution(cat: CatalogTensors, enc: EncodedPods,
                 errors.append(f"node {idx}: group {g} zone constraint violated")
             if not (n.cap_mask & enc.allow_cap[g]).any():
                 errors.append(f"node {idx}: group {g} capacity-type constraint violated")
-        # final cum (prior occupancy + this solve) must fit the committed type
-        if np.any(n.cum[: alloc.shape[1]] > alloc[t] + 2e-3):
+        # final cum (prior occupancy + this solve) must fit the committed
+        # type, minus the zone-varying daemonset reservation the node's
+        # final zone mask still exposes it to
+        cap_t = alloc[t]
+        if zovh is not None and n.zone_mask.any():
+            cap_t = cap_t - zovh[t][n.zone_mask].max(axis=0)
+        if np.any(n.cum[: alloc.shape[1]] > cap_t + 2e-3):
             errors.append(f"node {idx}: over capacity on {cat.names[t]}")
         if not (cat.available[t] & n.zone_mask[:, None] & n.cap_mask[None, :]).any():
             errors.append(f"node {idx}: no available offering survives masks")

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from .binpack import BIG, EPS, VirtualNode
-from .encode import CatalogTensors, EncodedPods, align_resources
+from .encode import (CatalogTensors, EncodedPods, align_resources,
+                     align_zone_overhead)
 
 
 def _screen_kernel_impl(alloc, avail, node_type, node_cum, node_zmask,
@@ -105,21 +106,32 @@ def _mesh_screen_fn(mesh):
     return fn
 
 
-def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
-                         views: "List",
-                         group_counts: np.ndarray,
-                         mesh=None) -> Tuple[np.ndarray, np.ndarray]:
-    """views: NodeView list; group_counts [N, G] = pods of group g on node n.
-    Returns (screen [N] bool, slack [N, G]).
+def screen_device_time(cat: CatalogTensors, enc: EncodedPods, views,
+                       group_counts: np.ndarray, iters: int = 40) -> float:
+    """Pipelined device time per screen call, in seconds — `iters`
+    dispatches, one block (the honest chip-time measurement on a
+    tunneled TPU, same methodology as solver.kernel_device_time)."""
+    import time
 
-    mesh: shard the candidate-node axis across the mesh's chips (inactive
-    padding rows make N divisible); the production multi-chip path for
-    large-cluster consolidation."""
+    args = tuple(jnp.asarray(a)
+                 for a in _screen_args(cat, enc, views, group_counts))
+    _screen_kernel(*args).block_until_ready()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = _screen_kernel(*args)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def _screen_args(cat: CatalogTensors, enc: EncodedPods, views,
+                 group_counts: np.ndarray, Np: int = 0):
+    """Numpy screen inputs (padded to Np rows when Np > N) — the ONE
+    construction the production path and the bench's device-time seam
+    share, so the published timing can't drift from production shapes."""
     R = enc.requests.shape[1]
     N = len(views)
-    if N == 0:
-        return np.zeros(0, bool), np.zeros((0, enc.G), np.float32)
-    Np = N if mesh is None else -(-N // int(mesh.size)) * int(mesh.size)
+    Np = max(Np, N)
     alloc = align_resources(cat.allocatable, R)
     node_type = np.zeros(Np, np.int32)
     node_cum = np.zeros((Np, R), np.float32)
@@ -130,13 +142,38 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         node_cum[i, : len(v.virtual.cum)] = v.virtual.cum
         node_zmask[i] = v.virtual.zone_mask
         node_cmask[i] = v.virtual.cap_mask
+    zovh = align_zone_overhead(cat, R)
+    if zovh is not None:
+        # zone-varying daemonset reservation: charge each node's headroom
+        # with the max over its zone mask (host-side — the kernel then
+        # sees it as consumed capacity, same as the solve's view)
+        node_cum = node_cum + np.where(
+            node_zmask[:, :, None], zovh[node_type], np.float32(0.0)
+        ).max(axis=1)
     active = np.zeros(Np, bool)
     active[:N] = True
     counts = group_counts if Np == N else np.concatenate(
         [group_counts, np.zeros((Np - N, enc.G), group_counts.dtype)])
-    args = (alloc, cat.available, node_type, node_cum, node_zmask, node_cmask,
-            active, enc.requests.astype(np.float32), enc.compat,
+    return (alloc, cat.available, node_type, node_cum, node_zmask,
+            node_cmask, active, enc.requests.astype(np.float32), enc.compat,
             enc.allow_zone, enc.allow_cap, counts)
+
+
+def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
+                         views: "List",
+                         group_counts: np.ndarray,
+                         mesh=None) -> Tuple[np.ndarray, np.ndarray]:
+    """views: NodeView list; group_counts [N, G] = pods of group g on node n.
+    Returns (screen [N] bool, slack [N, G]).
+
+    mesh: shard the candidate-node axis across the mesh's chips (inactive
+    padding rows make N divisible); the production multi-chip path for
+    large-cluster consolidation."""
+    N = len(views)
+    if N == 0:
+        return np.zeros(0, bool), np.zeros((0, enc.G), np.float32)
+    Np = N if mesh is None else -(-N // int(mesh.size)) * int(mesh.size)
+    args = _screen_args(cat, enc, views, group_counts, Np=Np)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         nodes_sh = NamedSharding(mesh, P("nodes"))

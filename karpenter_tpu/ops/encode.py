@@ -98,6 +98,15 @@ class CatalogTensors:
     # — blocks only serve launches that explicitly target reserved
     # capacity; the facade masks these out of `available` otherwise)
     is_block: Optional[np.ndarray] = None
+    # f32 [T, Z, R]: zone-VARYING daemonset reservation (zone-pinned
+    # daemonsets that only partially overlap the pool's zones). A node
+    # reserves the elementwise max over its remaining zone mask, so a
+    # node whose zones narrow away from the daemonset's zones gets its
+    # headroom back — more accurate than the reference, which charges
+    # any template-compatible daemonset unconditionally (core scheduler
+    # daemonset simulation). Zone-invariant overhead is baked into
+    # `allocatable` instead (apply_daemonset_overhead). None = absent.
+    zone_overhead: Optional[np.ndarray] = None
 
     @property
     def T(self) -> int:
@@ -571,6 +580,17 @@ def feasible_zones(enc: EncodedPods, cat: CatalogTensors, i: int,
     per_zone = (cat.available & ok_t[:, None, None]
                 & cap[None, None, :]).any(axis=(0, 2))
     return per_zone & zone_mask
+
+
+def align_zone_overhead(cat: CatalogTensors, R: int) -> "Optional[np.ndarray]":
+    """cat.zone_overhead ([T, Z, R_cat]) zero-padded to R resource columns,
+    or None when absent — the shared accessor every backend uses."""
+    z = cat.zone_overhead
+    if z is None:
+        return None
+    if z.shape[2] >= R:
+        return z
+    return np.pad(z, ((0, 0), (0, 0), (0, R - z.shape[2])))
 
 
 def align_resources(alloc: np.ndarray, R: int) -> np.ndarray:

@@ -39,37 +39,48 @@ MAX_OVERRIDES = 60  # reference MaxInstanceTypes (instance.go:62)
 _MESH_UNSET = object()
 
 
-def daemonset_overhead(cat: CatalogTensors, daemonsets, nodepool: NodePool,
-                       template: Dict[str, str]) -> Optional[np.ndarray]:
-    """f32 [T, R]: per-instance-type resource reservation for daemonset
-    pods that would run on this pool's nodes (reference core: the
-    scheduler adds daemonset pods to every virtual node before placing
-    workloads). Per-type, not per-pool: a gpu-selector daemonset
-    reserves only on gpu-carrying types. Each compatible daemonset also
-    consumes one pod slot. Returns None when nothing applies."""
+def _daemonset_overhead_parts(
+        cat: CatalogTensors, daemonsets, nodepool: NodePool,
+        template: Dict[str, str],
+        ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """(base [T, R], zone_var [T, Z, R]) daemonset reservations.
+
+    base: daemonsets that run in EVERY zone this pool's nodes can land
+    in (no zone selector, or full overlap with the pool's zones) — a
+    flat per-type reservation the solve bakes into allocatable.
+    zone_var: zone-pinned daemonsets whose zones only PARTIALLY overlap
+    the pool's — reserved per (type, zone); a node charges the
+    elementwise max over its remaining zone mask, so nodes whose zones
+    narrow away from the daemonset get their headroom back (the
+    reference charges any template-compatible daemonset on every
+    virtual node — core scheduler daemonset simulation — so this is
+    strictly tighter packing at equal safety).
+
+    Per-type, not per-pool: a gpu-selector daemonset reserves only on
+    gpu-carrying types. Each compatible daemonset also consumes one pod
+    slot. Either part is None when nothing applies."""
     from ..models.pod import tolerates_all
     from ..models.resources import PODS, Resources
     from .encode import compat_mask
     taints = nodepool.taints + nodepool.startup_taints
     pool_zvs = nodepool.requirements.get(L.ZONE)
+    pool_zones = [z for z in cat.zones
+                  if pool_zvs is None or pool_zvs.contains(z)]
     R = cat.allocatable.shape[1]
-    out = None
+    base = None
+    zvar = None
     for ds in daemonsets:
         if taints and not tolerates_all(ds.tolerations, taints):
             continue
         reqs = ds.scheduling_requirements()
-        # zone-keyed selectors: the overhead tensor is per-TYPE, not
-        # per-offering, so a zone-pinned daemonset is skipped when its
-        # zones can't intersect the pool's; on partial overlap it is
-        # reserved everywhere — conservative (may under-pack a
-        # multi-zone pool slightly, never overcommits a node)
         ds_zvs = reqs.get(L.ZONE)
+        partial = None  # zone indices, when only partially overlapping
         if ds_zvs is not None:
-            possible = [z for z in cat.zones
-                        if ds_zvs.contains(z)
-                        and (pool_zvs is None or pool_zvs.contains(z))]
+            possible = [z for z in pool_zones if ds_zvs.contains(z)]
             if not possible:
                 continue
+            if len(possible) < len(pool_zones):
+                partial = [cat.zones.index(z) for z in possible]
         mask = compat_mask(reqs, cat, template)
         if not mask.any():
             continue
@@ -77,27 +88,48 @@ def daemonset_overhead(cat: CatalogTensors, daemonsets, nodepool: NodePool,
         v = np.zeros(R, np.float32)
         n = min(len(vec), R)
         v[:n] = vec[:n]
-        if out is None:
-            out = np.zeros((cat.T, R), np.float32)
-        out[mask] += v
-    return out
+        if partial is None:
+            if base is None:
+                base = np.zeros((cat.T, R), np.float32)
+            base[mask] += v
+        else:
+            if zvar is None:
+                zvar = np.zeros((cat.T, cat.Z, R), np.float32)
+            for zi in partial:
+                zvar[mask, zi] += v
+    return base, zvar
+
+
+def daemonset_overhead(cat: CatalogTensors, daemonsets, nodepool: NodePool,
+                       template: Dict[str, str]) -> Optional[np.ndarray]:
+    """f32 [T, R]: the zone-INVARIANT per-instance-type daemonset
+    reservation (reference core: the scheduler adds daemonset pods to
+    every virtual node before placing workloads). Zone-pinned daemonsets
+    with partial pool overlap are excluded here — they live on
+    CatalogTensors.zone_overhead (see _daemonset_overhead_parts).
+    Returns None when nothing applies."""
+    base, _ = _daemonset_overhead_parts(cat, daemonsets, nodepool, template)
+    return base
 
 
 def apply_daemonset_overhead(cat: CatalogTensors, daemonsets,
                              nodepool: NodePool,
                              template: Dict[str, str]) -> CatalogTensors:
-    """Shrink the catalog's allocatable by the pool's daemonset overhead
-    — the ONE transformation both the solve and the consolidation screen
-    apply, so their headroom views can't diverge. Returns `cat` itself
-    when nothing applies."""
+    """Shrink the catalog's allocatable by the pool's zone-invariant
+    daemonset overhead and attach the zone-varying part as
+    `zone_overhead` — the ONE transformation both the solve and the
+    consolidation screen apply, so their headroom views can't diverge.
+    Returns `cat` itself when nothing applies."""
     if not daemonsets:
         return cat
-    ovh = daemonset_overhead(cat, daemonsets, nodepool, template)
-    if ovh is None:
+    base, zvar = _daemonset_overhead_parts(cat, daemonsets, nodepool,
+                                           template)
+    if base is None and zvar is None:
         return cat
     from dataclasses import replace as _dc_replace
-    return _dc_replace(cat, allocatable=np.maximum(
-        cat.allocatable - ovh, 0.0))
+    alloc = (np.maximum(cat.allocatable - base, 0.0)
+             if base is not None else cat.allocatable)
+    return _dc_replace(cat, allocatable=alloc, zone_overhead=zvar)
 
 
 def targets_reserved(requirements: Optional[Requirements]) -> bool:
@@ -302,7 +334,9 @@ class Solver:
                                                template)
             if reduced is not cat:
                 cat = reduced
-                ds_fp = hash(cat.allocatable.tobytes())
+                ds_fp = hash((cat.allocatable.tobytes(),
+                              None if cat.zone_overhead is None
+                              else cat.zone_overhead.tobytes()))
         fits_cap = None
         if capacity_cap is not None:
             types = self.catalog.list(node_class or NodeClassSpec())
@@ -333,8 +367,18 @@ class Solver:
                 banned_groups=vn.banned_groups,
                 existing_name=vn.existing_name) for vn in (existing or [])]
             existing_pods = dict(existing_pods or {})
+            cat_plan = cat
+            if cat.zone_overhead is not None:
+                # the planner sizes concrete bundle nodes host-side;
+                # give it the conservative (max-over-zones) reservation
+                from dataclasses import replace as _dc_replace
+                cat_plan = _dc_replace(
+                    cat, allocatable=np.maximum(
+                        cat.allocatable - cat.zone_overhead.max(axis=1),
+                        0.0),
+                    zone_overhead=None)
             plan = plan_colocation(
-                pods, cat, extra_requirements=nodepool.requirements,
+                pods, cat_plan, extra_requirements=nodepool.requirements,
                 taints=nodepool.taints + nodepool.startup_taints,
                 existing=existing, existing_pods=existing_pods,
                 type_cap=fits_cap, template_labels=template)
@@ -409,6 +453,10 @@ class Solver:
         from ..utils.profiling import maybe_trace
         t0 = _time.perf_counter()
         backend = self._resolve_backend(int(enc.counts.sum()))
+        if backend == "native" and cat.zone_overhead is not None:
+            # the C++ FFD takes a flat [T, R] allocatable; zone-varying
+            # reservations need the masked-max path — host oracle instead
+            backend = "host"
         with maybe_trace(self.profile_dir):
             if backend == "host":
                 result = solve_host(cat, enc, existing)

@@ -44,21 +44,27 @@ class DeviceCatalog:
     alloc: jax.Array      # f32 [T, R]
     price: jax.Array      # f32 [T, Z, C]
     avail: jax.Array      # bool [T, Z, C]
+    # f32 [T, Z, R] zone-varying daemonset reservation, or a [1, 1, R]
+    # zero dummy when absent (the static zone_ovh flag compiles it out)
+    ovh_z: Optional[jax.Array] = None
 
 
 def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
     """mesh: replicate the catalog over the mesh's devices (the sharded
     solve reads it on every chip) instead of committing to device 0."""
+    from .encode import align_zone_overhead
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         rep = NamedSharding(mesh, P())
         put = lambda x: jax.device_put(np.asarray(x), rep)
     else:
         put = jnp.asarray
+    zovh = align_zone_overhead(cat, R)
     return DeviceCatalog(
         alloc=put(align_resources(cat.allocatable, R)),
         price=put(cat.price),
         avail=put(cat.available),
+        ovh_z=put(zovh) if zovh is not None else None,
     )
 
 
@@ -67,11 +73,12 @@ def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("n_max", "track_conflicts"))
+@partial(jax.jit, static_argnames=("n_max", "track_conflicts", "zone_ovh"))
 def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
                   allow_cap, max_per_node, prior_counts, banned, conflict,
-                  node_type, node_cum, node_zmask, node_cmask, node_open,
-                  n_used, n_max: int, track_conflicts: bool = False):
+                  zovh, node_type, node_cum, node_zmask, node_cmask,
+                  node_open, n_used, n_max: int, track_conflicts: bool = False,
+                  zone_ovh: bool = False):
     """scan over G groups; returns final node state + per-(g,n) take matrix
     + per-group unschedulable counts.
 
@@ -80,7 +87,13 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
     conflict + track_conflicts: cross-group anti-affinity. When the static
     flag is False (no group has anti terms — the common case) the per-step
     [N, G] hosted bookkeeping is compiled out entirely; conflict is then a
-    [G, 1] dummy."""
+    [G, 1] dummy.
+    zovh + zone_ovh: zone-varying daemonset reservation [T, Z, R] — a node
+    charges the elementwise max over its (post-take) zone mask, so zones
+    narrowing away from a zone-pinned daemonset restore headroom. When the
+    static flag is False (no partial-overlap daemonset — the common case)
+    the per-step [N, Z, R] gather is compiled out; zovh is a [1, 1, R]
+    dummy."""
 
     T, Z, C = price.shape
     R = alloc.shape[1]
@@ -96,7 +109,15 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
         cap_per = jnp.where(cap_per == 0, BIG, cap_per).astype(jnp.int32)
 
         # --- 1. fill existing nodes (vectorized first-fit) ---
+        zmask2 = zmask & gzone[None, :]                 # [N, Z]
+        cmask2 = cmask & gcap[None, :]                  # [N, C]
         talloc = alloc[ntype]                           # [N, R]
+        if zone_ovh:
+            # post-take zone mask: taking the pod commits the node to
+            # zmask2, so the reservation maxes over exactly those zones
+            ovh_n = jnp.where(zmask2[:, :, None], zovh[ntype],
+                              0.0).max(axis=1)          # [N, R]
+            talloc = talloc - ovh_n
         headroom = talloc - cum                         # [N, R]
         # max pods of this group per node by capacity
         with_req = jnp.where(req > 0, req, 1.0)
@@ -105,8 +126,6 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
                           jnp.asarray(BIG, jnp.float32)).min(axis=1)
         k_cap = jnp.maximum(k_cap, 0.0).astype(jnp.int32)   # [N]
         # eligibility: open, type-compatible, masks intersect an available offering
-        zmask2 = zmask & gzone[None, :]                 # [N, Z]
-        cmask2 = cmask & gcap[None, :]                  # [N, C]
         off_ok = jnp.einsum("nz,nc,nzc->n", zmask2, cmask2,
                             avail[ntype], preferred_element_type=jnp.float32) > 0
         eligible = nopen & gcompat[ntype] & off_ok & ~banned_n
@@ -135,8 +154,15 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
         # --- 2. open new nodes at the cost-per-slot argmin offering ---
         adm = (avail & gcompat[:, None, None] & gzone[None, :, None]
                & gcap[None, None, :])                   # [T, Z, C]
+        alloc_eff = alloc
+        if zone_ovh:
+            # a new node's zone mask becomes gzone & type-available zones;
+            # reserve the max over exactly those (host oracle mirrors)
+            zm_open = gzone[None, :] & avail.any(axis=2)   # [T, Z]
+            alloc_eff = alloc - jnp.where(zm_open[:, :, None], zovh,
+                                          0.0).max(axis=1)
         slots_t = jnp.where(req > 0,
-                            jnp.floor(alloc / with_req[None, :] + EPS),
+                            jnp.floor(alloc_eff / with_req[None, :] + EPS),
                             jnp.asarray(BIG, jnp.float32)).min(axis=1)
         slots_t = jnp.minimum(jnp.maximum(slots_t, 0.0).astype(jnp.int32), cap_per)  # [T]
         feasible = adm & (slots_t[:, None, None] >= 1)
@@ -188,9 +214,11 @@ def _solve_kernel(alloc, price, avail, requests, counts, compat, allow_zone,
 
 def _solve_kernel_packed_impl(alloc, price, avail, requests, counts, compat,
                               allow_zone, allow_cap, max_per_node, prior_counts,
-                              banned, conflict, node_type, node_cum, node_zmask,
-                              node_cmask, node_open, n_used, n_max: int,
-                              k_max: int, track_conflicts: bool = False):
+                              banned, conflict, zovh, node_type, node_cum,
+                              node_zmask, node_cmask, node_open, n_used,
+                              n_max: int, k_max: int,
+                              track_conflicts: bool = False,
+                              zone_ovh: bool = False):
     """Kernel + single-buffer output packing.
 
     The deployment TPU sits behind a network tunnel where every host read
@@ -208,9 +236,9 @@ def _solve_kernel_packed_impl(alloc, price, avail, requests, counts, compat,
     """
     out = _solve_kernel(alloc, price, avail, requests, counts, compat,
                         allow_zone, allow_cap, max_per_node, prior_counts,
-                        banned, conflict, node_type, node_cum, node_zmask,
-                        node_cmask, node_open, n_used, n_max=n_max,
-                        track_conflicts=track_conflicts)
+                        banned, conflict, zovh, node_type, node_cum,
+                        node_zmask, node_cmask, node_open, n_used, n_max=n_max,
+                        track_conflicts=track_conflicts, zone_ovh=zone_ovh)
     ntype, _cum, _zm, _cm, _no, nused, takes, unsched, overflow = out
     flat = takes.reshape(-1)
     nnz = jnp.sum(flat > 0)
@@ -227,7 +255,8 @@ def _solve_kernel_packed_impl(alloc, price, avail, requests, counts, compat,
 
 
 _solve_kernel_packed = partial(
-    jax.jit, static_argnames=("n_max", "k_max", "track_conflicts")
+    jax.jit, static_argnames=("n_max", "k_max", "track_conflicts",
+                              "zone_ovh")
 )(_solve_kernel_packed_impl)
 
 
@@ -238,21 +267,22 @@ _mesh_fn_cache: dict = {}
 _MESH_FN_CACHE_MAX = 32
 
 
-def _mesh_packed_fn(mesh, n_max: int, k_max: int, track: bool):
+def _mesh_packed_fn(mesh, n_max: int, k_max: int, track: bool,
+                    zone_ovh: bool = False):
     """jit the packed kernel for a node-axis-sharded mesh run. Inputs are
     device_put with explicit shardings by the caller; GSPMD propagates them
     through the scan and inserts the ICI collectives (cumsum/argmin/sum
     reductions over the node axis). The packed output replicates — it's a
     small int32 vector read once by the host."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    key = (mesh, n_max, k_max, track)
+    key = (mesh, n_max, k_max, track, zone_ovh)
     fn = _mesh_fn_cache.get(key)
     if fn is None:
         if len(_mesh_fn_cache) >= _MESH_FN_CACHE_MAX:
             _mesh_fn_cache.clear()
         fn = jax.jit(
             partial(_solve_kernel_packed_impl, n_max=n_max, k_max=k_max,
-                    track_conflicts=track),
+                    track_conflicts=track, zone_ovh=zone_ovh),
             out_shardings=NamedSharding(mesh, P()))
         _mesh_fn_cache[key] = fn
     return fn
@@ -353,7 +383,7 @@ def kernel_args(cat: CatalogTensors, enc: EncodedPods,
     solve_device's input prep; results equivalence is covered by the golden
     tests comparing solve_device to the host oracle.
 
-    Returns (args_tuple, n_max, k_max, track_conflicts)."""
+    Returns (args_tuple, n_max, k_max, track_conflicts, zone_ovh)."""
     R = enc.requests.shape[1]
     Gp = _bucket(enc.G, 8)
     if dcat is None or dcat.alloc.shape[1] != R:
@@ -361,6 +391,9 @@ def kernel_args(cat: CatalogTensors, enc: EncodedPods,
     n_max = _auto_node_budget(cat, enc, 0)
     k_max = _bucket(2 * n_max)
     track = enc.conflict is not None
+    zone_ovh = dcat.ovh_z is not None
+    zovh = (dcat.ovh_z if zone_ovh
+            else jnp.zeros((1, 1, R), jnp.float32))
     conflict = (_pad_to(_pad_to(enc.conflict, Gp, 0), Gp, 1) if track
                 else np.zeros((Gp, 1), bool))
     args = ((dcat.alloc, dcat.price, dcat.avail)
@@ -368,13 +401,14 @@ def kernel_args(cat: CatalogTensors, enc: EncodedPods,
             + (jnp.asarray(np.zeros((Gp, 1), np.int32)),
                jnp.asarray(np.zeros((Gp, 1), bool)),
                jnp.asarray(conflict),
+               zovh,
                jnp.asarray(np.zeros(n_max, np.int32)),
                jnp.asarray(np.zeros((n_max, R), np.float32)),
                jnp.asarray(np.zeros((n_max, cat.Z), bool)),
                jnp.asarray(np.zeros((n_max, cat.C), bool)),
                jnp.asarray(np.zeros(n_max, bool)),
                jnp.asarray(0, jnp.int32)))
-    return args, n_max, k_max, track
+    return args, n_max, k_max, track, zone_ovh
 
 
 def kernel_device_time(cat: CatalogTensors, enc: EncodedPods,
@@ -386,14 +420,15 @@ def kernel_device_time(cat: CatalogTensors, enc: EncodedPods,
     (~70 ms measured), so per-call amortization is the only honest way to
     report what the chip itself spends."""
     import time
-    args, n_max, k_max, track = kernel_args(cat, enc)
+    args, n_max, k_max, track, zone_ovh = kernel_args(cat, enc)
     _solve_kernel_packed(*args, n_max=n_max, k_max=k_max,
-                         track_conflicts=track).block_until_ready()
+                         track_conflicts=track,
+                         zone_ovh=zone_ovh).block_until_ready()
     t0 = time.perf_counter()
     out = None
     for _ in range(iters):
         out = _solve_kernel_packed(*args, n_max=n_max, k_max=k_max,
-                                   track_conflicts=track)
+                                   track_conflicts=track, zone_ovh=zone_ovh)
     out.block_until_ready()
     return (time.perf_counter() - t0) / iters
 
@@ -426,7 +461,8 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
         n_max = -(-n_max // ms) * ms  # shardable node axis
     Gp = _bucket(G, 8)
 
-    if dcat is None or dcat.alloc.shape[1] != R:
+    if (dcat is None or dcat.alloc.shape[1] != R
+            or (dcat.ovh_z is not None) != (cat.zone_overhead is not None)):
         dcat = device_catalog(cat, R, mesh=mesh)
 
     # pad group inputs; padded groups have count 0 → no-ops in the scan
@@ -449,6 +485,9 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
         node_open[i] = True
 
     track = enc.conflict is not None
+    zone_ovh = dcat.ovh_z is not None
+    zovh = (dcat.ovh_z if zone_ovh
+            else jnp.zeros((1, 1, R), jnp.float32))
     conflict = (_pad_to(_pad_to(enc.conflict, Gp, 0), Gp, 1) if track
                 else np.zeros((Gp, 1), bool))
     # prior occupancy / resident bans exist only when existing nodes carry
@@ -476,7 +515,7 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
             rep_sh = NamedSharding(mesh, P())
             gn_sh = NamedSharding(mesh, P(None, "nodes"))
             put = jax.device_put
-            packed = _mesh_packed_fn(mesh, n_max, k_max, track)(
+            packed = _mesh_packed_fn(mesh, n_max, k_max, track, zone_ovh)(
                 dcat.alloc, dcat.price, dcat.avail,
                 put(requests, rep_sh), put(counts, rep_sh),
                 put(compat, rep_sh), put(allow_zone, rep_sh),
@@ -484,6 +523,7 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
                 put(prior, gn_sh if has_prior else rep_sh),
                 put(banned, gn_sh if has_banned else rep_sh),
                 put(conflict, rep_sh),
+                zovh if zone_ovh else put(np.asarray(zovh), rep_sh),
                 put(_pad_to(node_type, n_max), nodes_sh),
                 put(_pad_to(node_cum, n_max), nodes_sh),
                 put(_pad_to(node_zmask, n_max), nodes_sh),
@@ -494,14 +534,14 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
             packed = _solve_kernel_packed(
                 dcat.alloc, dcat.price, dcat.avail, requests, counts,
                 compat, allow_zone, allow_cap, max_per_node, jnp.asarray(prior),
-                jnp.asarray(banned), jnp.asarray(conflict),
+                jnp.asarray(banned), jnp.asarray(conflict), zovh,
                 jnp.asarray(_pad_to(node_type, n_max)),
                 jnp.asarray(_pad_to(node_cum, n_max)),
                 jnp.asarray(_pad_to(node_zmask, n_max)),
                 jnp.asarray(_pad_to(node_cmask, n_max)),
                 jnp.asarray(_pad_to(node_open, n_max)),
                 jnp.asarray(n_existing, jnp.int32), n_max=n_max, k_max=k_max,
-                track_conflicts=track)
+                track_conflicts=track, zone_ovh=zone_ovh)
         buf = np.asarray(packed)  # ONE host read
         nused, overflowed, nnz = int(buf[0]), bool(buf[1]), int(buf[2])
         o = 3

@@ -55,6 +55,7 @@ def sharded_solve_fn(mesh: Mesh, n_max: int):
             prior,                    # prior_counts [G, N]
             prior,                    # banned [G, N]
             rep,                      # conflict [G, G] (replicated like groups)
+            rep,                      # zovh [T, Z, R] (catalog, replicated)
             nodes,                    # node_type
             nodes,                    # node_cum
             nodes,                    # node_zmask
@@ -80,6 +81,7 @@ def run_sharded_solve(mesh: Mesh, alloc, price, avail, requests, counts,
              jnp.asarray(max_per_node),
              jnp.zeros((Gp, n_max), jnp.int32),
              jnp.zeros((Gp, n_max), bool), jnp.zeros((Gp, 1), bool),
+             jnp.zeros((1, 1, R), jnp.float32),
              jnp.zeros(n_max, jnp.int32), jnp.zeros((n_max, R), jnp.float32),
              jnp.zeros((n_max, Z), bool), jnp.zeros((n_max, C), bool),
              jnp.zeros(n_max, bool), jnp.asarray(n_existing, jnp.int32))

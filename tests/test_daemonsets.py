@@ -69,6 +69,166 @@ class TestOverheadMatrix:
                                   pool.template_labels()) is not None
 
 
+class TestZoneVaryingOverhead:
+    """Zone-pinned daemonsets with PARTIAL pool-zone overlap reserve per
+    (type, zone) — a node charges the max over its remaining zone mask,
+    so zones narrowing away from the daemonset restore headroom. This is
+    tighter than the reference (which charges any template-compatible
+    daemonset on every virtual node) at equal safety."""
+
+    def setup_method(self):
+        self.cat = encode_catalog(small_catalog(8))
+        self.pool = NodePool(name="default")
+        self.ds = DaemonSet(name="zonal-agent",
+                            requests=Resources.parse({"cpu": "1",
+                                                      "memory": "1Gi"}),
+                            node_selector={L.ZONE: "zone-a"})
+
+    def test_partial_overlap_goes_to_zone_tensor(self):
+        from karpenter_tpu.ops.facade import (_daemonset_overhead_parts,
+                                              apply_daemonset_overhead)
+        base, zvar = _daemonset_overhead_parts(
+            self.cat, [self.ds], self.pool, self.pool.template_labels())
+        assert base is None and zvar is not None
+        za = self.cat.zones.index("zone-a")
+        cpu = self.cat.resources.index("cpu")
+        assert (zvar[:, za, cpu] == 1.0).all()
+        assert (zvar[:, [i for i in range(self.cat.Z) if i != za]] == 0).all()
+        out = apply_daemonset_overhead(self.cat, [self.ds], self.pool,
+                                       self.pool.template_labels())
+        assert np.array_equal(out.allocatable, self.cat.allocatable)
+        assert out.zone_overhead is not None
+
+    def test_full_overlap_stays_baked(self):
+        """A zone selector covering ALL pool zones is zone-invariant:
+        baked into allocatable, no zone tensor."""
+        from karpenter_tpu.models.nodepool import NodePool as NP
+        from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                                       Requirements)
+        from karpenter_tpu.ops.facade import apply_daemonset_overhead
+        pool = NP(name="pinned", requirements=Requirements(
+            Requirement(L.ZONE, Operator.IN, ("zone-a",))))
+        out = apply_daemonset_overhead(self.cat, [self.ds], pool,
+                                       pool.template_labels())
+        assert out.zone_overhead is None
+        assert (out.allocatable < self.cat.allocatable).any()
+
+    def test_zone_narrowed_nodes_regain_headroom(self):
+        """Pods pinned AWAY from the daemonset's zone pack at full
+        density; pods pinned INTO it pack at reduced density — on both
+        backends, node-for-node identical."""
+        from karpenter_tpu.models.nodepool import NodePool as NP
+        from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                                       Requirements)
+        from karpenter_tpu.ops.binpack import solve_host, validate_solution
+        from karpenter_tpu.ops.encode import encode_pods
+        from karpenter_tpu.ops.facade import apply_daemonset_overhead
+        from karpenter_tpu.ops.solver import solve_device
+        # pin the type so density is deterministic
+        pin = Requirements(Requirement(L.INSTANCE_TYPE, Operator.IN,
+                                       ("c5.xlarge",)))  # 3.92 cpu
+        pool = NP(name="default", requirements=pin)
+        cat = apply_daemonset_overhead(self.cat, [self.ds], pool,
+                                       pool.template_labels())
+        assert cat.zone_overhead is not None
+
+        def pods(zone, n):
+            return [Pod(name=f"{zone}-{i}",
+                        requests=Resources.parse({"cpu": "900m"}),
+                        node_selector={L.ZONE: zone}) for i in range(n)]
+
+        for pset, per_node in ((pods("zone-b", 8), 4),   # full 3.92 cpu
+                               (pods("zone-a", 8), 3)):  # 2.92 after ds
+            enc = encode_pods(pset, cat, extra_requirements=pool.requirements)
+            h = solve_host(cat, enc)
+            d = solve_device(cat, enc)
+            assert not h.unschedulable and not d.unschedulable
+            assert len(h.nodes) == len(d.nodes) == -(-8 // per_node), (
+                f"{pset[0].name}: {len(h.nodes)} host / {len(d.nodes)} "
+                f"device nodes, expected {-(-8 // per_node)}")
+            for a, b in zip(h.nodes, d.nodes):
+                assert (a.type_idx == b.type_idx
+                        and a.pods_by_group == b.pods_by_group)
+            assert not validate_solution(cat, enc, h)
+            assert not validate_solution(cat, enc, d)
+
+    def test_validate_catches_zone_overcommit(self):
+        """validate_solution charges the zone reservation: a node whose
+        zone mask includes the daemonset's zone and whose cum fits only
+        WITHOUT the reservation must be flagged."""
+        from karpenter_tpu.models.nodepool import NodePool as NP
+        from karpenter_tpu.ops.binpack import (VirtualNode, solve_host,
+                                               validate_solution)
+        from karpenter_tpu.ops.encode import encode_pods
+        from karpenter_tpu.ops.facade import apply_daemonset_overhead
+        pool = NP(name="default")
+        cat = apply_daemonset_overhead(self.cat, [self.ds], pool,
+                                       pool.template_labels())
+        t = self.cat.names.index("c5.xlarge")
+        pods = [Pod(name=f"p{i}", requests=Resources.parse({"cpu": "900m"}),
+                    node_selector={L.ZONE: "zone-a"}) for i in range(4)]
+        enc = encode_pods(pods, cat)
+        res = solve_host(cat, enc)
+        # forge an overcommitted node: 4 × 0.9 cpu on a zone-a c5.xlarge
+        # (3.92 raw, 2.92 after the zonal daemonset)
+        zmask = np.zeros(cat.Z, bool)
+        zmask[cat.zones.index("zone-a")] = True
+        bad = VirtualNode(type_idx=t, zone_mask=zmask,
+                          cap_mask=np.ones(cat.C, bool),
+                          cum=res.nodes[0].cum * 0)
+        bad.cum = np.zeros_like(res.nodes[0].cum)
+        bad.cum[cat.resources.index("cpu")] = 3.6
+        bad.pods_by_group = {0: 4}
+        res.nodes = [bad]
+        res.unschedulable = {0: 0}
+        errs = validate_solution(cat, enc, res)
+        assert any("over capacity" in e for e in errs), errs
+
+    def test_screen_charges_zone_overhead(self):
+        """The consolidation screen sees a zone-a node's headroom shrunk
+        by the zonal daemonset but a zone-b node's untouched."""
+        from karpenter_tpu.models.nodeclaim import NodeClaim
+        from karpenter_tpu.models.nodepool import NodePool as NP
+        from karpenter_tpu.ops.binpack import VirtualNode
+        from karpenter_tpu.ops.consolidate import consolidation_screen
+        from karpenter_tpu.ops.encode import encode_pods
+        from karpenter_tpu.ops.facade import apply_daemonset_overhead
+        from karpenter_tpu.state.cluster import NodeView
+        pool = NP(name="default")
+        cat = apply_daemonset_overhead(self.cat, [self.ds], pool,
+                                       pool.template_labels())
+        t = self.cat.names.index("c5.xlarge")  # 3.92 cpu
+        # candidate 0 hosts 3 pods x 1.2 cpu; its pods fit a zone-b
+        # twin (3.92 free) but NOT a zone-a twin (2.92 after the ds)
+        pods = [Pod(name=f"p{i}", requests=Resources.parse({"cpu": "1200m"}))
+                for i in range(3)]
+        enc = encode_pods(pods, cat)
+
+        def view(name, zone, cum_cpu):
+            zmask = np.zeros(cat.Z, bool)
+            zmask[cat.zones.index(zone)] = True
+            cum = np.zeros(len(cat.resources), np.float32)
+            cum[cat.resources.index("cpu")] = cum_cpu
+            return NodeView(claim=NodeClaim(name=name, nodepool="default"),
+                            node=None, pods=[],
+                            virtual=VirtualNode(type_idx=t, zone_mask=zmask,
+                                                cap_mask=np.ones(cat.C, bool),
+                                                cum=cum, existing_name=name),
+                            price=0.1)
+
+        counts = np.zeros((2, enc.G), np.int32)
+        counts[0, 0] = 3
+        cand = view("cand", "zone-b", 3.6)
+        screen_b, _ = consolidation_screen(
+            cat, enc, [cand, view("tgt-b", "zone-b", 0.0)], counts)
+        assert screen_b[0], "empty zone-b twin has 3.92 cpu free — fits"
+        screen_a, _ = consolidation_screen(
+            cat, enc, [cand, view("tgt-a", "zone-a", 0.0)], counts)
+        assert not screen_a[0], (
+            "zone-a twin has only 2.92 cpu after the zonal daemonset — "
+            "3 x 1.2 cpu cannot fit")
+
+
 class TestEndToEnd:
     def test_density_drops_under_daemonset_overhead(self):
         """The same workload needs MORE nodes once a fat daemonset

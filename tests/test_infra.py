@@ -227,8 +227,11 @@ class TestBatchingCloud:
 
     def test_build_operator_wires_batching_cloud(self):
         """Production wiring: the operator's controllers all speak to one
-        BatchingCloud over the raw cloud."""
+        BatchingCloud over the metering middleware over the raw cloud
+        (batcher coalesces; the middleware times each wire call —
+        aws-sdk-go-prometheus position, operator.go:98)."""
         from karpenter_tpu.cloud.fake import FakeCloud, FakeCloudConfig
+        from karpenter_tpu.cloud.metering import MeteredCloud
         from karpenter_tpu.catalog import small_catalog
         from karpenter_tpu.main import build_operator
         cloud = FakeCloud(small_catalog())
@@ -239,7 +242,9 @@ class TestBatchingCloud:
         wrapped = {getattr(c, "cloud", None) for c in runtime.controllers}
         bclouds = {c for c in wrapped if isinstance(c, BatchingCloud)}
         assert len(bclouds) == 1  # one shared batcher
-        assert next(iter(bclouds)).inner is cloud
+        metered = next(iter(bclouds)).inner
+        assert isinstance(metered, MeteredCloud)
+        assert metered._inner is cloud
         assert any(c.name == "cloud.batcher.flush"
                    for c in runtime.controllers)
 
