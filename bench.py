@@ -97,6 +97,17 @@ def main() -> None:
         timeit(lambda: encode_pods(pods100k, cat)) * 1e3, 1)
     solve_device(cat, enc100k)
     tpu_s = timeit(lambda: solve_device(cat, enc100k))
+    # device-boundary budget: a fresh solve must cross the tunnel exactly
+    # twice (one packed upload, one packed read) — the regression guard
+    # that keeps e2e latency at the 1-RTT floor (test_transfer_budget.py)
+    from karpenter_tpu.ops.solver import transfer_stats
+    _u0, _r0 = transfer_stats()
+    solve_device(cat, enc100k)
+    _u1, _r1 = transfer_stats()
+    detail["c5_uploads_per_solve"] = _u1 - _u0
+    detail["c5_reads_per_solve"] = _r1 - _r0
+    assert _u1 - _u0 <= 2 and _r1 - _r0 == 1, (
+        f"transfer budget blown: {_u1 - _u0} uploads / {_r1 - _r0} reads")
     # e2e includes the tunnel RTT to the remote TPU (~70ms/read on this
     # rig); kernel_device_ms is what the chip itself spends (pipelined
     # dispatch, one block) — the honest compute comparison vs the C++ FFD

@@ -49,6 +49,9 @@ class PendingDisruption:
     replacement_claims: List[str]
     reason: str
     decided_at: float
+    # no default: constructing a decision without its pool would make
+    # _revalidate silently vacuous (pool lookup misses → returns True)
+    pool: str
 
 
 @dataclass
@@ -82,19 +85,87 @@ class DisruptionController:
             repl = [self.store.nodeclaims.get(r) for r in pd.replacement_claims]
             if any(r is None or r.phase == Phase.FAILED for r in repl):
                 # replacement failed: abort the disruption, keep the victims
+                self._uncordon(pd.victim_claims)
                 self.store.record_event("disruption", ",".join(pd.victim_claims),
                                         "ReplacementFailed", pd.reason)
                 continue
             if all(r.phase == Phase.INITIALIZED for r in repl):
+                # re-validate against FRESH cluster state before touching
+                # the victims (reference validates a consolidation command
+                # again after its TTL, designs/consolidation.md:5-43): the
+                # decision is minutes old and pods may have landed on a
+                # victim (tolerated taint, direct bind) or other capacity
+                # may have drained away in the meantime
+                if not self._revalidate(pd, now):
+                    self._uncordon(pd.victim_claims)
+                    self.store.record_event(
+                        "disruption", ",".join(pd.victim_claims),
+                        "DisruptionAborted",
+                        f"{pd.reason}: validation failed after replacement "
+                        "boot; victims kept (idle replacements are reaped "
+                        "by the emptiness pass)")
+                    continue
                 for v in pd.victim_claims:
                     claim = self.store.nodeclaims.get(v)
                     if claim is not None:
                         self.termination.delete_nodeclaim(claim, now, pd.reason)
                 continue
             if now - pd.decided_at > 15 * 60:
+                self._uncordon(pd.victim_claims)
                 continue  # stale decision: drop
             still.append(pd)
         self._pending = still
+
+    def _revalidate(self, pd: PendingDisruption, now: float) -> bool:
+        """Fresh-state feasibility: every pod currently ON the victims must
+        re-solve onto the surviving nodes (replacements included, they are
+        INITIALIZED views now) without opening ANY new capacity."""
+        pool = self.store.nodepools.get(pd.pool)
+        if pool is None:
+            return True  # pool deleted out from under us; nothing to check
+        node_class = self.store.nodeclasses.get(pool.node_class)
+        cat = self.solver.tensors(node_class)
+        # scope to the victim's pool, like the decision solve was — other
+        # pools' nodes carry taints/labels the VirtualNode view doesn't
+        # model, so "fits on pool B" would be unsoundly lenient
+        views = [v for v in build_node_views(self.store, cat, now)
+                 if v.claim.nodepool == pd.pool]
+        victim_set = set(pd.victim_claims)
+        pods = [p for v in views if v.name in victim_set for p in v.pods]
+        if not pods:
+            return True  # victims drained on their own: trivially safe
+        other_pending = {name for q in self._pending if q is not pd
+                         for name in q.victim_claims}
+        others = [v for v in views
+                  if v.name not in victim_set
+                  and v.name not in other_pending
+                  and not v.claim.is_deleting()]
+        out = self.solver.solve(
+            pods, pool, node_class,
+            existing=[v.virtual for v in others],
+            existing_pods={v.name: v.pods for v in others},
+            daemonsets=list(self.store.daemonsets.values()))
+        return not out.unschedulable and not out.launches
+
+    # --- decision-time cordon (reference step order: taint victims FIRST,
+    # then pre-spin, validate, delete — disruption.md:14-27) ---
+    def _cordon(self, victims: List[NodeView]) -> None:
+        from ..models.pod import Taint
+        for v in victims:
+            if v.node is not None and not any(
+                    t.key == L.DISRUPTED_TAINT_KEY for t in v.node.taints):
+                v.node.taints.append(
+                    Taint(key=L.DISRUPTED_TAINT_KEY, effect="NoSchedule"))
+
+    def _uncordon(self, claim_names: List[str]) -> None:
+        for name in claim_names:
+            claim = self.store.nodeclaims.get(name)
+            if claim is None or claim.is_deleting():
+                continue  # draining nodes keep their taint
+            node = self.store.node_for_nodeclaim(claim)
+            if node is not None:
+                node.taints = [t for t in node.taints
+                               if t.key != L.DISRUPTED_TAINT_KEY]
 
     # --- per-pool pass ---
     def _reconcile_pool(self, pool: NodePool, now: float) -> None:
@@ -441,9 +512,14 @@ class DisruptionController:
         DISRUPTION_DECISIONS.inc(
             reason=reason,
             consolidation_type="multi" if len(victims) > 1 else "single")
+        # cordon victims NOW — between this decision and the replacement
+        # becoming ready the victims must not absorb new pods, or the
+        # validated decision rots while the replacement boots
+        self._cordon(victims)
         self._pending.append(PendingDisruption(
             victim_claims=[v.name for v in victims],
-            replacement_claims=repl_names, reason=reason, decided_at=now))
+            replacement_claims=repl_names, reason=reason, decided_at=now,
+            pool=pool.name))
         self.store.record_event("disruption", ",".join(v.name for v in victims),
                                 reason, f"replacements: {repl_names}")
 

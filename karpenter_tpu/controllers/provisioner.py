@@ -131,6 +131,12 @@ class Provisioner:
         for view in build_node_views(self.store, cat, now):
             if view.claim.nodepool != pool.name:
                 continue
+            # a node cordoned at disruption-decision time (or draining)
+            # must not absorb new pods — reusing its headroom would rot
+            # the validated disruption while its replacement boots
+            if view.node is not None and any(
+                    t.key == L.DISRUPTED_TAINT_KEY for t in view.node.taints):
+                continue
             existing.append(view.virtual)
             existing_pods[view.claim.name] = view.pods
         daemonsets = list(self.store.daemonsets.values())

@@ -18,7 +18,7 @@ from ..models.pod import Taint
 from ..state.store import Store
 from .provisioner import NOMINATED
 
-DISRUPTED_TAINT = Taint(key="karpenter.tpu/disrupted", effect="NoSchedule")
+DISRUPTED_TAINT = Taint(key=L.DISRUPTED_TAINT_KEY, effect="NoSchedule")
 DEFAULT_GRACE = 30.0
 
 

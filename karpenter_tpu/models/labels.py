@@ -23,6 +23,10 @@ NODEPOOL = "karpenter.tpu/nodepool"
 # provisioner's in-flight placement marker; the store's pending-group
 # index keys off its presence)
 NOMINATED = "karpenter.tpu/nominated-nodeclaim"
+# NoSchedule taint cordoning a node: applied at DISRUPTION DECISION time
+# (before replacements boot — reference step order, disruption.md:14-27)
+# and again at drain start; the provisioner never reuses a node carrying it
+DISRUPTED_TAINT_KEY = "karpenter.tpu/disrupted"
 NODE_INITIALIZED = "karpenter.tpu/initialized"
 NODE_REGISTERED = "karpenter.tpu/registered"
 

@@ -414,10 +414,25 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
     return result
 
 
+def cheapest_offerings(t: np.ndarray, zm: np.ndarray, cm: np.ndarray,
+                       cat: CatalogTensors) -> List[Tuple[int, int, int, float]]:
+    """The launch decision, array-level: cheapest available (zone, captype)
+    per node given type ids [M], zone masks [M, Z], cap masks [M, C]
+    (reference launch path picks cheapest via CreateFleet's lowest-price
+    strategy over the override list). The ONE implementation both the host
+    oracle (finalize_offerings) and solve_device's decode use, so a
+    tie-break or pricing change can't diverge the two paths."""
+    masked = np.where(zm[:, :, None] & cm[:, None, :] & cat.available[t],
+                      cat.price[t], np.inf)            # [M, Z, C]
+    flat = masked.reshape(t.shape[0], -1)
+    k = np.argmin(flat, axis=1)
+    prices = flat[np.arange(t.shape[0]), k]
+    return [(int(ti), int(ki // cat.C), int(ki % cat.C), float(p))
+            for ti, ki, p in zip(t.tolist(), k.tolist(), prices.tolist())]
+
+
 def finalize_offerings(result: SolveResult, cat: CatalogTensors) -> None:
-    """Pick the cheapest surviving (zone, captype) for each new node —
-    the launch decision (reference launch path picks cheapest via
-    CreateFleet's lowest-price strategy over the override list).
+    """Pick the cheapest surviving (zone, captype) for each new node.
     Vectorized over all new nodes: this runs on every solve and a per-node
     Python loop costs more than the TPU kernel at 100k-pod scale."""
     new = result.new_nodes()
@@ -427,13 +442,7 @@ def finalize_offerings(result: SolveResult, cat: CatalogTensors) -> None:
     t = np.array([n.type_idx for n in new])
     zm = np.stack([n.zone_mask for n in new])          # [M, Z]
     cm = np.stack([n.cap_mask for n in new])           # [M, C]
-    masked = np.where(zm[:, :, None] & cm[:, None, :] & cat.available[t],
-                      cat.price[t], np.inf)            # [M, Z, C]
-    flat = masked.reshape(len(new), -1)
-    k = np.argmin(flat, axis=1)
-    prices = flat[np.arange(len(new)), k]
-    result.launches = [(int(ti), int(ki // cat.C), int(ki % cat.C), float(p))
-                       for ti, ki, p in zip(t, k, prices)]
+    result.launches = cheapest_offerings(t, zm, cm, cat)
 
 
 def validate_solution(cat: CatalogTensors, enc: EncodedPods,

@@ -108,20 +108,20 @@ def _mesh_screen_fn(mesh):
 
 def screen_device_time(cat: CatalogTensors, enc: EncodedPods, views,
                        group_counts: np.ndarray, iters: int = 40) -> float:
-    """Pipelined device time per screen call, in seconds — `iters`
-    dispatches, one block (the honest chip-time measurement on a
-    tunneled TPU, same methodology as solver.kernel_device_time)."""
-    import time
+    """Per-call device time for the screen, in seconds (solver.slope_time
+    over 8 variants with perturbed node cum — see that helper for why the
+    RTT cancels and why inputs must vary)."""
+    from .solver import slope_time
 
-    args = tuple(jnp.asarray(a)
-                 for a in _screen_args(cat, enc, views, group_counts))
-    _screen_kernel(*args).block_until_ready()
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = _screen_kernel(*args)
-    out.block_until_ready()
-    return (time.perf_counter() - t0) / iters
+    base = _screen_args(cat, enc, views, group_counts)
+    variants = []
+    for i in range(8):
+        a = list(base)
+        cum = np.asarray(a[3]).copy()
+        cum[:, 0] += np.float32(i) * np.float32(0.001)
+        a[3] = cum
+        variants.append(tuple(jnp.asarray(x) for x in a))
+    return slope_time(lambda i: _screen_kernel(*variants[i % 8]), iters=iters)
 
 
 def _screen_args(cat: CatalogTensors, enc: EncodedPods, views,

@@ -1,0 +1,126 @@
+"""Decision-time cordon + pre-delete re-validation of disruptions.
+
+Reference step order (website concepts/disruption.md:14-27): taint victims
+`disrupted:NoSchedule` FIRST, then pre-spin replacements, re-validate the
+command against fresh state, and only then delete. Without the cordon a
+victim can absorb pods during the replacement's boot; without the
+re-validation a minutes-old decision executes against a cluster that no
+longer supports it (designs/consolidation.md:5-43).
+"""
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodeclaim import Phase
+from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def add_pods(sim, n, cpu="500m", mem="1Gi", prefix="p", **kw):
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def settle(sim, timeout=120):
+    ok = sim.engine.run_until(
+        lambda: all(p.node_name is not None for p in sim.store.pods.values()),
+        timeout=timeout)
+    assert ok
+
+
+def make_pending_sim(n_anchors=3):
+    """A sim holding a PendingDisruption: one-pod-per-node anchors (self
+    hostname anti-affinity) so a drifted node's pod can never fold onto
+    surviving nodes — the disruption must pre-spin a replacement and wait
+    for it, which is exactly the window these tests probe."""
+    sim = make_sim()
+    pods = [Pod(name=f"a-{i}", labels={"role": "anchor"},
+                requests=Resources.parse({"cpu": "1", "memory": "2Gi"}),
+                affinity_terms=[PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector={"role": "anchor"}, anti=True)])
+            for i in range(n_anchors)]
+    for p in pods:
+        sim.store.add_pod(p)
+    settle(sim)
+    sim.store.nodeclasses["default"].user_data = "v2"  # drift everything
+    ok = sim.engine.run_until(lambda: bool(sim.disruption._pending),
+                              timeout=900)
+    assert ok, "no pre-spin disruption decision was made"
+    return sim, sim.disruption._pending[0]
+
+
+def test_victims_cordoned_at_decision_time():
+    sim, pd = make_pending_sim()
+    for vname in pd.victim_claims:
+        claim = sim.store.nodeclaims[vname]
+        node = sim.store.node_for_nodeclaim(claim)
+        assert node is not None
+        assert any(t.key == L.DISRUPTED_TAINT_KEY for t in node.taints), (
+            f"victim {vname} not cordoned at decision time")
+        assert not claim.is_deleting(), (
+            "victim must not drain before its replacement is up")
+
+
+def test_provisioner_skips_cordoned_victims():
+    sim, pd = make_pending_sim()
+    victims = set(pd.victim_claims)
+    # new pending pods arrive while the replacement boots: none may be
+    # nominated to or bound on a cordoned victim
+    fresh = add_pods(sim, 6, prefix="late")
+    sim.engine.run_for(60, step=1)
+    from karpenter_tpu.controllers.provisioner import NOMINATED
+    for p in fresh:
+        live = sim.store.pods.get(f"{p.namespace}/{p.name}")
+        if live is None:
+            continue
+        nominated = live.annotations.get(NOMINATED)
+        assert nominated not in victims, (
+            f"pod {p.name} nominated onto cordoned victim {nominated}")
+        if live.node_name is not None:
+            owner = next((c.name for c in sim.store.nodeclaims.values()
+                          if c.node_name == live.node_name), None)
+            assert owner not in victims, (
+                f"pod {p.name} bound onto cordoned victim {owner}")
+
+
+def test_validation_failure_aborts_disruption():
+    """A pod force-bound onto the victim during replacement boot (tolerating
+    the cordon, as a daemonset-like or direct-bind pod would) must abort
+    the disruption: victims kept and uncordoned, abort event recorded."""
+    sim, pd = make_pending_sim()
+    victim = sim.store.nodeclaims[pd.victim_claims[0]]
+    node = sim.store.node_for_nodeclaim(victim)
+    # an unschedulable-elsewhere hog lands directly on the victim: big
+    # enough that the surviving nodes cannot absorb it
+    hog = Pod(name="hog", requests=Resources.parse({"cpu": "64",
+                                                    "memory": "256Gi"}))
+    sim.store.add_pod(hog)
+    sim.store.bind_pod(hog, node.name)
+    sim.engine.run_until(lambda: not sim.disruption._pending, timeout=900)
+    # the decision was abandoned: victim survives, uncordoned, event logged
+    live = sim.store.nodeclaims.get(victim.name)
+    assert live is not None and not live.is_deleting(), (
+        "victim was deleted despite failed re-validation")
+    node = sim.store.node_for_nodeclaim(live)
+    assert node is not None
+    assert not any(t.key == L.DISRUPTED_TAINT_KEY for t in node.taints), (
+        "aborted victim left cordoned")
+    assert any(r == "DisruptionAborted" for _, _, r, _ in sim.store.events)
+
+
+def test_validation_pass_deletes_victims():
+    """The happy path still completes: with no interference the victims
+    drain once replacements initialize."""
+    sim, pd = make_pending_sim()
+    victims = list(pd.victim_claims)
+    sim.engine.run_until(
+        lambda: all(sim.store.nodeclaims.get(v) is None
+                    or sim.store.nodeclaims[v].is_deleting()
+                    for v in victims),
+        timeout=900)
+    assert all(sim.store.nodeclaims.get(v) is None
+               or sim.store.nodeclaims[v].is_deleting() for v in victims)

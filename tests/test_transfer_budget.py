@@ -1,0 +1,106 @@
+"""Device-boundary transfer budget: the tunnel-latency regression guard.
+
+The deployment TPU sits behind a network tunnel where every independent
+host↔device crossing can cost a full RTT (~70-100 ms measured), so
+Solve() latency is governed by CROSSING COUNT, not compute. Round 4
+regressed every end-to-end config ~45 ms by adding per-solve uploads;
+these tests pin the budget so the next regression is a red diff
+(the same discipline cloud/metering.py applies to wire calls — reference
+meters its hot boundary in pkg/batcher/metrics.go:25-40).
+"""
+
+import numpy as np
+
+from karpenter_tpu.catalog import generate_catalog, small_catalog
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.ops import solver as S
+from karpenter_tpu.ops.binpack import VirtualNode
+from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+
+
+def _pods(n):
+    return [Pod(name=f"p{i}",
+                requests=Resources.parse({"cpu": ["250m", "1", "2"][i % 3],
+                                          "memory": "1Gi"}))
+            for i in range(n)]
+
+
+def test_fresh_solve_is_one_upload_one_read():
+    cat = encode_catalog(small_catalog())
+    enc = encode_pods(_pods(200), cat)
+    S.solve_device(cat, enc)  # warm: compile + catalog upload
+    up0, rd0 = S.transfer_stats()
+    for _ in range(3):
+        S.solve_device(cat, enc)
+    up1, rd1 = S.transfer_stats()
+    assert (up1 - up0) == 3, (
+        f"fresh solve must upload exactly ONE packed group buffer, "
+        f"got {(up1 - up0) / 3} per solve")
+    assert (rd1 - rd0) == 3, (
+        f"fresh solve must block on exactly ONE packed device read, "
+        f"got {(rd1 - rd0) / 3} per solve")
+
+
+def test_catalog_uploads_are_per_epoch_not_per_solve():
+    cat = encode_catalog(generate_catalog())
+    enc = encode_pods(_pods(500), cat)
+    S.solve_device(cat, enc)
+    up0, _ = S.transfer_stats()
+    S.solve_device(cat, enc)
+    up1, _ = S.transfer_stats()
+    assert up1 - up0 == 1  # gbuf only: dcat served from the epoch cache
+    # a NEW catalog epoch re-uploads the 4 catalog tensors once, then
+    # steady-state returns to one upload per solve
+    cat2 = encode_catalog(generate_catalog())
+    enc2 = encode_pods(_pods(500), cat2)
+    S.solve_device(cat2, enc2)
+    up2, _ = S.transfer_stats()
+    S.solve_device(cat2, enc2)
+    up3, _ = S.transfer_stats()
+    assert up3 - up2 == 1
+
+
+def test_resume_solve_budget():
+    """Resuming onto existing nodes ships at most gbuf + nbuf (+ prior /
+    banned when resident state carries them)."""
+    cat = encode_catalog(small_catalog())
+    enc = encode_pods(_pods(60), cat)
+    first = S.solve_device(cat, enc)
+    existing = [VirtualNode(type_idx=n.type_idx, zone_mask=n.zone_mask,
+                            cap_mask=n.cap_mask, cum=n.cum,
+                            existing_name=f"n{i}")
+                for i, n in enumerate(first.nodes[:3])]
+    S.solve_device(cat, enc, existing)
+    up0, rd0 = S.transfer_stats()
+    S.solve_device(cat, enc, existing)
+    up1, rd1 = S.transfer_stats()
+    assert up1 - up0 <= 2, f"resume solve uploaded {up1 - up0} buffers"
+    assert rd1 - rd0 == 1
+
+
+def test_projected_columns_match_full_axis():
+    """The kernel's resource-column projection must not change results:
+    requests over a catalog whose resource axis carries columns nobody
+    requests solve identically to the host oracle."""
+    from karpenter_tpu.ops.binpack import solve_host
+    cat = encode_catalog(generate_catalog())
+    enc = encode_pods(_pods(300), cat)
+    # the union is process-global and monotone: an earlier test requesting
+    # exotic resources would erode this test's premise — reset it
+    saved = set(S._cols_union)
+    S._cols_union.clear()
+    S._cols_union.add(0)
+    try:
+        cols = S._request_cols(enc, cat)
+        assert len(cols) < enc.requests.shape[1], (
+            "test premise: some catalog resource columns are unrequested")
+        d = S.solve_device(cat, enc)
+        h = solve_host(cat, enc)
+        assert len(d.nodes) == len(h.nodes)
+        for a, b in zip(d.nodes, h.nodes):
+            assert a.type_idx == b.type_idx
+            assert a.pods_by_group == b.pods_by_group
+            assert np.allclose(a.cum, b.cum)
+    finally:
+        S._cols_union.update(saved)
