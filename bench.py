@@ -213,12 +213,10 @@ def main() -> None:
     sim = make_sim()
     ic = next(c for c in sim.engine.controllers
               if isinstance(c, InterruptionController))
+    from karpenter_tpu.cloud.messages import spot_interruption_event
     for i in range(15_000):
-        sim.cloud.interruptions.append({
-            "kind": "spot-interruption", "instance_id": f"i-b{i}",
-            "provider_id": f"tpu:///zone-a/i-b{i}",
-            "instance_type": "m5.large", "zone": "zone-a",
-            "capacity_type": "spot", "time": 0.0})
+        sim.cloud.send_raw_message(spot_interruption_event(
+            f"i-b{i}", f"tpu:///zone-a/i-b{i}", 0.0))
     t0 = time.perf_counter()
     ic.reconcile(0.0)  # drains the whole queue in 10-message batches
     dt = time.perf_counter() - t0

@@ -366,27 +366,51 @@ class FakeCloud:
         if not inst:
             raise NotFoundError(instance_id)
         inst.state = "terminated"
-        self.interruptions.append({
-            "kind": "state-change", "instance_id": instance_id,
-            "provider_id": inst.provider_id, "reason": reason,
-            "time": self.clock.now()})
+        from .messages import state_change_event
+        self.interruptions.append(state_change_event(
+            instance_id, inst.provider_id, "terminated", self.clock.now()))
 
     def send_spot_interruption(self, instance_id: str) -> None:
-        """Queue a 2-minute spot reclaim warning (EventBridge analog)."""
+        """Queue a 2-minute spot reclaim warning as RAW event-bus JSON —
+        the consumer gets wire bytes, not pre-parsed structures."""
         inst = self.instances.get(instance_id)
         if not inst:
             raise NotFoundError(instance_id)
-        self.interruptions.append({
-            "kind": "spot-interruption", "instance_id": instance_id,
-            "provider_id": inst.provider_id,
-            "instance_type": inst.instance_type, "zone": inst.zone,
-            "capacity_type": inst.capacity_type, "time": self.clock.now()})
+        from .messages import spot_interruption_event
+        self.interruptions.append(spot_interruption_event(
+            instance_id, inst.provider_id, self.clock.now()))
 
-    def poll_interruptions(self, max_messages: int = 10) -> List[dict]:
-        """SQS-style receive (messages must be acked with delete_message)."""
+    def send_rebalance_recommendation(self, instance_id: str) -> None:
+        inst = self.instances.get(instance_id)
+        if not inst:
+            raise NotFoundError(instance_id)
+        from .messages import rebalance_recommendation_event
+        self.interruptions.append(rebalance_recommendation_event(
+            instance_id, inst.provider_id, self.clock.now()))
+
+    def send_scheduled_change(self, instance_ids: List[str]) -> None:
+        missing = [i for i in instance_ids if i not in self.instances]
+        if missing or not instance_ids:
+            # same contract as the other senders — silently filtering
+            # would enqueue an empty-entity event our own parser rejects
+            raise NotFoundError(",".join(missing) or "<no instances>")
+        insts = [self.instances[i] for i in instance_ids]
+        from .messages import scheduled_change_event
+        self.interruptions.append(scheduled_change_event(
+            [i.id for i in insts], [i.provider_id for i in insts],
+            self.clock.now()))
+
+    def send_raw_message(self, raw: str) -> None:
+        """Inject arbitrary queue bytes (garbage, unknown kinds) — the
+        consumer must survive anything that lands here."""
+        self.interruptions.append(raw)
+
+    def poll_interruptions(self, max_messages: int = 10) -> List[str]:
+        """SQS-style receive of raw JSON payloads (messages must be acked
+        with delete_message)."""
         return list(itertools.islice(self.interruptions, max_messages))
 
-    def delete_message(self, msg: dict) -> None:
+    def delete_message(self, msg: str) -> None:
         # acks arrive in poll order, so the head-pop fast path is O(1);
         # a 15k-message drain through list.remove was O(n^2) and dominated
         # the interruption throughput benchmark

@@ -2,25 +2,36 @@
 capacity loss.
 
 Reference: pkg/controllers/interruption/controller.go:62-139 — long-polls
-the SQS queue in 10-message batches, parses EventBridge messages (spot
-interruption, rebalance recommendation, scheduled change, state change),
-maps instance → NodeClaim via the provider-id index, deletes the NodeClaim
-(triggering graceful drain) and marks the offering unavailable on spot
-interrupts so the next Solve avoids the reclaimed pool.
+the SQS queue in 10-message batches, parses raw EventBridge JSON into
+typed messages (parser.go + messages/*), maps instance → NodeClaim via
+the provider-id index, deletes the NodeClaim (triggering graceful drain)
+and marks the offering unavailable on spot interrupts so the next Solve
+avoids the reclaimed pool.
+
+The queue hands this controller RAW BYTES: cloud/messages.py owns the
+parse (per-kind schemas, unknown-kind → no-op). Garbage payloads are
+counted and DELETED — a poison message must not wedge the queue — and
+duplicate deliveries (at-least-once queues redeliver) are dropped via a
+bounded id window.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict
 
 from ..catalog.provider import CatalogProvider
+from ..cloud import messages as wire
 from ..state.store import Store
 from .termination import TerminationController
 
-ACTIONABLE = {"spot-interruption", "scheduled-change", "state-change"}
+ACTIONABLE = {wire.SPOT_INTERRUPTION, wire.SCHEDULED_CHANGE,
+              wire.STATE_CHANGE}
 # rebalance recommendations are observability-only by default, like the
 # reference (it deletes only for actionable kinds)
+
+DEDUPE_WINDOW = 4096  # recent message ids remembered for duplicate drops
 
 
 @dataclass
@@ -33,32 +44,68 @@ class InterruptionController:
     requeue: float = 0.5
     batch_size: int = 10
     stats: Dict[str, int] = field(default_factory=dict)
+    _seen_ids: deque = field(default_factory=lambda: deque(maxlen=DEDUPE_WINDOW))
+    _seen_set: set = field(default_factory=set)
 
     def reconcile(self, now: float) -> float:
+        from ..metrics import INTERRUPTION_MESSAGES, INTERRUPTION_PARSE_FAILURES
         while True:
-            messages = self.cloud.poll_interruptions(self.batch_size)
-            if not messages:
+            batch = self.cloud.poll_interruptions(self.batch_size)
+            if not batch:
                 return self.requeue
-            for msg in list(messages):
-                self._handle(msg, now)
-                self.cloud.delete_message(msg)
-            if len(messages) < self.batch_size:
+            for raw in list(batch):
+                try:
+                    msg = wire.parse(raw)
+                except wire.ParseError:
+                    # poison message: count it, ack it, move on — never
+                    # crash the consumer or wedge the queue head
+                    self.stats["parse-failed"] = (
+                        self.stats.get("parse-failed", 0) + 1)
+                    INTERRUPTION_PARSE_FAILURES.inc()
+                    self.cloud.delete_message(raw)
+                    continue
+                if msg.metadata.id and not self._first_delivery(msg.metadata.id):
+                    self.stats["duplicate"] = self.stats.get("duplicate", 0) + 1
+                else:
+                    self.stats[msg.kind] = self.stats.get(msg.kind, 0) + 1
+                    INTERRUPTION_MESSAGES.inc(kind=msg.kind)
+                    self._handle(msg, now)
+                self.cloud.delete_message(raw)
+            if len(batch) < self.batch_size:
                 return self.requeue
 
-    def _handle(self, msg: dict, now: float) -> None:
-        kind = msg.get("kind", "")
-        self.stats[kind] = self.stats.get(kind, 0) + 1
-        from ..metrics import INTERRUPTION_MESSAGES
-        INTERRUPTION_MESSAGES.inc(kind=kind)
-        if kind == "spot-interruption":
-            # the reclaimed pool will be tight for a while
-            self.catalog.unavailable.mark_unavailable(
-                msg["instance_type"], msg["zone"], msg["capacity_type"],
-                reason="spot-interrupted")
-        if kind not in ACTIONABLE:
+    def _first_delivery(self, msg_id: str) -> bool:
+        if msg_id in self._seen_set:
+            return False
+        if len(self._seen_ids) == self._seen_ids.maxlen:
+            self._seen_set.discard(self._seen_ids[0])
+        self._seen_ids.append(msg_id)
+        self._seen_set.add(msg_id)
+        return True
+
+    def _handle(self, msg: wire.ParsedMessage, now: float) -> None:
+        if msg.kind not in ACTIONABLE:
             return
-        claim = self.store.nodeclaim_by_provider_id(msg.get("provider_id", ""))
-        if claim is None:
-            return
-        self.store.record_event("nodeclaim", claim.name, "Interrupted", kind)
-        self.termination.delete_nodeclaim(claim, now, kind)
+        for iid in msg.instance_ids:
+            claim = self._resolve(iid, msg)
+            if claim is None:
+                continue
+            if msg.kind == wire.SPOT_INTERRUPTION and claim.instance_type:
+                # the reclaimed pool will be tight for a while — offering
+                # facts come from the CLAIM (the wire carries only ids)
+                self.catalog.unavailable.mark_unavailable(
+                    claim.instance_type, claim.zone or "",
+                    claim.capacity_type or "spot",
+                    reason="spot-interrupted")
+            self.store.record_event("nodeclaim", claim.name, "Interrupted",
+                                    msg.kind)
+            self.termination.delete_nodeclaim(claim, now, msg.kind)
+
+    def _resolve(self, instance_id: str, msg: wire.ParsedMessage):
+        """Instance id → NodeClaim: the envelope's resources carry provider
+        ids (fast path); fall back to the instance-id suffix index."""
+        for pid in msg.metadata.resources:
+            claim = self.store.nodeclaim_by_provider_id(pid)
+            if claim is not None and pid.rsplit("/", 1)[-1] == instance_id:
+                return claim
+        return self.store.nodeclaim_by_instance_id(instance_id)

@@ -324,6 +324,7 @@ class Provisioner:
             if isinstance(res, Instance):
                 claim.phase = Phase.LAUNCHED
                 claim.provider_id = res.provider_id
+                self.store.index_nodeclaim_instance(claim)
                 claim.instance_type = res.instance_type
                 claim.zone = res.zone
                 claim.capacity_type = res.capacity_type

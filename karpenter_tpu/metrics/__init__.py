@@ -51,6 +51,10 @@ ICE_ERRORS = REGISTRY.counter(
 INTERRUPTION_MESSAGES = REGISTRY.counter(
     "karpenter_tpu_interruption_messages_total",
     "interruption queue messages", ("kind",))
+INTERRUPTION_PARSE_FAILURES = REGISTRY.counter(
+    "karpenter_tpu_interruption_message_parse_failures_total",
+    "interruption payloads that failed wire-format parsing (counted and "
+    "deleted, never retried — poison messages must not wedge the queue)")
 LIFECYCLE_DURATION = REGISTRY.histogram(
     "karpenter_nodeclaims_lifecycle_duration_seconds",
     "Seconds from creation to each lifecycle phase (reference: "

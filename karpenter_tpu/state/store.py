@@ -35,6 +35,10 @@ class Store:
         self.nodepools: Dict[str, NodePool] = {}
         self.nodeclasses: Dict[str, NodeClassSpec] = {}
         self.nodeclaims: Dict[str, NodeClaim] = {}
+        # instance id (provider-id tail) -> claim name; maintained by
+        # add/delete_nodeclaim + index_nodeclaim_instance so interruption
+        # storms resolve claims O(1), not O(claims) per message
+        self._claims_by_iid: Dict[str, str] = {}
         self.nodes: Dict[str, Node] = {}
         self.daemonsets: Dict[str, object] = {}
         self.pdbs: Dict[str, object] = {}
@@ -200,21 +204,52 @@ class Store:
     # --- nodeclaims ---
     def add_nodeclaim(self, nc: NodeClaim) -> NodeClaim:
         self.nodeclaims[nc.name] = nc
+        self.index_nodeclaim_instance(nc)
         self._notify("nodeclaim", "add", nc)
         return nc
 
     def delete_nodeclaim(self, name: str) -> None:
         nc = self.nodeclaims.pop(name, None)
         if nc:
+            if nc.provider_id:
+                iid = nc.provider_id.rsplit("/", 1)[-1]
+                if self._claims_by_iid.get(iid) == name:
+                    del self._claims_by_iid[iid]
             self._notify("nodeclaim", "delete", nc)
+
+    def index_nodeclaim_instance(self, nc: NodeClaim) -> None:
+        """Register the claim's instance id in the lookup index — called
+        when provider_id is assigned post-launch (the claim is added to the
+        store before the cloud answers, so add-time indexing misses it)."""
+        if nc.provider_id:
+            self._claims_by_iid[nc.provider_id.rsplit("/", 1)[-1]] = nc.name
 
     def nodeclaims_for_pool(self, pool: str) -> List[NodeClaim]:
         return [c for c in self.nodeclaims.values() if c.nodepool == pool]
 
     def nodeclaim_by_provider_id(self, provider_id: str) -> Optional[NodeClaim]:
-        """The instance-id field index (reference operator.go:298-319)."""
+        """The provider-id index (reference operator.go:298-319)."""
+        if not provider_id:
+            return None
+        c = self.nodeclaim_by_instance_id(provider_id.rsplit("/", 1)[-1])
+        return c if c is not None and c.provider_id == provider_id else None
+
+    def nodeclaim_by_instance_id(self, instance_id: str) -> Optional[NodeClaim]:
+        """Instance-id lookup: provider ids end in the instance id
+        (tpu:///zone/i-xxx), mirroring the reference's id-from-provider-id
+        parse (utils.ParseInstanceID). O(1) via the maintained index; the
+        scan fallback covers claims whose provider_id was set without
+        index_nodeclaim_instance (tests mutating claims directly)."""
+        name = self._claims_by_iid.get(instance_id)
+        if name is not None:
+            c = self.nodeclaims.get(name)
+            if (c is not None
+                    and (c.provider_id or "").rsplit("/", 1)[-1] == instance_id):
+                return c
         for c in self.nodeclaims.values():
-            if c.provider_id == provider_id:
+            pid = c.provider_id or ""
+            if pid and pid.rsplit("/", 1)[-1] == instance_id:
+                self._claims_by_iid[instance_id] = c.name
                 return c
         return None
 

@@ -1,0 +1,192 @@
+"""Interruption wire-format: parsing raw queue bytes, surviving garbage.
+
+Reference parity: pkg/controllers/interruption/parser.go (registry keyed
+on version/source/detail-type, unknown → noop) and messages/*_test
+behaviors — malformed payloads error, unknown kinds no-op, state-change
+accepts only dying states. Plus consumer-side requirements: poison
+messages are counted and deleted (never wedge the queue), duplicate
+deliveries are dropped.
+"""
+
+import json
+import random
+import string
+
+import pytest
+
+from karpenter_tpu.cloud import messages as wire
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+class TestParser:
+    def test_spot_interruption_roundtrip(self):
+        raw = wire.spot_interruption_event("i-123", "tpu:///zone-a/i-123",
+                                           42.0)
+        msg = wire.parse(raw)
+        assert msg.kind == wire.SPOT_INTERRUPTION
+        assert msg.instance_ids == ("i-123",)
+        assert msg.metadata.resources == ("tpu:///zone-a/i-123",)
+        assert msg.start_time == 42.0
+
+    def test_bytes_payload(self):
+        raw = wire.state_change_event("i-9", "tpu:///z/i-9", "stopped", 1.0)
+        assert wire.parse(raw.encode()).kind == wire.STATE_CHANGE
+
+    def test_state_change_ignores_living_states(self):
+        for state in ("pending", "running", "rebooting", ""):
+            raw = wire.state_change_event("i-9", "tpu:///z/i-9", state, 1.0)
+            assert wire.parse(raw).kind == wire.NOOP
+        for state in ("stopping", "stopped", "shutting-down", "terminated",
+                      "TERMINATED"):
+            raw = wire.state_change_event("i-9", "tpu:///z/i-9", state, 1.0)
+            assert wire.parse(raw).kind == wire.STATE_CHANGE
+
+    def test_scheduled_change_filters_service_and_category(self):
+        good = wire.scheduled_change_event(["i-1", "i-2"],
+                                           ["p/1", "p/2"], 5.0)
+        msg = wire.parse(good)
+        assert msg.kind == wire.SCHEDULED_CHANGE
+        assert msg.instance_ids == ("i-1", "i-2")
+        # wrong service → noop, not error (parser.go acceptance filter)
+        obj = json.loads(good)
+        obj["detail"]["service"] = "STORAGE"
+        assert wire.parse(json.dumps(obj)).kind == wire.NOOP
+
+    def test_unknown_kind_is_noop_with_metadata(self):
+        raw = json.dumps({"version": "0", "source": wire.SOURCE_COMPUTE,
+                          "detail-type": "Brand New Event Nobody Knows",
+                          "id": "x-1", "time": 3.0, "resources": [],
+                          "detail": {"whatever": 1}})
+        msg = wire.parse(raw)
+        assert msg.kind == wire.NOOP
+        assert msg.metadata.id == "x-1"
+
+    def test_unknown_version_is_noop(self):
+        raw = json.dumps({"version": "7", "source": wire.SOURCE_COMPUTE,
+                          "detail-type": "Spot Interruption Warning",
+                          "detail": {"instance-id": "i-1"}})
+        assert wire.parse(raw).kind == wire.NOOP
+
+    def test_empty_payload_is_noop(self):
+        assert wire.parse("").kind == wire.NOOP
+        assert wire.parse("   ").kind == wire.NOOP
+
+    @pytest.mark.parametrize("raw", [
+        "{not json",
+        "[1, 2, 3]",
+        '"just a string"',
+        "42",
+        b"\xff\xfe garbage bytes",
+        json.dumps({"version": "0", "source": wire.SOURCE_COMPUTE,
+                    "detail-type": "Spot Interruption Warning"}),  # no detail
+        json.dumps({"version": "0", "source": wire.SOURCE_COMPUTE,
+                    "detail-type": "Spot Interruption Warning",
+                    "detail": {}}),  # missing instance-id
+        json.dumps({"version": "0", "source": wire.SOURCE_HEALTH,
+                    "detail-type": "Health Event",
+                    "detail": {"service": "COMPUTE",
+                               "event-type-category": "scheduledChange",
+                               "affected-entities": [{"bogus": 1}]}}),
+    ])
+    def test_malformed_payloads_raise(self, raw):
+        with pytest.raises(wire.ParseError):
+            wire.parse(raw)
+
+    def test_fuzz_never_raises_anything_but_parse_error(self):
+        rng = random.Random(0xC0FFEE)
+        corpus = [wire.spot_interruption_event("i-1", "p/1", 1.0),
+                  wire.scheduled_change_event(["i-2"], ["p/2"], 2.0),
+                  wire.state_change_event("i-3", "p/3", "stopped", 3.0)]
+        for _ in range(2000):
+            base = rng.choice(corpus)
+            mode = rng.randrange(4)
+            if mode == 0:  # random truncation
+                raw = base[: rng.randrange(len(base))]
+            elif mode == 1:  # byte corruption
+                chars = list(base)
+                for _ in range(rng.randrange(1, 6)):
+                    chars[rng.randrange(len(chars))] = rng.choice(
+                        string.printable)
+                raw = "".join(chars)
+            elif mode == 2:  # random JSON-ish structure
+                raw = json.dumps({
+                    rng.choice(["version", "source", "detail",
+                                "detail-type", "x"]):
+                    rng.choice([None, 1, [], {}, "y", {"state": 1}])
+                    for _ in range(rng.randrange(5))})
+            else:  # pure noise
+                raw = "".join(rng.choice(string.printable)
+                              for _ in range(rng.randrange(80)))
+            try:
+                msg = wire.parse(raw)
+                assert msg.kind in (wire.NOOP, wire.SPOT_INTERRUPTION,
+                                    wire.SCHEDULED_CHANGE, wire.STATE_CHANGE,
+                                    wire.REBALANCE_RECOMMENDATION)
+            except wire.ParseError:
+                pass  # the only acceptable failure mode
+
+
+class TestConsumer:
+    def _booted_sim(self, n=4):
+        sim = make_sim()
+        for i in range(n):
+            sim.store.add_pod(Pod(
+                name=f"p{i}",
+                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=120)
+        return sim
+
+    def test_garbage_messages_counted_and_deleted(self):
+        sim = self._booted_sim()
+        ic = sim.interruption
+        for raw in ("{broken", "12", '{"detail-type": 5}'):
+            sim.cloud.send_raw_message(raw)
+        # also a well-formed spot interruption for a real claim
+        claim = next(iter(sim.store.nodeclaims.values()))
+        iid = claim.provider_id.rsplit("/", 1)[-1]
+        sim.cloud.send_spot_interruption(iid)
+        ic.reconcile(sim.clock.now())
+        assert not sim.cloud.interruptions, "queue must fully drain"
+        assert ic.stats.get("parse-failed") == 2  # {broken + 12 decode fail
+        # '{"detail-type": 5}' is valid JSON, unknown kind → noop
+        assert ic.stats.get(wire.NOOP, 0) >= 1
+        assert ic.stats.get(wire.SPOT_INTERRUPTION) == 1
+        live = sim.store.nodeclaims.get(claim.name)
+        assert live is None or live.is_deleting()
+
+    def test_duplicate_delivery_dropped(self):
+        sim = self._booted_sim()
+        ic = sim.interruption
+        claim = next(iter(sim.store.nodeclaims.values()))
+        iid = claim.provider_id.rsplit("/", 1)[-1]
+        raw = wire.spot_interruption_event(
+            iid, claim.provider_id, sim.clock.now(), msg_id="dup-1")
+        sim.cloud.send_raw_message(raw)
+        sim.cloud.send_raw_message(raw)  # at-least-once redelivery
+        ic.reconcile(sim.clock.now())
+        assert ic.stats.get(wire.SPOT_INTERRUPTION) == 1
+        assert ic.stats.get("duplicate") == 1
+
+    def test_scheduled_change_drains_all_affected(self):
+        sim = self._booted_sim()
+        ic = sim.interruption
+        iids = [i.id for i in sim.cloud.describe()][:2]
+        sim.cloud.send_scheduled_change(iids)
+        ic.reconcile(sim.clock.now())
+        drained = [c for c in sim.store.nodeclaims.values()
+                   if c.is_deleting()]
+        assert len(drained) == len(iids)
+
+    def test_spot_interruption_marks_offering_unavailable(self):
+        sim = self._booted_sim()
+        ic = sim.interruption
+        claim = next(iter(sim.store.nodeclaims.values()))
+        iid = claim.provider_id.rsplit("/", 1)[-1]
+        sim.cloud.send_spot_interruption(iid)
+        ic.reconcile(sim.clock.now())
+        assert sim.catalog.unavailable.is_unavailable(
+            claim.instance_type, claim.zone, claim.capacity_type or "spot")

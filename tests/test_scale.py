@@ -326,12 +326,11 @@ class TestScaleSuite:
         for v, _ in zip(itertools.cycle(victims), range(900)):
             iid = v.provider_id.rsplit("/", 1)[-1]
             sim.cloud.send_spot_interruption(iid)
+        from karpenter_tpu.cloud.messages import spot_interruption_event
         for i in range(100):
-            sim.cloud.interruptions.append({
-                "kind": "spot-interruption", "instance_id": f"i-unknown{i}",
-                "provider_id": f"tpu:///zone-a/i-unknown{i}",
-                "instance_type": "m5.large", "zone": "zone-a",
-                "capacity_type": "spot", "time": sim.clock.now()})
+            sim.cloud.send_raw_message(spot_interruption_event(
+                f"i-unknown{i}", f"tpu:///zone-a/i-unknown{i}",
+                sim.clock.now()))
         with RECORDER.measure("interruption-1k", sim_clock=sim.clock,
                               messages=1000):
             sim.engine.run_until(lambda: not sim.cloud.interruptions,
