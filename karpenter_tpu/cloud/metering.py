@@ -44,10 +44,16 @@ class MeteredCloud:
         if name not in _API_METHODS or not callable(attr):
             return attr
 
-        def call(*args, __attr=attr, __name=name, **kwargs):
+        inner = self._inner
+
+        def call(*args, __name=name, **kwargs):
+            # resolve per call: swapping/monkeypatching a method on the
+            # wrapped cloud (test seams, snapshot-restore) must take
+            # effect — a captured bound method would silently pin the old
+            # one. One attribute lookup per call.
             t0 = time.perf_counter()
             try:
-                out = __attr(*args, **kwargs)
+                out = getattr(inner, __name)(*args, **kwargs)
             except Exception as e:
                 CLOUD_API_DURATION.observe(time.perf_counter() - t0,
                                            method=__name)

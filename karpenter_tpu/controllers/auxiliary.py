@@ -125,6 +125,8 @@ class CatalogRefreshController:
             self.store.record_event("catalog", "instance-types", "Discovered",
                                     f"{len(types)} instance types")
         if now - self._last_pricing >= self.pricing_interval:
+            # hydrate flags staleness itself when the backend hands back
+            # an empty book (degraded feed ≠ new truth)
             self.catalog.pricing.hydrate(types)
             self._last_pricing = now
         if self.images is not None:
@@ -147,17 +149,32 @@ class SpotPricingController:
     stats: Dict[str, int] = field(default_factory=lambda: {"updates": 0})
 
     def reconcile(self, now: float) -> float:
+        from ..cloud.provider import CloudError
         describe = getattr(self.cloud, "describe_spot_prices", None)
         if describe is None:
             return self.requeue
-        book = describe()
+        try:
+            book = describe()
+        except CloudError:
+            # feed down: solves keep running on the last good book; the
+            # staleness gauge is the operator's signal (pricing.go keeps
+            # the previous prices on DescribeSpotPriceHistory failure)
+            self.catalog.pricing.feed_failed()
+            self.stats["feed_failures"] = self.stats.get("feed_failures", 0) + 1
+            return self.requeue
         if not book:
+            self.catalog.pricing.feed_failed()
             return self.requeue
         changed = any(self.catalog.pricing.spot_price(t, z) != p
                       for (t, z), p in book.items())
-        if changed:
+        # a successful non-empty poll is fresh truth even when the prices
+        # match the retained book — staleness must not latch on after a
+        # recovered feed, or the gauge cries wolf until the next 12h
+        # hydrate
+        if changed or self.catalog.pricing.stale:
             self.catalog.pricing.update_spot(book)
-            self.stats["updates"] += 1
+            if changed:
+                self.stats["updates"] += 1
         return self.requeue
 
 

@@ -76,8 +76,12 @@ class CloudProviderMetricsController:
         NODEPOOL_USAGE.clear()
         NODEPOOL_LIMIT.clear()
         usage: dict = {}
+        from ..models.nodeclaim import Phase
         for claim in self.store.nodeclaims.values():
-            if claim.is_deleting():
+            # same exclusions as Provisioner._pool_usage (the limit gate):
+            # deleting AND failed claims don't consume the pool, so the
+            # exported gauge must not over-report relative to the gate
+            if claim.is_deleting() or claim.phase == Phase.FAILED:
                 continue
             per = usage.setdefault(claim.nodepool, {})
             for k, v in claim.capacity.items():

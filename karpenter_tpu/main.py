@@ -60,7 +60,12 @@ def build_operator(options: Optional[Options] = None,
     bcloud = BatchingCloud(mcloud, clock)
     # catalog refresh hits the wire too — meter it (DescribeInstanceTypes
     # is the reference middleware's dominant series)
-    catalog = CatalogProvider(lambda: mcloud.describe_types(), clock=clock)
+    from .catalog.pricing import PricingProvider
+    pricing = PricingProvider(
+        snapshot_path=opts.pricing_snapshot_file or None, clock=clock,
+        isolated=opts.isolated)
+    catalog = CatalogProvider(lambda: mcloud.describe_types(), clock=clock,
+                              pricing=pricing)
     catalog.raw_types()  # sync hydrate before controllers start
     solver = Solver(catalog, backend=opts.solver_backend,
                     profile_dir=opts.profile_dir)

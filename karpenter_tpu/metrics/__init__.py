@@ -55,6 +55,14 @@ INTERRUPTION_PARSE_FAILURES = REGISTRY.counter(
     "karpenter_tpu_interruption_message_parse_failures_total",
     "interruption payloads that failed wire-format parsing (counted and "
     "deleted, never retried — poison messages must not wedge the queue)")
+PRICING_STALE = REGISTRY.gauge(
+    "karpenter_tpu_pricing_stale",
+    "1 while prices are served from the last good book/snapshot because "
+    "the live pricing feed failed or returned nothing (reference "
+    "pricing.go static-table fallback)")
+PRICING_LAST_UPDATE = REGISTRY.gauge(
+    "karpenter_tpu_pricing_last_update_timestamp_seconds",
+    "wall time of the last successful pricing feed update")
 LIFECYCLE_DURATION = REGISTRY.histogram(
     "karpenter_nodeclaims_lifecycle_duration_seconds",
     "Seconds from creation to each lifecycle phase (reference: "
@@ -101,11 +109,11 @@ CLOUD_API_ERRORS = REGISTRY.counter(
     "Wire-level cloud API errors (raised, or returned in-band by "
     "create_fleet), by exception class", ("method", "error"))
 NODEPOOL_USAGE = REGISTRY.gauge(
-    "karpenter_nodepool_usage",
-    "Resources consumed by a NodePool's claims (reference "
-    "karpenter_nodepools_usage)", ("nodepool", "resource"))
+    "karpenter_nodepools_usage",
+    "Resources consumed by a NodePool's claims — reference series name, "
+    "so existing dashboards/alerts match", ("nodepool", "resource"))
 NODEPOOL_LIMIT = REGISTRY.gauge(
-    "karpenter_nodepool_limit",
+    "karpenter_nodepools_limit",
     "A NodePool's spec.limits (reference karpenter_nodepools_limit)",
     ("nodepool", "resource"))
 

@@ -112,16 +112,20 @@ class NodeClassSpec:
     metadata_http_tokens: str = "required"
     detailed_monitoring: bool = False
 
-    def hash(self) -> str:
-        """Static drift hash (reference EC2NodeClass.Hash(),
-        ec2nodeclass.go:482 — drift detection compares this against the
-        hash annotation stamped on launched nodes)."""
+    def _hash_fields(self) -> dict:
+        """The EXACT field set the static drift hash covers. Adding or
+        removing a key here without bumping NODECLASS_HASH_VERSION would
+        silently roll (or freeze) every fleet on upgrade — the hygiene
+        test (tests/test_hash_version.py) pins this dict's keys to the
+        version so the pair can only change together (the reference
+        enforces the same discipline by bumping its hash version,
+        ec2nodeclass.go:480)."""
         # selector terms (network groups) are hash-EXEMPT: their effect is
         # covered by the dynamic resolved-set drift comparison, so a
         # cosmetic selector rewrite that resolves to the same groups must
         # not roll the fleet (the reference marks securityGroupSelectorTerms
         # hash:"ignore" for exactly this reason); role/profile stay static
-        blob = json.dumps({
+        return {
             "zones": sorted(self.zones),
             "image_family": self.image_family,
             "image_selector": dict(sorted(self.image_selector.items())),
@@ -136,7 +140,13 @@ class NodeClassSpec:
                         dict(sorted(self.kubelet_eviction_hard.items()))],
             "metadata_http_tokens": self.metadata_http_tokens,
             "detailed_monitoring": self.detailed_monitoring,
-        }, sort_keys=True)
+        }
+
+    def hash(self) -> str:
+        """Static drift hash (reference EC2NodeClass.Hash(),
+        ec2nodeclass.go:482 — drift detection compares this against the
+        hash annotation stamped on launched nodes)."""
+        blob = json.dumps(self._hash_fields(), sort_keys=True)
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     # status (populated by the nodeclass controller)

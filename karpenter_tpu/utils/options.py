@@ -36,6 +36,9 @@ class Options:
     batch_max_seconds: float = 10.0
     max_instance_types: int = 60
     isolated: bool = False                # static pricing only (isolated-vpc)
+    # last-good price book persisted here (the reference's generated
+    # static price table analog); empty disables persistence
+    pricing_snapshot_file: str = ""
     metrics_port: int = 8080
     log_level: str = "info"
     # HA: lease-based leader election (reference: controller-runtime

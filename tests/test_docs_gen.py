@@ -1,0 +1,65 @@
+"""Docs generator (reference `make docgen`, hack/docs): the generated
+pages must exist, stay in sync with the live registry/catalog, and the
+per-instance-type page must cover the whole catalog."""
+
+import importlib.util
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_gen():
+    spec = importlib.util.spec_from_file_location(
+        "gen_docs", os.path.join(ROOT, "tools", "gen_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metrics_page_covers_registry():
+    gen = _load_gen()
+    from karpenter_tpu import metrics as M
+    page = gen.gen_metrics()
+    for m in M.REGISTRY._metrics:
+        assert f"`{m.name}`" in page
+
+
+def test_settings_page_covers_options():
+    gen = _load_gen()
+    from dataclasses import fields
+
+    from karpenter_tpu.utils.options import Options
+    page = gen.gen_settings()
+    for f in fields(Options):
+        if f.name == "feature_gates":
+            continue
+        assert f.name.replace("_", "-") in page
+
+
+def test_instance_types_page_covers_catalog():
+    gen = _load_gen()
+    from karpenter_tpu.catalog import GeneratorConfig, generate_catalog
+    types = generate_catalog(GeneratorConfig(families=["m5", "c5"]))
+    page = gen.gen_instance_types(types)
+    for t in types:
+        assert f"### `{t.name}`" in page
+    # labels, resources, and offerings sections render per type
+    assert page.count("#### Labels") == len(types)
+    assert page.count("#### Resources") == len(types)
+    assert page.count("#### Offerings") == len(types)
+    # the scheduling surface is present
+    assert "karpenter.tpu/instance-family" in page
+    assert "topology.kubernetes.io/region" in page
+    assert "on-demand" in page and "spot" in page
+
+
+def test_checked_in_instance_types_page_is_current():
+    """docs/reference/instance-types.md is generated output — a catalog
+    change without regenerating the page is documentation drift."""
+    gen = _load_gen()
+    path = os.path.join(ROOT, "docs", "reference", "instance-types.md")
+    assert os.path.exists(path), "run tools/gen_docs.py"
+    with open(path) as f:
+        on_disk = f.read()
+    assert on_disk == gen.gen_instance_types(), (
+        "docs/reference/instance-types.md is stale — rerun tools/gen_docs.py")

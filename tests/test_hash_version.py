@@ -1,0 +1,65 @@
+"""Hash-version hygiene: the NodeClass drift-hash field set and
+NODECLASS_HASH_VERSION may only change TOGETHER.
+
+A field added to the hash blob without a version bump makes every
+existing fleet's stamped hash mismatch → a silent full roll on operator
+upgrade; a removed field without a bump freezes real drift. The
+reference guards this with its hash-version discipline
+(ec2nodeclass.go:480, hash version v4 + the hash-version migration
+re-stamp); here the guard is executable.
+"""
+
+from karpenter_tpu.models.nodepool import (NODECLASS_HASH_VERSION,
+                                           NodeClassSpec)
+
+# THE SNAPSHOT: the exact keys _hash_fields() covered when the version
+# was last bumped. If the assertion below fails you changed the hashed
+# field set — bump NODECLASS_HASH_VERSION (models/nodepool.py) and update
+# this tuple IN THE SAME COMMIT; never update the tuple alone.
+HASHED_FIELDS = {
+    "v3": (
+        "block_device_gib",
+        "detailed_monitoring",
+        "image_family",
+        "image_selector",
+        "instance_store_policy",
+        "kubelet",
+        "metadata_http_tokens",
+        "node_profile",
+        "role",
+        "tags",
+        "user_data",
+        "zones",
+    ),
+}
+
+
+def test_hash_field_set_is_pinned_to_version():
+    assert NODECLASS_HASH_VERSION in HASHED_FIELDS, (
+        f"NODECLASS_HASH_VERSION is {NODECLASS_HASH_VERSION!r} but this "
+        "test has no field-set snapshot for it — add one (and only one "
+        "per version)")
+    want = HASHED_FIELDS[NODECLASS_HASH_VERSION]
+    got = tuple(sorted(NodeClassSpec()._hash_fields().keys()))
+    assert got == want, (
+        "the drift-hash field set changed without a "
+        "NODECLASS_HASH_VERSION bump — bump the version and snapshot the "
+        f"new set.\n  hashed now: {got}\n  {NODECLASS_HASH_VERSION} "
+        f"snapshot: {want}")
+
+
+def test_hash_changes_when_any_hashed_field_changes():
+    base = NodeClassSpec(name="x")
+    assert NodeClassSpec(name="x").hash() == base.hash()  # name not hashed
+    changed = [
+        NodeClassSpec(name="x", zones=["zone-a"]),
+        NodeClassSpec(name="x", user_data="v2"),
+        NodeClassSpec(name="x", block_device_gib=200.0),
+        NodeClassSpec(name="x", instance_store_policy="raid0"),
+        NodeClassSpec(name="x", tags={"a": "b"}),
+        NodeClassSpec(name="x", detailed_monitoring=True),
+        NodeClassSpec(name="x", kubelet_max_pods=64),
+    ]
+    hashes = {c.hash() for c in changed}
+    assert base.hash() not in hashes
+    assert len(hashes) == len(changed)  # each field change is distinct

@@ -1,0 +1,110 @@
+"""Pricing degraded mode: stale books keep solving, snapshots survive
+restarts, the staleness gauge tells the operator.
+
+Reference: pkg/providers/pricing/pricing.go:58-135 — static-table
+fallback when the Pricing API is unreachable, previous book retained on
+update failure.
+"""
+
+import pytest
+
+from karpenter_tpu.catalog.generator import small_catalog
+from karpenter_tpu.catalog.pricing import PricingProvider
+from karpenter_tpu.cloud.provider import ServerError
+from karpenter_tpu.metrics import PRICING_STALE
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _gauge_value(g):
+    vals = getattr(g, "_values", {})
+    return vals.get(tuple(), 0.0) if vals else 0.0
+
+
+class TestProvider:
+    def test_empty_hydrate_keeps_old_book_and_flags_stale(self):
+        p = PricingProvider(clock=FakeClock())
+        p.hydrate(small_catalog())
+        price = p.on_demand_price("c5.large")
+        assert price is not None and not p.stale
+        p.hydrate([])  # feed went dark
+        assert p.stale
+        assert p.on_demand_price("c5.large") == price  # still serving
+        assert _gauge_value(PRICING_STALE) == 1.0
+        p.hydrate(small_catalog())  # feed recovers
+        assert not p.stale
+        assert _gauge_value(PRICING_STALE) == 0.0
+
+    def test_snapshot_round_trip(self, tmp_path):
+        snap = str(tmp_path / "prices.json")
+        p1 = PricingProvider(snapshot_path=snap, clock=FakeClock())
+        p1.hydrate(small_catalog())
+        od = p1.on_demand_price("c5.large")
+        spot = p1.spot_price("c5.large", "zone-a")
+        # cold restart with a DEAD feed: the snapshot is the static table
+        p2 = PricingProvider(snapshot_path=snap, clock=FakeClock())
+        p2.feed_failed()
+        assert p2.on_demand_price("c5.large") == od
+        assert p2.spot_price("c5.large", "zone-a") == spot
+        assert p2.stale
+
+    def test_isolated_mode_serves_snapshot_without_staleness(self, tmp_path):
+        snap = str(tmp_path / "prices.json")
+        seed = PricingProvider(snapshot_path=snap, clock=FakeClock())
+        seed.hydrate(small_catalog())
+        iso = PricingProvider(snapshot_path=snap, clock=FakeClock(),
+                              isolated=True)
+        iso.feed_failed()  # no live feed is NORMAL when isolated
+        assert iso.on_demand_price("c5.large") is not None
+        assert not iso.stale
+
+
+class TestFeedDiesMidRun:
+    def test_solves_continue_on_stale_prices(self):
+        sim = make_sim()
+        for i in range(6):
+            sim.store.add_pod(Pod(
+                name=f"p{i}",
+                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=120)
+
+        # the spot feed starts throwing mid-run
+        sim.cloud.describe_spot_prices = _raise_server_error
+        from karpenter_tpu.controllers.auxiliary import SpotPricingController
+        spc = next(c for c in sim.engine.controllers
+                   if isinstance(c, SpotPricingController))
+        spc.reconcile(sim.clock.now())
+        assert sim.catalog.pricing.stale
+        assert spc.stats.get("feed_failures") == 1
+
+        # scheduling still works on the last good book
+        sim.store.add_pod(Pod(
+            name="late", requests=Resources.parse({"cpu": "500m",
+                                                   "memory": "1Gi"})))
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=120)
+
+        # feed recovers with UNCHANGED prices: staleness must not latch —
+        # a successful poll is fresh truth even when nothing moved
+        same = {(t, z): p for (t, z), p
+                in sim.catalog.pricing._spot.items()}
+        sim.cloud.describe_spot_prices = lambda: same
+        spc.reconcile(sim.clock.now())
+        assert not sim.catalog.pricing.stale
+
+        # and a changed book updates prices as usual
+        sim.catalog.pricing.feed_failed()
+        book = {("c5.large", "zone-a"): 0.031}
+        sim.cloud.describe_spot_prices = lambda: book
+        spc.reconcile(sim.clock.now())
+        assert not sim.catalog.pricing.stale
+        assert sim.catalog.pricing.spot_price("c5.large", "zone-a") == 0.031
+
+
+def _raise_server_error():
+    raise ServerError("pricing API unreachable")

@@ -203,11 +203,13 @@ class TestSubprocessE2E:
         launches over HTTP → nodes materialize (real-clock fake) → pods
         bind. The healthz probe gates startup like the reference operator's
         connectivity check."""
+        import os
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         proc = subprocess.Popen(
             [sys.executable, "-m", "karpenter_tpu.cloud.remote",
              "--ready-delay", "0.05"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            cwd="/root/repo", text=True)
+            cwd=repo_root, text=True)
         try:
             line = proc.stdout.readline().strip()
             assert line.startswith("READY "), line

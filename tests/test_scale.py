@@ -60,12 +60,40 @@ class TestScaleSuite:
         with RECORDER.measure("pod-dense", sim_clock=sim.clock, pods=6600):
             ok = sim.engine.run_until(lambda: all_bound(sim), timeout=1800)
         assert ok
-        # pods-per-node is capped by the 110-737 ENI-style limits; dense
-        # packing should land in the same order of magnitude as the
-        # reference's 60 nodes
-        assert len(sim.store.nodes) <= 90
+        # pods-per-node is capped by the 110-737 ENI-style limits; the
+        # reference lands 60 nodes x 110 pods — the cost-per-slot argmin
+        # picks high-cap types and does strictly better (14 nodes x ~478
+        # measured), so 60 is the regression ceiling, not the target
+        assert len(sim.store.nodes) <= 60
         # single CreateFleet batch for the whole burst
         assert sim.cloud.api_calls["create_fleet"] <= 3
+
+    def test_pod_dense_50k_full_loop(self):
+        """50k pods through the FULL reconcile loop (store → admission
+        index → encode → solve → launch → bind), wall-clock budgeted —
+        bench.py solves 100k at the kernel layer, but the controller path
+        has to survive this scale too (reference scale suite provisions
+        via the real controllers the same way)."""
+        import time
+        sim = make_sim(types=generate_catalog())
+        t0 = time.monotonic()
+        for i in range(50_000):
+            sim.store.add_pod(Pod(
+                name=f"pd50-{i}",
+                requests=Resources.parse(
+                    {"cpu": ["100m", "250m", "500m"][i % 3],
+                     "memory": ["256Mi", "512Mi", "1Gi"][i % 3]})))
+        with RECORDER.measure("pod-dense-50k", sim_clock=sim.clock,
+                              pods=50_000):
+            ok = sim.engine.run_until(lambda: all_bound(sim), timeout=3600)
+        wall = time.monotonic() - t0
+        assert ok
+        assert wall < 120, f"50k-pod loop took {wall:.0f}s wall-clock"
+        # the cost-per-slot argmin picks many small cheap nodes here (big
+        # types are pod-cap-bound, so their $/slot loses); 534 measured —
+        # the ceiling guards against packing regressions, not cost policy
+        assert len(sim.store.nodes) <= 560
+        assert sim.cloud.api_calls["create_fleet"] <= 6
 
     def test_pod_dense_min_values_30(self):
         """minValues=30 variant (reference provisioning_test.go:123-178):
