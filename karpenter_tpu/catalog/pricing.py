@@ -39,9 +39,21 @@ class PricingProvider:
         # serve the snapshot without flagging staleness
         self.isolated = isolated
         self.last_update: Optional[float] = None
-        self.stale = False
+        # which FEEDS are stale ("catalog" = the 12h hydrate, "spot" =
+        # the live spot poll): a healthy spot poll must not clear a
+        # staleness raised by a dead catalog feed — they fail
+        # independently and the gauge is the OR
+        self._stale_feeds: set = set()
         if snapshot_path:
             self._load_snapshot()
+
+    @property
+    def stale(self) -> bool:
+        return bool(self._stale_feeds)
+
+    @property
+    def spot_stale(self) -> bool:
+        return "spot" in self._stale_feeds
 
     # --- live feed ---
     def hydrate(self, types: Iterable[InstanceType]) -> None:
@@ -61,19 +73,20 @@ class PricingProvider:
                 else:
                     res[(t.name, o.zone)] = o.price
         if not od and not spot and not res:
-            self.feed_failed()
+            self.feed_failed("catalog")
             return
         self._on_demand, self._spot, self._reserved = od, spot, res
-        self._mark_fresh()
+        # the hydrate carries every book, so it refreshes BOTH feeds
+        self._mark_fresh("catalog", "spot")
 
     def update_spot(self, prices: Dict[Tuple[str, str], float]) -> None:
         if not prices:
-            self.feed_failed()
+            self.feed_failed("spot")
             return
         self._spot.update(prices)
-        self._mark_fresh()
+        self._mark_fresh("spot")
 
-    def feed_failed(self) -> None:
+    def feed_failed(self, feed: str = "catalog") -> None:
         """The live feed errored or returned nothing: keep serving what we
         have (loading the snapshot if we have nothing), raise the gauge.
         Matches pricing.go's behavior of retaining the previous book on
@@ -81,17 +94,17 @@ class PricingProvider:
         if not self._on_demand and not self._spot and not self._reserved:
             self._load_snapshot()
         if not self.isolated:
-            self.stale = True
+            self._stale_feeds.add(feed)
             from ..metrics import PRICING_STALE
             PRICING_STALE.set(1.0)
 
     # --- bookkeeping ---
-    def _mark_fresh(self) -> None:
+    def _mark_fresh(self, *feeds: str) -> None:
         self.updates += 1
         self.last_update = self.clock.now()
-        self.stale = False
+        self._stale_feeds.difference_update(feeds)
         from ..metrics import PRICING_LAST_UPDATE, PRICING_STALE
-        PRICING_STALE.set(0.0)
+        PRICING_STALE.set(1.0 if self._stale_feeds else 0.0)
         PRICING_LAST_UPDATE.set(self.last_update)
         self._save_snapshot()
 

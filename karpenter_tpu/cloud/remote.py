@@ -177,10 +177,27 @@ def _http_status(e: CloudError) -> int:
 # ---------------------------------------------------------------------------
 
 
-def make_server(cloud, host: str = "127.0.0.1", port: int = 0):
+def make_server(cloud, host: str = "127.0.0.1", port: int = 0,
+                lease_backend=None):
     """An http.server wrapping `cloud`; returns the server object (its
-    .server_address[1] is the bound port). Run with serve_forever()."""
+    .server_address[1] is the bound port). Run with serve_forever().
+
+    Besides the /rpc/* CloudProvider surface it serves a CAS'd leader
+    LEASE at /lease (get/update) — the coordination.k8s.io Lease-object
+    analog, so multi-replica deploys elect through the cloud endpoint
+    instead of needing a shared RWX volume for the file lease.
+
+    lease_backend: the record behind /lease. Production MUST pass a
+    durable backend (FileLeaseBackend on the gateway's own volume — see
+    the `main()` entrypoint's --lease-file): with the in-memory default
+    a gateway restart forgets the holder, and the standby can acquire
+    while the old leader is still inside its renew window. The gateway
+    itself must be a SINGLE instance (or share storage): two gateways
+    with independent backends are two independent leases."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..utils.leaderelection import InMemoryLeaseBackend, Lease
+    lease_backend = lease_backend or InMemoryLeaseBackend()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -197,11 +214,29 @@ def make_server(cloud, host: str = "127.0.0.1", port: int = 0):
         def do_GET(self):
             if self.path == "/healthz":
                 self._send(200, {"ok": True})
+            elif self.path == "/lease":
+                lease = lease_backend.get()
+                self._send(200, {"lease": lease.__dict__ if lease else None})
             else:
                 self._send(404, {"error": {"type": "NotFoundError",
                                            "msg": self.path}})
 
         def do_POST(self):
+            if self.path == "/lease":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    ok = lease_backend.update(
+                        Lease(**body["lease"]), body.get("expected_version"))
+                except (json.JSONDecodeError, KeyError, TypeError,
+                        ValueError) as e:
+                    # malformed/version-skewed lease body: structured 400,
+                    # not a handler-thread traceback
+                    self._send(400, {"error": {"type": "CloudError",
+                                               "msg": f"bad lease body: {e}"}})
+                    return
+                self._send(200, {"ok": ok})
+                return
             if not self.path.startswith("/rpc/"):
                 self._send(404, {"error": {"type": "NotFoundError",
                                            "msg": self.path}})
@@ -373,12 +408,20 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="serve a FakeCloud over HTTP")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--ready-delay", type=float, default=0.05)
+    ap.add_argument("--lease-file", default="",
+                    help="durable backing for the /lease endpoint — set "
+                         "in production so a gateway restart keeps the "
+                         "leader record (empty = in-memory, test only)")
     args = ap.parse_args(argv)
     cloud = FakeCloud(small_catalog(), clock=RealClock(),
                       config=FakeCloudConfig(
                           node_ready_delay=args.ready_delay,
                           register_delay=args.ready_delay / 2))
-    srv = make_server(cloud, port=args.port)
+    lease_backend = None
+    if args.lease_file:
+        from ..utils.leaderelection import FileLeaseBackend
+        lease_backend = FileLeaseBackend(args.lease_file)
+    srv = make_server(cloud, port=args.port, lease_backend=lease_backend)
     # the parent waits for this line before connecting
     print(f"READY {srv.server_address[1]}", flush=True)
     srv.serve_forever()

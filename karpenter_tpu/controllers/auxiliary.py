@@ -159,19 +159,19 @@ class SpotPricingController:
             # feed down: solves keep running on the last good book; the
             # staleness gauge is the operator's signal (pricing.go keeps
             # the previous prices on DescribeSpotPriceHistory failure)
-            self.catalog.pricing.feed_failed()
+            self.catalog.pricing.feed_failed("spot")
             self.stats["feed_failures"] = self.stats.get("feed_failures", 0) + 1
             return self.requeue
         if not book:
-            self.catalog.pricing.feed_failed()
+            self.catalog.pricing.feed_failed("spot")
             return self.requeue
         changed = any(self.catalog.pricing.spot_price(t, z) != p
                       for (t, z), p in book.items())
         # a successful non-empty poll is fresh truth even when the prices
-        # match the retained book — staleness must not latch on after a
-        # recovered feed, or the gauge cries wolf until the next 12h
-        # hydrate
-        if changed or self.catalog.pricing.stale:
+        # match the retained book — SPOT staleness must not latch on after
+        # a recovered feed (a dead catalog feed's staleness is its own and
+        # stays up until the hydrate recovers)
+        if changed or self.catalog.pricing.spot_stale:
             self.catalog.pricing.update_spot(book)
             if changed:
                 self.stats["updates"] += 1

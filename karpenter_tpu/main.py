@@ -113,17 +113,26 @@ def build_operator(options: Optional[Options] = None,
             termination=termination))
 
     elector = None
-    # empty lease path disables election even when the flag is on (the
-    # options docstring promises this; a FileLeaseBackend("") would fail
-    # every write and leave the replica permanently standby)
-    if opts.leader_elect and opts.leader_elect_lease_file:
+    # empty lease path/endpoint disables election even when the flag is on
+    # (the options docstring promises this; a FileLeaseBackend("") would
+    # fail every write and leave the replica permanently standby)
+    if opts.leader_elect and (opts.leader_elect_endpoint
+                              or opts.leader_elect_lease_file):
         import socket
-        from .utils.leaderelection import Elector, FileLeaseBackend
-        os_dir = os.path.dirname(opts.leader_elect_lease_file)
-        if os_dir:
-            os.makedirs(os_dir, exist_ok=True)
+        from .utils.leaderelection import (Elector, FileLeaseBackend,
+                                           HTTPLeaseBackend)
+        if opts.leader_elect_endpoint:
+            # elect through the cloud endpoint's CAS'd /lease — no shared
+            # volume needed (the Lease-through-API-server analog)
+            host, _, port = opts.leader_elect_endpoint.partition(":")
+            backend = HTTPLeaseBackend(host, int(port or 80))
+        else:
+            os_dir = os.path.dirname(opts.leader_elect_lease_file)
+            if os_dir:
+                os.makedirs(os_dir, exist_ok=True)
+            backend = FileLeaseBackend(opts.leader_elect_lease_file)
         elector = Elector(
-            backend=FileLeaseBackend(opts.leader_elect_lease_file),
+            backend=backend,
             identity=opts.leader_elect_identity
             or f"{socket.gethostname()}-{os.getpid()}")
     runtime = Runtime(clock=clock, metrics_port=opts.metrics_port,

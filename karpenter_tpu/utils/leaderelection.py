@@ -129,6 +129,52 @@ class FileLeaseBackend:
             return True
 
 
+class HTTPLeaseBackend:
+    """CAS lease through the remote cloud server's /lease endpoint — the
+    coordination.k8s.io Lease-through-API-server analog. Replicas elect
+    over the network instead of a shared RWX volume (the FileLeaseBackend
+    caveat in deploy/karpenter-tpu.yaml). Transport failures read as
+    'can't reach the lease': get() → None-safe False paths and update() →
+    False, so a partitioned leader steps down within renew_deadline, the
+    same way losing the API server does in client-go."""
+
+    def __init__(self, host: str, port: int, timeout: float = 2.0) -> None:
+        self.host, self.port, self.timeout = host, port, timeout
+
+    def _request(self, method: str, body: Optional[dict] = None):
+        import http.client
+        import json as _json
+        try:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            try:
+                conn.request(method, "/lease",
+                             body=_json.dumps(body) if body else None,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return _json.loads(resp.read())
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            # HTTPException covers a connection dropped mid-response
+            # (IncompleteRead/BadStatusLine) — same "can't reach the
+            # lease" semantics as a refused connection
+            return None
+
+    def get(self) -> Optional[Lease]:
+        out = self._request("GET")
+        if not out or out.get("lease") is None:
+            return None
+        return Lease(**out["lease"])
+
+    def update(self, lease: Lease, expected_version: Optional[int]) -> bool:
+        out = self._request("POST", {"lease": lease.__dict__,
+                                     "expected_version": expected_version})
+        return bool(out and out.get("ok"))
+
+
 @dataclass
 class Elector:
     backend: LeaseBackend

@@ -46,6 +46,10 @@ class Options:
     # sharing a volume real mutual exclusion; empty path disables election.
     leader_elect: bool = False
     leader_elect_lease_file: str = "/var/run/karpenter-tpu/leader.lease"
+    # host:port of a cloud endpoint serving the CAS'd /lease (the
+    # Lease-through-API-server analog); non-empty overrides the file
+    # backend and removes the shared-RWX-volume requirement
+    leader_elect_endpoint: str = ""
     leader_elect_identity: str = ""       # default: hostname-pid
     # feature gates (reference Makefile:21-24 + settings.md)
     feature_gates: Dict[str, bool] = field(default_factory=lambda: {

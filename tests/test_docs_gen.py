@@ -53,13 +53,17 @@ def test_instance_types_page_covers_catalog():
     assert "on-demand" in page and "spot" in page
 
 
-def test_checked_in_instance_types_page_is_current():
-    """docs/reference/instance-types.md is generated output — a catalog
-    change without regenerating the page is documentation drift."""
+def test_checked_in_generated_pages_are_current():
+    """docs/reference/* are generated output — a registry/options/catalog
+    change without regenerating them is documentation drift (found live:
+    settings.md shipped without the leader_elect_endpoint row)."""
     gen = _load_gen()
-    path = os.path.join(ROOT, "docs", "reference", "instance-types.md")
-    assert os.path.exists(path), "run tools/gen_docs.py"
-    with open(path) as f:
-        on_disk = f.read()
-    assert on_disk == gen.gen_instance_types(), (
-        "docs/reference/instance-types.md is stale — rerun tools/gen_docs.py")
+    for fname, generate in (("instance-types.md", gen.gen_instance_types),
+                            ("metrics.md", gen.gen_metrics),
+                            ("settings.md", gen.gen_settings)):
+        path = os.path.join(ROOT, "docs", "reference", fname)
+        assert os.path.exists(path), f"run tools/gen_docs.py ({fname})"
+        with open(path) as f:
+            on_disk = f.read()
+        assert on_disk == generate(), (
+            f"docs/reference/{fname} is stale — rerun tools/gen_docs.py")

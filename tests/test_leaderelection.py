@@ -247,3 +247,111 @@ class _FailingBackend:
 
     def update(self, lease, expected_version):
         return False
+
+
+class TestHTTPLeaseBackend:
+    """Election through the cloud endpoint's CAS'd /lease — the
+    Lease-through-API-server analog that removes the RWX-volume
+    requirement (deploy/karpenter-tpu.yaml LEADER_ELECT_ENDPOINT)."""
+
+    def _served(self):
+        from karpenter_tpu.catalog.generator import small_catalog
+        from karpenter_tpu.cloud import remote
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.utils.clock import FakeClock
+        cloud = FakeCloud(small_catalog(), clock=FakeClock())
+        return remote.serve_in_thread(cloud)
+
+    def test_two_replicas_one_leader(self):
+        from karpenter_tpu.utils.leaderelection import HTTPLeaseBackend
+        srv, port = self._served()
+        try:
+            a = Elector(backend=HTTPLeaseBackend("127.0.0.1", port),
+                        identity="replica-a")
+            b = Elector(backend=HTTPLeaseBackend("127.0.0.1", port),
+                        identity="replica-b")
+            now = 0.0
+            a.tick(now)
+            b.tick(now)
+            assert a.is_leader() and not b.is_leader()
+            # renewals hold the lease across the window
+            for now in (5.0, 10.0, 20.0, 30.0):
+                a.tick(now)
+                b.tick(now)
+            assert a.is_leader() and not b.is_leader()
+        finally:
+            srv.shutdown()
+
+    def test_release_hands_over(self):
+        from karpenter_tpu.utils.leaderelection import HTTPLeaseBackend
+        srv, port = self._served()
+        try:
+            a = Elector(backend=HTTPLeaseBackend("127.0.0.1", port),
+                        identity="replica-a")
+            b = Elector(backend=HTTPLeaseBackend("127.0.0.1", port),
+                        identity="replica-b")
+            a.tick(0.0)
+            b.tick(0.0)
+            a.release(1.0)
+            b.tick(2.0)  # immediate acquire: no lease_duration wait
+            assert not a.is_leader() and b.is_leader()
+        finally:
+            srv.shutdown()
+
+    def test_endpoint_down_steps_leader_down(self):
+        """A partitioned leader must step down within renew_deadline —
+        transport failures read as 'cannot CAS the lease'."""
+        from karpenter_tpu.utils.leaderelection import HTTPLeaseBackend
+        srv, port = self._served()
+        try:
+            a = Elector(backend=HTTPLeaseBackend("127.0.0.1", port,
+                                                 timeout=0.3),
+                        identity="replica-a")
+            a.tick(0.0)
+            assert a.is_leader()
+        finally:
+            srv.shutdown()
+        a.tick(5.0)   # endpoint gone; renew fails but deadline not hit
+        assert a.is_leader()
+        a.tick(11.0)  # renew_deadline (10s) exceeded -> stepped down
+        assert not a.is_leader()
+
+    def test_gateway_restart_keeps_holder(self, tmp_path):
+        """A durable /lease (FileLeaseBackend behind the gateway) must
+        survive a gateway restart: the standby may NOT acquire while the
+        old leader is still inside its renew window."""
+        from karpenter_tpu.catalog.generator import small_catalog
+        from karpenter_tpu.cloud import remote
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils.leaderelection import (FileLeaseBackend,
+                                                        HTTPLeaseBackend)
+        import threading
+        lease_file = str(tmp_path / "leader.lease")
+
+        def serve():
+            cloud = FakeCloud(small_catalog(), clock=FakeClock())
+            srv = remote.make_server(
+                cloud, lease_backend=FileLeaseBackend(lease_file))
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            return srv, srv.server_address[1]
+
+        srv, port = serve()
+        a = Elector(backend=HTTPLeaseBackend("127.0.0.1", port),
+                    identity="replica-a")
+        a.tick(0.0)
+        assert a.is_leader()
+        srv.shutdown()  # gateway restarts
+        srv2, port2 = serve()
+        try:
+            b = Elector(backend=HTTPLeaseBackend("127.0.0.1", port2),
+                        identity="replica-b")
+            b.tick(5.0)  # within a's 15s lease: record survived, b waits
+            assert not b.is_leader(), (
+                "standby acquired through a restarted gateway — the lease "
+                "record did not survive")
+            b.tick(30.0)  # lease expired for real: now b may take over
+            assert b.is_leader()
+        finally:
+            srv2.shutdown()

@@ -98,12 +98,19 @@ class TestFeedDiesMidRun:
         assert not sim.catalog.pricing.stale
 
         # and a changed book updates prices as usual
-        sim.catalog.pricing.feed_failed()
+        sim.catalog.pricing.feed_failed("spot")
         book = {("c5.large", "zone-a"): 0.031}
         sim.cloud.describe_spot_prices = lambda: book
         spc.reconcile(sim.clock.now())
         assert not sim.catalog.pricing.stale
         assert sim.catalog.pricing.spot_price("c5.large", "zone-a") == 0.031
+
+        # feed independence: a dead CATALOG feed's staleness is not
+        # cleared by a healthy spot poll
+        sim.catalog.pricing.feed_failed("catalog")
+        spc.reconcile(sim.clock.now())
+        assert sim.catalog.pricing.stale
+        assert not sim.catalog.pricing.spot_stale
 
 
 def _raise_server_error():
