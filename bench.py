@@ -19,6 +19,16 @@ import statistics
 import sys
 import time
 
+_T0 = time.time()
+
+
+def progress(msg: str) -> None:
+    """Timestamped progress on STDERR (stdout stays the one JSON line) —
+    the remote-TPU tunnel can hang mid-run, and a silent bench is
+    undiagnosable from the driver side."""
+    print(f"[bench {time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
 
 def timeit(fn, repeats=5):
     vals = []
@@ -49,6 +59,7 @@ def main() -> None:
                                               "memory": shapes[i % len(shapes)][1]}))
                 for i in range(n)]
 
+    progress("c1: 500 pods x small catalog")
     # --- config 1: kwok-scale, 500 pods, small catalog ---
     cat_small = encode_catalog(small_catalog())
     enc500 = encode_pods(mk_pods(500), cat_small)
@@ -68,12 +79,14 @@ def main() -> None:
     detail["c1_500pod_auto_ms"] = round(
         timeit(lambda: _solver.solve(_p500, _pool)) * 1e3, 1)
 
+    progress("c2: 10k x full catalog (first device compile ~20-40s)")
     # --- config 2 + headline: 10k / 100k pods, full catalog ---
     cat = encode_catalog(generate_catalog())
     enc10k = encode_pods(mk_pods(10_000), cat)
     solve_device(cat, enc10k)
     detail["c2_10k_full_ms"] = round(timeit(lambda: solve_device(cat, enc10k)) * 1e3, 1)
 
+    progress("c5: 100k x full catalog")
     pods100k = mk_pods(100_000)
     t0 = time.perf_counter()
     enc100k = encode_pods(pods100k, cat)
@@ -128,6 +141,7 @@ def main() -> None:
     except Exception:
         pass
 
+    progress("c3: 50k anti-affinity + spread")
     # --- config 3: 50k pods with anti-affinity + zone topology spread ---
     from karpenter_tpu.models.pod import (PodAffinityTerm,
                                           TopologySpreadConstraint)
@@ -157,6 +171,7 @@ def main() -> None:
     detail["c3_50k_affinity_ms"] = round(
         timeit(lambda: solve_device(cat, enc3), repeats=3) * 1e3, 1)
 
+    progress("c4: 5k-node consolidation screen")
     # --- config 4: 5k-node consolidation screen (one batched kernel call) ---
     import numpy as np
     from karpenter_tpu.models.nodeclaim import NodeClaim
@@ -205,6 +220,7 @@ def main() -> None:
             timeit(lambda: consolidation_screen(cat, enc4, views, counts),
                    repeats=3) * 1e3, 1)
 
+    progress("c6: 15k interruption messages")
     # --- config 6: interruption throughput, 15k queued messages ---
     # (reference interruption_benchmark_test.go:58-75 benches 100/1k/5k/15k
     # SQS messages; this is the 15k point through the real controller)
@@ -224,6 +240,7 @@ def main() -> None:
     detail["c6_interruption_15k_ms"] = round(dt * 1e3, 1)
     detail["c6_interruption_msgs_per_sec"] = round(15_000 / dt)
 
+    progress("done")
     result = {
         "metric": "p50 Solve() latency, 100k pods x full catalog",
         "value": round(tpu_s * 1e3, 1),

@@ -52,11 +52,16 @@ class Runtime:
     async def _run_elector(self) -> None:
         # release in finally: start() cancels this task on shutdown, so the
         # loop usually exits via CancelledError, not the while condition —
-        # the clean lease handover must survive both paths
+        # the clean lease handover must survive both paths.
+        # tick/release run OFF the event loop (to_thread): the HTTP lease
+        # backend does blocking I/O with multi-second timeouts against a
+        # possibly-unreachable gateway, and stalling the loop would take
+        # the metrics server down exactly when operators need it
         try:
             while not self._stop.is_set():
                 try:
-                    self.elector.tick(self.clock.now())
+                    await asyncio.to_thread(self.elector.tick,
+                                            self.clock.now())
                 except Exception:
                     self.crash_counts["elector"] = \
                         self.crash_counts.get("elector", 0) + 1
@@ -67,7 +72,12 @@ class Runtime:
                 except asyncio.TimeoutError:
                     pass
         finally:
-            self.elector.release(self.clock.now())
+            try:
+                await asyncio.shield(
+                    asyncio.to_thread(self.elector.release,
+                                      self.clock.now()))
+            except Exception:
+                log.exception("lease release failed")
 
     async def _run_controller(self, c) -> None:
         while not self._stop.is_set():

@@ -85,6 +85,70 @@ def _screen_kernel_impl(alloc, avail, node_type, node_cum, node_zmask,
 _screen_kernel = jax.jit(_screen_kernel_impl,
                          static_argnames=("use_pallas", "pallas_interpret"))
 
+
+# --- single-upload dispatch (same tunnel economics as solver._solve_onebuf:
+# upload COUNT, not bytes, is the latency budget — the 12-array call above
+# cost ~10 round-trips' worth of transfer latency per screen) ---
+
+
+def _pack_screen_nodes(node_type, node_cum, node_zmask, node_cmask, active,
+                       counts, cols) -> np.ndarray:
+    """One f32 [Np, 1+Rk+Z+C+1+G] matrix of all node-side screen inputs."""
+    return np.concatenate([
+        node_type[:, None].astype(np.float32),
+        node_cum[:, cols].astype(np.float32),
+        node_zmask.astype(np.float32),
+        node_cmask.astype(np.float32),
+        active[:, None].astype(np.float32),
+        counts.astype(np.float32),
+    ], axis=1)
+
+
+def _pack_screen_groups(req, compat, allow_zone, allow_cap,
+                        cols) -> np.ndarray:
+    """One f32 [G, Rk+T+Z+C] matrix of all group-side screen inputs."""
+    return np.concatenate([
+        req[:, cols].astype(np.float32),
+        compat.astype(np.float32),
+        allow_zone.astype(np.float32),
+        allow_cap.astype(np.float32),
+    ], axis=1)
+
+
+def _screen_onebuf_impl(alloc, avail, nbuf, gbuf, cols: tuple,
+                        use_pallas: bool = False,
+                        pallas_interpret: bool = False):
+    """Unpack by static offsets (resource columns projected to `cols` —
+    dropped columns carry no requests so they can never bind, same
+    argument as solver._solve_onebuf) and run the screen body."""
+    T, Z, C = avail.shape
+    Rk = len(cols)
+    G = gbuf.shape[0]
+    cix = jnp.asarray(np.asarray(cols, np.int32))
+    alloc_k = alloc[:, cix]
+    req = gbuf[:, :Rk]
+    o = Rk
+    compat = gbuf[:, o:o + T] > 0; o += T
+    allow_zone = gbuf[:, o:o + Z] > 0; o += Z
+    allow_cap = gbuf[:, o:o + C] > 0
+    node_type = nbuf[:, 0].astype(jnp.int32)
+    o = 1
+    node_cum = nbuf[:, o:o + Rk]; o += Rk
+    node_zmask = nbuf[:, o:o + Z] > 0; o += Z
+    node_cmask = nbuf[:, o:o + C] > 0; o += C
+    active = nbuf[:, o] > 0; o += 1
+    counts = nbuf[:, o:o + G]
+    return _screen_kernel_impl(alloc_k, avail, node_type, node_cum,
+                               node_zmask, node_cmask, active, req, compat,
+                               allow_zone, allow_cap, counts,
+                               use_pallas=use_pallas,
+                               pallas_interpret=pallas_interpret)
+
+
+_screen_onebuf = jax.jit(_screen_onebuf_impl,
+                         static_argnames=("cols", "use_pallas",
+                                          "pallas_interpret"))
+
 # mesh-jitted screens, keyed on the (hashable) Mesh itself and capped —
 # id() keys break under address reuse and pin dead meshes forever
 _mesh_screen_cache: dict = {}
@@ -110,18 +174,29 @@ def screen_device_time(cat: CatalogTensors, enc: EncodedPods, views,
                        group_counts: np.ndarray, iters: int = 40) -> float:
     """Per-call device time for the screen, in seconds (solver.slope_time
     over 8 variants with perturbed node cum — see that helper for why the
-    RTT cancels and why inputs must vary)."""
-    from .solver import slope_time
+    RTT cancels and why inputs must vary). Times the production onebuf
+    dispatch so the published number can't drift from the real path."""
+    from .solver import _auto_dcat, _put, _request_cols, slope_time
 
-    base = _screen_args(cat, enc, views, group_counts)
+    R = enc.requests.shape[1]
+    dcat = _auto_dcat(cat, R)
+    cols = _request_cols(enc, cat)
+    (_, _, node_type, node_cum, node_zmask, node_cmask, active,
+     req, compat, allow_zone, allow_cap, counts) = _screen_args(
+        cat, enc, views, group_counts)
+    gbuf = _put(_pack_screen_groups(req, compat, allow_zone, allow_cap,
+                                    list(cols)))
     variants = []
     for i in range(8):
-        a = list(base)
-        cum = np.asarray(a[3]).copy()
+        cum = node_cum.copy()
         cum[:, 0] += np.float32(i) * np.float32(0.001)
-        a[3] = cum
-        variants.append(tuple(jnp.asarray(x) for x in a))
-    return slope_time(lambda i: _screen_kernel(*variants[i % 8]), iters=iters)
+        variants.append(_put(_pack_screen_nodes(
+            node_type, cum, node_zmask, node_cmask, active, counts,
+            list(cols))))
+    return slope_time(
+        lambda i: _screen_onebuf(dcat.alloc, dcat.avail, variants[i % 8],
+                                 gbuf, cols=cols),
+        iters=iters)
 
 
 def _screen_args(cat: CatalogTensors, enc: EncodedPods, views,
@@ -181,28 +256,45 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         # node-axis arrays shard; catalog + group arrays replicate
         sharded = [rep_sh, rep_sh, nodes_sh, nodes_sh, nodes_sh, nodes_sh,
                    nodes_sh, rep_sh, rep_sh, rep_sh, rep_sh, nodes_sh]
-        packed = _mesh_screen_fn(mesh)(
-            *(jax.device_put(np.asarray(a), s) for a, s in zip(args, sharded)))
+        buf = np.asarray(_mesh_screen_fn(mesh)(
+            *(jax.device_put(np.asarray(a), s)
+              for a, s in zip(args, sharded))))
     else:
-        # single-device path may route the k-cap reduction through the
+        # single-device path: TWO packed uploads (node-side + group-side;
+        # catalog tensors ride the solver's per-epoch device cache) and
+        # one packed read. May route the k-cap reduction through the
         # opt-in Pallas kernel; the mesh path above stays fused-XLA (the
         # kernel is not GSPMD-partitioned — flag is inert there). A
         # failure at the REAL shape (the probe compiles a toy one) falls
         # back to the XLA path, as the pallas_screen contract promises.
         from . import pallas_screen
-        jargs = [jnp.asarray(a) for a in args]
+        from .solver import _auto_dcat, _put, _read, _request_cols
+        R = enc.requests.shape[1]
+        dcat = _auto_dcat(cat, R)
+        cols = _request_cols(enc, cat)
+        (_, _, node_type, node_cum, node_zmask, node_cmask, active,
+         req, compat, allow_zone, allow_cap, counts) = args
+        nbuf = _put(_pack_screen_nodes(node_type, node_cum, node_zmask,
+                                       node_cmask, active, counts,
+                                       list(cols)))
+        gbuf = _put(_pack_screen_groups(req, compat, allow_zone, allow_cap,
+                                        list(cols)))
         if pallas_screen.available():
             try:
-                packed = _screen_kernel(*jargs, use_pallas=True)
+                packed = _screen_onebuf(dcat.alloc, dcat.avail, nbuf, gbuf,
+                                        cols=cols, use_pallas=True)
             except Exception:
                 # latch OFF: jit does not cache failed compiles, so
                 # re-attempting every screen would pay a failed Mosaic
                 # compile on each disruption cycle
                 pallas_screen._status = False
-                packed = _screen_kernel(*jargs)
+                packed = _screen_onebuf(dcat.alloc, dcat.avail, nbuf, gbuf,
+                                        cols=cols)
         else:
-            packed = _screen_kernel(*jargs)
-    buf = np.asarray(packed)  # ONE host read
+            packed = _screen_onebuf(dcat.alloc, dcat.avail, nbuf, gbuf,
+                                    cols=cols)
+        buf = _read(packed)
+    # ONE host read either way; shared unpack of the packed layout
     screen = buf[:N] > 0.5
     slack = buf[Np: Np + N * enc.G].reshape(N, enc.G)
     return screen, slack

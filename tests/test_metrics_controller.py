@@ -1,0 +1,148 @@
+"""CloudProviderMetricsController + auxiliary controllers: the exported
+series must tell the truth about the cluster.
+
+Reference: pkg/controllers/metrics/metrics.go:31-59 (per-offering gauges)
++ the core metrics controllers' cluster-state families.
+"""
+
+from karpenter_tpu.metrics import (CLUSTER_NODES, CLUSTER_PODS,
+                                   NODEPOOL_LIMIT, NODEPOOL_USAGE,
+                                   OFFERING_AVAILABLE, OFFERING_PRICE,
+                                   REGISTRY)
+from karpenter_tpu.models.nodeclaim import Phase
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def _booted(n=6, **kw):
+    sim = make_sim(**kw)
+    for i in range(n):
+        sim.store.add_pod(Pod(
+            name=f"p{i}",
+            requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+    assert sim.engine.run_until(
+        lambda: all(p.node_name for p in sim.store.pods.values()),
+        timeout=120)
+    return sim
+
+
+def _series(gauge):
+    return dict(getattr(gauge, "_values", {}))
+
+
+class TestOfferingGauges:
+    def test_every_offering_exported(self):
+        sim = _booted()
+        from karpenter_tpu.controllers.metrics_controller import (
+            CloudProviderMetricsController)
+        mc = next(c for c in sim.engine.controllers
+                  if isinstance(c, CloudProviderMetricsController))
+        mc.reconcile(sim.clock.now())
+        n_offerings = sum(len(t.offerings) for t in sim.catalog.list())
+        assert len(_series(OFFERING_PRICE)) >= n_offerings
+        assert len(_series(OFFERING_AVAILABLE)) >= n_offerings
+
+    def test_unavailability_flows_into_gauge(self):
+        sim = _booted()
+        from karpenter_tpu.controllers.metrics_controller import (
+            CloudProviderMetricsController)
+        mc = next(c for c in sim.engine.controllers
+                  if isinstance(c, CloudProviderMetricsController))
+        mc.reconcile(sim.clock.now())
+        t = sim.catalog.list()[0]
+        o = t.offerings[0]
+        sim.catalog.unavailable.mark_unavailable(
+            t.name, o.zone, o.capacity_type, reason="test")
+        mc.reconcile(sim.clock.now())
+        key = tuple(v for _, v in sorted(dict(
+            instance_type=t.name, zone=o.zone,
+            capacity_type=o.capacity_type).items()))
+        vals = {k: v for k, v in _series(OFFERING_AVAILABLE).items()}
+        # find the series regardless of label ordering
+        hit = [v for k, v in vals.items()
+               if set((t.name, o.zone, o.capacity_type)) <= set(k)]
+        assert hit and hit[0] == 0.0
+
+
+class TestClusterState:
+    def test_node_and_pod_counts(self):
+        sim = _booted(n=4)
+        from karpenter_tpu.controllers.metrics_controller import (
+            CloudProviderMetricsController)
+        mc = next(c for c in sim.engine.controllers
+                  if isinstance(c, CloudProviderMetricsController))
+        mc.reconcile(sim.clock.now())
+        assert _series(CLUSTER_NODES)[()] == float(len(sim.store.nodes))
+        pods = _series(CLUSTER_PODS)
+        assert pods[("bound",)] == 4.0
+        assert pods[("pending",)] == 0.0
+
+    def test_nodepool_usage_excludes_deleting_and_failed(self):
+        """The gauge must mirror Provisioner._pool_usage's exclusions —
+        the exact ADVICE.md round-4 finding."""
+        from karpenter_tpu.models.pod import PodAffinityTerm
+        sim = make_sim()
+        for i in range(3):  # one pod per node -> three claims
+            sim.store.add_pod(Pod(
+                name=f"a{i}", labels={"role": "anchor"},
+                requests=Resources.parse({"cpu": "1", "memory": "2Gi"}),
+                affinity_terms=[PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector={"role": "anchor"}, anti=True)]))
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=120)
+        from karpenter_tpu.controllers.metrics_controller import (
+            CloudProviderMetricsController)
+        mc = next(c for c in sim.engine.controllers
+                  if isinstance(c, CloudProviderMetricsController))
+        mc.reconcile(sim.clock.now())
+        base = {k: v for k, v in _series(NODEPOOL_USAGE).items()
+                if "cpu" in k}
+        assert base, "expected a cpu usage series"
+        # fail one claim and delete another: usage must drop accordingly
+        claims = list(sim.store.nodeclaims.values())
+        victim_cap = claims[0].capacity.get("cpu")
+        claims[0].phase = Phase.FAILED
+        mc.reconcile(sim.clock.now())
+        after = {k: v for k, v in _series(NODEPOOL_USAGE).items()
+                 if "cpu" in k}
+        assert list(after.values())[0] == list(base.values())[0] - victim_cap
+        # provisioner gate agreement
+        pool = sim.store.nodepools["default"]
+        gate = sim.provisioner._pool_usage(pool).get("cpu")
+        assert abs(list(after.values())[0] - gate) < 1e-6
+
+    def test_reference_series_names(self):
+        """Dashboards key on the reference's exact names."""
+        exported = REGISTRY.expose()
+        assert "karpenter_nodepools_usage" in exported
+        assert "karpenter_nodepools_limit" in exported
+        assert "karpenter_nodepool_usage{" not in exported
+
+
+class TestTaggingAndDiscovery:
+    def test_instances_tagged_with_claim(self):
+        sim = _booted(n=3)
+        from karpenter_tpu.controllers.auxiliary import TaggingController
+        tc = next(c for c in sim.engine.controllers
+                  if isinstance(c, TaggingController))
+        tc.reconcile(sim.clock.now())
+        for inst in sim.cloud.instances.values():
+            if inst.state == "running":
+                assert inst.tags.get("karpenter.tpu/nodeclaim")
+
+    def test_discovered_capacity_feeds_catalog(self):
+        sim = _booted(n=3)
+        from karpenter_tpu.controllers.auxiliary import (
+            DiscoveredCapacityController)
+        dc = next(c for c in sim.engine.controllers
+                  if isinstance(c, DiscoveredCapacityController))
+        node = next(iter(sim.store.nodes.values()))
+        t_name = node.labels["node.kubernetes.io/instance-type"]
+        true_mem = node.capacity.get("memory") + 7 * 1024 ** 2
+        node.capacity["memory"] = true_mem
+        dc.reconcile(sim.clock.now())
+        it = next(t for t in sim.catalog.raw_types() if t.name == t_name)
+        assert abs(it.capacity.get("memory") - true_mem) <= 1

@@ -104,3 +104,35 @@ def test_projected_columns_match_full_axis():
             assert np.allclose(a.cum, b.cum)
     finally:
         S._cols_union.update(saved)
+
+
+def test_screen_budget_two_uploads_one_read():
+    """The consolidation screen ships node-side + group-side packed
+    buffers and reads one packed result — catalog tensors ride the
+    per-epoch device cache."""
+    import numpy as np
+
+    from karpenter_tpu.models.nodeclaim import NodeClaim
+    from karpenter_tpu.ops.binpack import VirtualNode
+    from karpenter_tpu.ops.consolidate import consolidation_screen
+    from karpenter_tpu.state.cluster import NodeView
+    cat = encode_catalog(small_catalog())
+    pods = _pods(40)
+    enc = encode_pods(pods, cat)
+    views = []
+    for i in range(10):
+        vn = VirtualNode(type_idx=i % cat.T, zone_mask=np.ones(cat.Z, bool),
+                         cap_mask=np.ones(cat.C, bool),
+                         cum=np.asarray(enc.requests[i % enc.G],
+                                        np.float32),
+                         existing_name=f"n{i}")
+        views.append(NodeView(claim=NodeClaim(name=f"n{i}",
+                                              nodepool="default"),
+                              node=None, pods=[], virtual=vn, price=0.1))
+    counts = np.zeros((len(views), enc.G), np.int32)
+    consolidation_screen(cat, enc, views, counts)  # warm: compile + dcat
+    up0, rd0 = S.transfer_stats()
+    consolidation_screen(cat, enc, views, counts)
+    up1, rd1 = S.transfer_stats()
+    assert up1 - up0 == 2, f"screen uploaded {up1 - up0} buffers"
+    assert rd1 - rd0 == 1
