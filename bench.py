@@ -223,7 +223,11 @@ def main() -> None:
     progress("c6: 15k interruption messages")
     # --- config 6: interruption throughput, 15k queued messages ---
     # (reference interruption_benchmark_test.go:58-75 benches 100/1k/5k/15k
-    # SQS messages; this is the 15k point through the real controller)
+    # SQS messages; this is the 15k point through the real controller).
+    # Round 5 note: messages are now RAW event-bus JSON parsed by
+    # cloud/messages.py (rounds ≤4 consumed pre-parsed dicts), so this
+    # config pays real wire-format parsing + dedupe like the reference's
+    # benchmark does — numbers are not comparable to BENCH_r04 and earlier.
     from karpenter_tpu.controllers.interruption import InterruptionController
     from karpenter_tpu.sim import make_sim
     sim = make_sim()

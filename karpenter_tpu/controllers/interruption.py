@@ -49,39 +49,55 @@ class InterruptionController:
 
     def reconcile(self, now: float) -> float:
         from ..metrics import INTERRUPTION_MESSAGES, INTERRUPTION_PARSE_FAILURES
-        while True:
-            batch = self.cloud.poll_interruptions(self.batch_size)
-            if not batch:
-                return self.requeue
-            for raw in list(batch):
-                try:
-                    msg = wire.parse(raw)
-                except wire.ParseError:
-                    # poison message: count it, ack it, move on — never
-                    # crash the consumer or wedge the queue head
-                    self.stats["parse-failed"] = (
-                        self.stats.get("parse-failed", 0) + 1)
-                    INTERRUPTION_PARSE_FAILURES.inc()
+        # metric increments batch per drain, not per message — the
+        # label-key build cost is visible at the 15k-message benchmark
+        kind_counts: Dict[str, int] = {}
+        parse_failures = 0
+        try:
+            while True:
+                batch = self.cloud.poll_interruptions(self.batch_size)
+                if not batch:
+                    return self.requeue
+                for raw in list(batch):
+                    try:
+                        msg = wire.parse(raw)
+                    except wire.ParseError:
+                        # poison message: count it, ack it, move on —
+                        # never crash the consumer or wedge the queue head
+                        self.stats["parse-failed"] = (
+                            self.stats.get("parse-failed", 0) + 1)
+                        parse_failures += 1
+                        self.cloud.delete_message(raw)
+                        continue
+                    if msg.metadata.id and msg.metadata.id in self._seen_set:
+                        self.stats["duplicate"] = (
+                            self.stats.get("duplicate", 0) + 1)
+                    else:
+                        # handle FIRST, register in the dedupe window only
+                        # on success: a raising _handle leaves the message
+                        # undeleted for redelivery, and that redelivery
+                        # must not be swallowed as a "duplicate"
+                        self._handle(msg, now)
+                        if msg.metadata.id:
+                            self._register(msg.metadata.id)
+                        self.stats[msg.kind] = self.stats.get(msg.kind, 0) + 1
+                        kind_counts[msg.kind] = kind_counts.get(msg.kind, 0) + 1
                     self.cloud.delete_message(raw)
-                    continue
-                if msg.metadata.id and not self._first_delivery(msg.metadata.id):
-                    self.stats["duplicate"] = self.stats.get("duplicate", 0) + 1
-                else:
-                    self.stats[msg.kind] = self.stats.get(msg.kind, 0) + 1
-                    INTERRUPTION_MESSAGES.inc(kind=msg.kind)
-                    self._handle(msg, now)
-                self.cloud.delete_message(raw)
-            if len(batch) < self.batch_size:
-                return self.requeue
+                if len(batch) < self.batch_size:
+                    return self.requeue
+        finally:
+            for kind, n in kind_counts.items():
+                INTERRUPTION_MESSAGES.inc(n, kind=kind)
+            if parse_failures:
+                INTERRUPTION_PARSE_FAILURES.inc(parse_failures)
 
-    def _first_delivery(self, msg_id: str) -> bool:
+    def _register(self, msg_id: str) -> None:
         if msg_id in self._seen_set:
-            return False
+            return
         if len(self._seen_ids) == self._seen_ids.maxlen:
             self._seen_set.discard(self._seen_ids[0])
         self._seen_ids.append(msg_id)
         self._seen_set.add(msg_id)
-        return True
 
     def _handle(self, msg: wire.ParsedMessage, now: float) -> None:
         if msg.kind not in ACTIONABLE:

@@ -59,9 +59,21 @@ class Runtime:
         # the metrics server down exactly when operators need it
         try:
             while not self._stop.is_set():
+                # shield the tick thread and, if we are cancelled while it
+                # runs, WAIT for it before falling into release(): the
+                # Elector has no internal locking, and a tick thread still
+                # CASing a renew while release() runs would re-take the
+                # lease the release just tried to clear
+                tick = asyncio.ensure_future(
+                    asyncio.to_thread(self.elector.tick, self.clock.now()))
                 try:
-                    await asyncio.to_thread(self.elector.tick,
-                                            self.clock.now())
+                    await asyncio.shield(tick)
+                except asyncio.CancelledError:
+                    try:
+                        await tick
+                    except Exception:
+                        pass
+                    raise
                 except Exception:
                     self.crash_counts["elector"] = \
                         self.crash_counts.get("elector", 0) + 1
@@ -72,10 +84,18 @@ class Runtime:
                 except asyncio.TimeoutError:
                     pass
         finally:
+            # BaseException: a cancel landing during this await must not
+            # leave the release thread unobserved — re-await the shielded
+            # work so the handover outcome is known before the task dies
+            rel = asyncio.ensure_future(
+                asyncio.to_thread(self.elector.release, self.clock.now()))
             try:
-                await asyncio.shield(
-                    asyncio.to_thread(self.elector.release,
-                                      self.clock.now()))
+                await asyncio.shield(rel)
+            except asyncio.CancelledError:
+                try:
+                    await rel
+                except Exception:
+                    log.exception("lease release failed")
             except Exception:
                 log.exception("lease release failed")
 

@@ -155,18 +155,20 @@ _mesh_screen_cache: dict = {}
 _MESH_SCREEN_CACHE_MAX = 16
 
 
-def _mesh_screen_fn(mesh):
-    """Node-axis-sharded screen: each chip computes its nodes' k[m, g] rows;
-    the total-over-nodes reduction becomes a psum GSPMD inserts. The packed
-    output replicates for the single host read."""
+def _mesh_screen_fn(mesh, cols: tuple):
+    """Node-axis-sharded ONEBUF screen: the packed node matrix shards over
+    the mesh (each chip computes its rows' k[m, g]); the total-over-nodes
+    reduction becomes a psum GSPMD inserts; the packed output replicates
+    for the single host read. Same 2-upload budget as single-device."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    fn = _mesh_screen_cache.get(mesh)
+    key = (mesh, cols)
+    fn = _mesh_screen_cache.get(key)
     if fn is None:
         if len(_mesh_screen_cache) >= _MESH_SCREEN_CACHE_MAX:
             _mesh_screen_cache.clear()
-        fn = jax.jit(_screen_kernel_impl,
+        fn = jax.jit(partial(_screen_onebuf_impl, cols=cols),
                      out_shardings=NamedSharding(mesh, P()))
-        _mesh_screen_cache[mesh] = fn
+        _mesh_screen_cache[key] = fn
     return fn
 
 
@@ -249,36 +251,38 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         return np.zeros(0, bool), np.zeros((0, enc.G), np.float32)
     Np = N if mesh is None else -(-N // int(mesh.size)) * int(mesh.size)
     args = _screen_args(cat, enc, views, group_counts, Np=Np)
+    from .solver import (_auto_dcat, _auto_dcat_mesh, _put, _put_sharded,
+                         _read, _request_cols)
+    R = enc.requests.shape[1]
+    cols = _request_cols(enc, cat)
+    (_, _, node_type, node_cum, node_zmask, node_cmask, active,
+     req, compat, allow_zone, allow_cap, counts) = args
+    nbuf_np = _pack_screen_nodes(node_type, node_cum, node_zmask,
+                                 node_cmask, active, counts, list(cols))
+    gbuf_np = _pack_screen_groups(req, compat, allow_zone, allow_cap,
+                                  list(cols))
     if mesh is not None:
+        # same 2-upload budget as single-device: the node matrix shards
+        # over the mesh, the group matrix + catalog replicate (catalog
+        # from the mesh-keyed epoch cache)
         from jax.sharding import NamedSharding, PartitionSpec as P
-        nodes_sh = NamedSharding(mesh, P("nodes"))
-        rep_sh = NamedSharding(mesh, P())
-        # node-axis arrays shard; catalog + group arrays replicate
-        sharded = [rep_sh, rep_sh, nodes_sh, nodes_sh, nodes_sh, nodes_sh,
-                   nodes_sh, rep_sh, rep_sh, rep_sh, rep_sh, nodes_sh]
-        buf = np.asarray(_mesh_screen_fn(mesh)(
-            *(jax.device_put(np.asarray(a), s)
-              for a, s in zip(args, sharded))))
+        dcat = _auto_dcat_mesh(cat, R, mesh)
+        nbuf = _put_sharded(nbuf_np, NamedSharding(mesh, P("nodes", None)))
+        gbuf = _put_sharded(gbuf_np, NamedSharding(mesh, P()))
+        buf = _read(_mesh_screen_fn(mesh, cols)(dcat.alloc, dcat.avail,
+                                                nbuf, gbuf))
     else:
         # single-device path: TWO packed uploads (node-side + group-side;
         # catalog tensors ride the solver's per-epoch device cache) and
         # one packed read. May route the k-cap reduction through the
-        # opt-in Pallas kernel; the mesh path above stays fused-XLA (the
+        # opt-in Pallas kernel; the mesh path stays fused-XLA (the
         # kernel is not GSPMD-partitioned — flag is inert there). A
         # failure at the REAL shape (the probe compiles a toy one) falls
         # back to the XLA path, as the pallas_screen contract promises.
         from . import pallas_screen
-        from .solver import _auto_dcat, _put, _read, _request_cols
-        R = enc.requests.shape[1]
         dcat = _auto_dcat(cat, R)
-        cols = _request_cols(enc, cat)
-        (_, _, node_type, node_cum, node_zmask, node_cmask, active,
-         req, compat, allow_zone, allow_cap, counts) = args
-        nbuf = _put(_pack_screen_nodes(node_type, node_cum, node_zmask,
-                                       node_cmask, active, counts,
-                                       list(cols)))
-        gbuf = _put(_pack_screen_groups(req, compat, allow_zone, allow_cap,
-                                        list(cols)))
+        nbuf = _put(nbuf_np)
+        gbuf = _put(gbuf_np)
         if pallas_screen.available():
             try:
                 packed = _screen_onebuf(dcat.alloc, dcat.avail, nbuf, gbuf,

@@ -128,6 +128,26 @@ def _auto_dcat(cat: CatalogTensors, R: int) -> DeviceCatalog:
     return dcat
 
 
+_dcat_mesh: dict = {}
+
+
+def _auto_dcat_mesh(cat: CatalogTensors, R: int, mesh) -> DeviceCatalog:
+    """Mesh-replicated flavor of _auto_dcat (same id-keyed + weakref
+    lifecycle); used by callers without their own cache (the sharded
+    consolidation screen)."""
+    import weakref
+    key = (id(cat), mesh)
+    ent = _dcat_mesh.get(key)
+    if (ent is not None and ent.alloc.shape[1] >= R
+            and (ent.ovh_z is not None) == (cat.zone_overhead is not None)):
+        return ent
+    if ent is None:
+        weakref.finalize(cat, _dcat_mesh.pop, key, None)
+    dcat = device_catalog(cat, R, mesh=mesh)
+    _dcat_mesh[key] = dcat
+    return dcat
+
+
 # ---------------------------------------------------------------------------
 # the kernel
 # ---------------------------------------------------------------------------

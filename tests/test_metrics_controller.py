@@ -55,12 +55,8 @@ class TestOfferingGauges:
         sim.catalog.unavailable.mark_unavailable(
             t.name, o.zone, o.capacity_type, reason="test")
         mc.reconcile(sim.clock.now())
-        key = tuple(v for _, v in sorted(dict(
-            instance_type=t.name, zone=o.zone,
-            capacity_type=o.capacity_type).items()))
-        vals = {k: v for k, v in _series(OFFERING_AVAILABLE).items()}
         # find the series regardless of label ordering
-        hit = [v for k, v in vals.items()
+        hit = [v for k, v in _series(OFFERING_AVAILABLE).items()
                if set((t.name, o.zone, o.capacity_type)) <= set(k)]
         assert hit and hit[0] == 0.0
 
@@ -101,14 +97,17 @@ class TestClusterState:
         base = {k: v for k, v in _series(NODEPOOL_USAGE).items()
                 if "cpu" in k}
         assert base, "expected a cpu usage series"
-        # fail one claim and delete another: usage must drop accordingly
+        # fail one claim AND delete another: both exclusions must hold
         claims = list(sim.store.nodeclaims.values())
-        victim_cap = claims[0].capacity.get("cpu")
+        failed_cap = claims[0].capacity.get("cpu")
         claims[0].phase = Phase.FAILED
+        deleting_cap = claims[1].capacity.get("cpu")
+        claims[1].deletion_timestamp = sim.clock.now()
         mc.reconcile(sim.clock.now())
         after = {k: v for k, v in _series(NODEPOOL_USAGE).items()
                  if "cpu" in k}
-        assert list(after.values())[0] == list(base.values())[0] - victim_cap
+        assert list(after.values())[0] == (
+            list(base.values())[0] - failed_cap - deleting_cap)
         # provisioner gate agreement
         pool = sim.store.nodepools["default"]
         gate = sim.provisioner._pool_usage(pool).get("cpu")
