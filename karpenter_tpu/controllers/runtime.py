@@ -69,10 +69,7 @@ class Runtime:
                 try:
                     await asyncio.shield(tick)
                 except asyncio.CancelledError:
-                    try:
-                        await tick
-                    except Exception:
-                        pass
+                    await self._join_thread(tick, "elector tick")
                     raise
                 except Exception:
                     self.crash_counts["elector"] = \
@@ -84,20 +81,29 @@ class Runtime:
                 except asyncio.TimeoutError:
                     pass
         finally:
-            # BaseException: a cancel landing during this await must not
-            # leave the release thread unobserved — re-await the shielded
-            # work so the handover outcome is known before the task dies
+            # the original exception (if any) resumes after this completes
             rel = asyncio.ensure_future(
                 asyncio.to_thread(self.elector.release, self.clock.now()))
+            await self._join_thread(rel, "lease release")
+
+    @staticmethod
+    async def _join_thread(fut: "asyncio.Future", what: str) -> None:
+        """Await a to_thread future to COMPLETION, surviving any number of
+        cancellations delivered while waiting (the thread's I/O has finite
+        timeouts, so this terminates): the lease invariants — tick joined
+        before release runs, release outcome observed before the task dies
+        — must hold even when shutdown cancels the elector task twice."""
+        while True:
             try:
-                await asyncio.shield(rel)
+                await asyncio.shield(fut)
+                return
             except asyncio.CancelledError:
-                try:
-                    await rel
-                except Exception:
-                    log.exception("lease release failed")
+                if fut.done():
+                    return
+                continue
             except Exception:
-                log.exception("lease release failed")
+                log.exception("%s failed", what)
+                return
 
     async def _run_controller(self, c) -> None:
         while not self._stop.is_set():

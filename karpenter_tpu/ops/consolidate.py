@@ -251,8 +251,8 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         return np.zeros(0, bool), np.zeros((0, enc.G), np.float32)
     Np = N if mesh is None else -(-N // int(mesh.size)) * int(mesh.size)
     args = _screen_args(cat, enc, views, group_counts, Np=Np)
-    from .solver import (_auto_dcat, _auto_dcat_mesh, _put, _put_sharded,
-                         _read, _request_cols)
+    from .solver import (_auto_dcat, _put, _put_sharded, _read,
+                         _request_cols)
     R = enc.requests.shape[1]
     cols = _request_cols(enc, cat)
     (_, _, node_type, node_cum, node_zmask, node_cmask, active,
@@ -266,7 +266,7 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         # over the mesh, the group matrix + catalog replicate (catalog
         # from the mesh-keyed epoch cache)
         from jax.sharding import NamedSharding, PartitionSpec as P
-        dcat = _auto_dcat_mesh(cat, R, mesh)
+        dcat = _auto_dcat(cat, R, mesh=mesh)
         nbuf = _put_sharded(nbuf_np, NamedSharding(mesh, P("nodes", None)))
         gbuf = _put_sharded(gbuf_np, NamedSharding(mesh, P()))
         buf = _read(_mesh_screen_fn(mesh, cols)(dcat.alloc, dcat.avail,

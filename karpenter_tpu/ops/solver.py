@@ -114,37 +114,21 @@ def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
 _dcat_auto: dict = {}
 
 
-def _auto_dcat(cat: CatalogTensors, R: int) -> DeviceCatalog:
+def _auto_dcat(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
+    """Epoch-cached device catalog for callers without their own cache;
+    mesh=None caches the single-device replica, a Mesh caches the
+    mesh-replicated one (same staleness predicate and weakref lifecycle
+    — ONE implementation so the two can't diverge)."""
     import weakref
-    key = id(cat)
+    key = (id(cat), mesh)
     ent = _dcat_auto.get(key)
     if (ent is not None and ent.alloc.shape[1] >= R
             and (ent.ovh_z is not None) == (cat.zone_overhead is not None)):
         return ent
     if ent is None:
         weakref.finalize(cat, _dcat_auto.pop, key, None)
-    dcat = device_catalog(cat, R)
-    _dcat_auto[key] = dcat
-    return dcat
-
-
-_dcat_mesh: dict = {}
-
-
-def _auto_dcat_mesh(cat: CatalogTensors, R: int, mesh) -> DeviceCatalog:
-    """Mesh-replicated flavor of _auto_dcat (same id-keyed + weakref
-    lifecycle); used by callers without their own cache (the sharded
-    consolidation screen)."""
-    import weakref
-    key = (id(cat), mesh)
-    ent = _dcat_mesh.get(key)
-    if (ent is not None and ent.alloc.shape[1] >= R
-            and (ent.ovh_z is not None) == (cat.zone_overhead is not None)):
-        return ent
-    if ent is None:
-        weakref.finalize(cat, _dcat_mesh.pop, key, None)
     dcat = device_catalog(cat, R, mesh=mesh)
-    _dcat_mesh[key] = dcat
+    _dcat_auto[key] = dcat
     return dcat
 
 

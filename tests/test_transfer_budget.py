@@ -136,3 +136,39 @@ def test_screen_budget_two_uploads_one_read():
     up1, rd1 = S.transfer_stats()
     assert up1 - up0 == 2, f"screen uploaded {up1 - up0} buffers"
     assert rd1 - rd0 == 1
+
+
+def test_mesh_screen_budget_two_uploads_one_read():
+    """The MESH screen path holds the same budget: sharded node matrix +
+    replicated group matrix, one packed read (catalog from the mesh-keyed
+    epoch cache) — the doc's 'single-device and mesh alike' claim,
+    enforced."""
+    import numpy as np
+
+    from karpenter_tpu.models.nodeclaim import NodeClaim
+    from karpenter_tpu.ops.binpack import VirtualNode
+    from karpenter_tpu.ops.consolidate import consolidation_screen
+    from karpenter_tpu.parallel import make_mesh
+    from karpenter_tpu.state.cluster import NodeView
+    mesh = make_mesh(8)
+    cat = encode_catalog(small_catalog())
+    enc = encode_pods(_pods(40), cat)
+    views = []
+    for i in range(13):  # odd count exercises the padding rows
+        vn = VirtualNode(type_idx=i % cat.T, zone_mask=np.ones(cat.Z, bool),
+                         cap_mask=np.ones(cat.C, bool),
+                         cum=np.asarray(enc.requests[i % enc.G], np.float32),
+                         existing_name=f"n{i}")
+        views.append(NodeView(claim=NodeClaim(name=f"n{i}",
+                                              nodepool="default"),
+                              node=None, pods=[], virtual=vn, price=0.1))
+    counts = np.zeros((len(views), enc.G), np.int32)
+    sm, _ = consolidation_screen(cat, enc, views, counts, mesh=mesh)  # warm
+    up0, rd0 = S.transfer_stats()
+    sm2, slack2 = consolidation_screen(cat, enc, views, counts, mesh=mesh)
+    up1, rd1 = S.transfer_stats()
+    assert up1 - up0 == 2, f"mesh screen uploaded {up1 - up0} buffers"
+    assert rd1 - rd0 == 1
+    # and agrees with the single-device path
+    s1, k1 = consolidation_screen(cat, enc, views, counts)
+    assert (sm2 == s1).all() and np.allclose(slack2, k1)
