@@ -99,6 +99,11 @@ class Runtime:
                 return
             except asyncio.CancelledError:
                 if fut.done():
+                    # observe the outcome even on this path, or a failed
+                    # release is silently dropped (plus an asyncio
+                    # "exception was never retrieved" warning at GC)
+                    if not fut.cancelled() and fut.exception() is not None:
+                        log.error("%s failed: %r", what, fut.exception())
                     return
                 continue
             except Exception:

@@ -1,0 +1,155 @@
+"""NodeOverlay semantics + node auto-repair windows.
+
+Reference: the core NodeOverlay CRD (price/priceAdjustment override +
+capacity injection, weight-ordered) and RepairPolicies
+(cloudprovider.go:268-309 — per-condition toleration windows, then force
+replace; NodeRepair feature gate).
+"""
+
+from karpenter_tpu.catalog import CatalogProvider, small_catalog
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.overlay import NodeOverlay, apply_overlays
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                               Requirements)
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def _sel(**kv):
+    r = Requirements()
+    for k, v in kv.items():
+        r.add(Requirement(k, Operator.IN, (v,)))
+    return r
+
+
+class TestOverlays:
+    def test_percent_and_absolute_price(self):
+        o = NodeOverlay(name="o", price_adjustment="+10%")
+        assert abs(o.adjust_price(1.0) - 1.1) < 1e-9
+        o2 = NodeOverlay(name="o2", price_adjustment="-50%")
+        assert abs(o2.adjust_price(1.0) - 0.5) < 1e-9
+        o3 = NodeOverlay(name="o3", price_adjustment="0.25")
+        assert o3.adjust_price(9.0) == 0.25
+        # adjustments never go negative
+        o4 = NodeOverlay(name="o4", price_adjustment="-200%")
+        assert o4.adjust_price(1.0) == 0.0
+
+    def test_heaviest_matching_overlay_wins_price(self):
+        types = small_catalog()
+        fam = types[0].name.split(".")[0]
+        heavy = NodeOverlay(
+            name="heavy", weight=10, price_adjustment="+100%",
+            requirements=_sel(**{L.INSTANCE_FAMILY: fam}))
+        light = NodeOverlay(
+            name="light", weight=1, price_adjustment="-50%",
+            requirements=_sel(**{L.INSTANCE_FAMILY: fam}))
+        out = apply_overlays(types, [light, heavy])
+        base = next(t for t in types if t.name.startswith(fam))
+        adj = next(t for t in out if t.name == base.name)
+        assert abs(adj.offerings[0].price
+                   - base.offerings[0].price * 2.0) < 1e-9
+
+    def test_capacity_injection_merges_across_overlays(self):
+        types = small_catalog()
+        fam = types[0].name.split(".")[0]
+        a = NodeOverlay(name="a",
+                        capacity=Resources.parse({"vendor.io/dev": "4"}),
+                        requirements=_sel(**{L.INSTANCE_FAMILY: fam}))
+        b = NodeOverlay(name="b",
+                        capacity=Resources.parse({"other.io/thing": "1"}),
+                        requirements=_sel(**{L.INSTANCE_FAMILY: fam}))
+        out = apply_overlays(types, [a, b])
+        adj = next(t for t in out if t.name.startswith(fam))
+        assert adj.capacity.get("vendor.io/dev") == 4
+        assert adj.capacity.get("other.io/thing") == 1
+        # non-matching types untouched (and originals never mutated)
+        orig = next(t for t in types if t.name.startswith(fam))
+        assert orig.capacity.get("vendor.io/dev") == 0
+
+    def test_overlay_capacity_schedules_custom_resource_pods(self):
+        """End-to-end: an injected device resource makes otherwise
+        unschedulable pods land on the overlaid family."""
+        provider = CatalogProvider(lambda: small_catalog())
+        fam = small_catalog()[0].name.split(".")[0]
+        provider.set_overlays([NodeOverlay(
+            name="dev", capacity=Resources.parse({"vendor.io/dev": "8"}),
+            requirements=_sel(**{L.INSTANCE_FAMILY: fam}))])
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.ops.facade import Solver
+        solver = Solver(provider, backend="host")
+        out = solver.solve(
+            [Pod(name="d0", requests=Resources.parse(
+                {"cpu": "250m", "vendor.io/dev": "2"}))],
+            NodePool(name="p"))
+        assert out.launches and not out.unschedulable
+        assert out.launches[0].instance_type.startswith(fam)
+
+    def test_overlay_change_bumps_availability_version(self):
+        provider = CatalogProvider(lambda: small_catalog())
+        v0 = provider._availability_version()
+        provider.set_overlays([NodeOverlay(name="x",
+                                           price_adjustment="+5%")])
+        assert provider._availability_version() != v0
+
+
+class TestRepairWindows:
+    def _booted(self):
+        sim = make_sim()
+        for i in range(3):
+            sim.store.add_pod(Pod(
+                name=f"p{i}",
+                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=120)
+        return sim
+
+    def test_not_ready_tolerated_then_replaced(self):
+        sim = self._booted()
+        node = next(iter(sim.store.nodes.values()))
+        claim_name = node.nodeclaim
+        iid = node.provider_id.rsplit("/", 1)[-1]
+        sim.cloud.make_unhealthy(iid)
+        # within the 30m Ready toleration: nothing happens
+        sim.engine.run_for(20 * 60, step=30)
+        live = sim.store.nodeclaims.get(claim_name)
+        assert live is not None and not live.is_deleting(), (
+            "repair fired inside the toleration window")
+        # past the window: replaced, workloads end up bound again
+        sim.engine.run_for(20 * 60, step=30)
+        sim.engine.run_for(120, step=5)
+        gone = sim.store.nodeclaims.get(claim_name)
+        assert gone is None or gone.is_deleting()
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=600)
+
+    def test_recovery_resets_window(self):
+        sim = self._booted()
+        node = next(iter(sim.store.nodes.values()))
+        claim_name = node.nodeclaim
+        iid = node.provider_id.rsplit("/", 1)[-1]
+        sim.cloud.make_unhealthy(iid)
+        sim.engine.run_for(20 * 60, step=30)
+        sim.cloud.unhealthy.discard(iid)  # kubelet recovers
+        sim.engine.run_for(15 * 60, step=30)
+        sim.cloud.make_unhealthy(iid)     # flaps again
+        sim.engine.run_for(20 * 60, step=30)
+        # two 20m windows separated by recovery: never crosses 30m
+        live = sim.store.nodeclaims.get(claim_name)
+        assert live is not None and not live.is_deleting(), (
+            "repair window did not reset on recovery")
+
+    def test_gate_off_disables_repair(self):
+        sim = self._booted()
+        from karpenter_tpu.controllers.repair import NodeRepairController
+        rc = next(c for c in sim.engine.controllers
+                  if isinstance(c, NodeRepairController))
+        rc.enabled = False
+        node = next(iter(sim.store.nodes.values()))
+        claim_name = node.nodeclaim
+        sim.cloud.make_unhealthy(node.provider_id.rsplit("/", 1)[-1])
+        sim.engine.run_for(45 * 60, step=60)
+        live = sim.store.nodeclaims.get(claim_name)
+        assert live is not None and not live.is_deleting()
