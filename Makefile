@@ -1,0 +1,42 @@
+# Developer entrypoints — the reference Makefile's target surface mapped
+# onto this framework (test / benchmark / docgen / e2e / deflake).
+
+PY ?= python
+
+.PHONY: help test e2etests scaletests benchmark docgen verify-docs \
+        deflake run native clean
+
+help:
+	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
+
+test:  ## full suite on the 8-device virtual CPU mesh (tests/conftest.py)
+	$(PY) -m pytest tests/ -q
+
+e2etests:  ## the e2e slices (sim + subprocess remote cloud)
+	$(PY) -m pytest tests/test_e2e_slice.py tests/test_remote_cloud.py -q
+
+scaletests:  ## the scale grid (node-dense / pod-dense / deprovisioning)
+	$(PY) -m pytest tests/test_scale.py -q
+
+benchmark:  ## one JSON line on the attached TPU (reference: make benchmark)
+	$(PY) bench.py
+
+docgen:  ## regenerate docs/reference/* from the live registry + catalog
+	$(PY) tools/gen_docs.py
+
+verify-docs:  ## fail if checked-in generated pages are stale
+	$(PY) -m pytest tests/test_docs_gen.py -q
+
+deflake:  ## rerun the suite until it fails (reference: make deflake)
+	@n=1; while $(PY) -m pytest tests/ -q -x; do \
+	  echo "=== pass $$n green ==="; n=$$((n+1)); done
+
+run:  ## run the operator against the fake cloud
+	$(PY) -m karpenter_tpu.main
+
+native:  ## build the C++ FFD solver explicitly (ops/native.py autoloads it)
+	$(PY) -c "from karpenter_tpu.ops import native; lib = native._load(); print(lib or native._build_error); raise SystemExit(0 if lib else 1)"
+
+clean:
+	rm -rf native/build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +
