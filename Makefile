@@ -29,7 +29,8 @@ verify-docs:  ## fail if checked-in generated pages are stale
 
 deflake:  ## rerun the suite until it fails (reference: make deflake)
 	@n=1; while $(PY) -m pytest tests/ -q -x; do \
-	  echo "=== pass $$n green ==="; n=$$((n+1)); done
+	  echo "=== pass $$n green ==="; n=$$((n+1)); done; \
+	echo "=== FLAKE found on pass $$n ==="; exit 1
 
 run:  ## run the operator against the fake cloud
 	$(PY) -m karpenter_tpu.main

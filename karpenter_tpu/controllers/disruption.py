@@ -131,6 +131,14 @@ class DisruptionController:
         views = [v for v in build_node_views(self.store, cat, now)
                  if v.claim.nodepool == pd.pool]
         victim_set = set(pd.victim_claims)
+        # a do-not-disrupt annotation applied (or a do-not-disrupt pod
+        # landed) after the decision invalidates it — node-level controls
+        # block voluntary disruption up to the last moment, unless the
+        # claim's terminationGracePeriod forces it
+        for v in views:
+            if (v.name in victim_set and v.has_do_not_disrupt()
+                    and v.claim.termination_grace_period is None):
+                return False
         pods = [p for v in views if v.name in victim_set for p in v.pods]
         if not pods:
             return True  # victims drained on their own: trivially safe
@@ -185,18 +193,26 @@ class DisruptionController:
         self._pdb_allowed = {key: self.store.pdb_disruptions_allowed(pdb)
                              for key, pdb in self.store.pdbs.items()}
 
-        # 1. drift (nodeclass hash mismatch) + expiration
+        # 1. drift (nodeclass hash mismatch) + expiration.
+        # do-not-disrupt (pod- or node-level) and PDBs gate these too —
+        # UNLESS the claim carries a terminationGracePeriod, which the
+        # reference treats as the operator's "this node WILL eventually
+        # go" override (disruption.md:260-268: with it set, drift may
+        # disrupt past blocking PDBs / do-not-disrupt)
         for v in views:
             if budget_for("Drifted") <= 0:
                 break
-            if self._pdb_blocked(v):
+            forced = v.claim.termination_grace_period is not None
+            if not forced and (self._pdb_blocked(v)
+                               or v.has_do_not_disrupt()):
                 continue
             if self._is_drifted(v, node_class):
-                self._replace(pool, [v], "Drifted", now, cat, views)
+                self._replace(pool, [v], "Drifted", now, cat, views,
+                              forced=forced)
             elif (pool.expire_after is not None
                   and now - v.claim.created_at > pool.expire_after):
                 self._replace(pool, [v], "Expired", now, cat, views,
-                              stat="expired")
+                              stat="expired", forced=forced)
 
         if pool.disruption.consolidation_policy == "WhenEmpty":
             self._empty_pass(pool, views, now)
@@ -240,6 +256,7 @@ class DisruptionController:
                 break
             if (not v.pods and v.claim.phase == Phase.INITIALIZED
                     and not v.claim.is_deleting()
+                    and not v.has_do_not_disrupt()  # node-level annotation
                     and not self._is_pending_victim(v.name)
                     and now - v.claim.initialized_at >= settle):
                 self.termination.delete_nodeclaim(v.claim, now, "Empty")
@@ -476,13 +493,16 @@ class DisruptionController:
 
     def _replace(self, pool: NodePool, victims: List[NodeView], reason: str,
                  now: float, cat, views: List[NodeView],
-                 stat: str = "drift") -> None:
+                 stat: str = "drift", forced: bool = False) -> None:
         if self._is_pending_victim(victims[0].name) or victims[0].claim.is_deleting():
             return
         # final PDB check: the consolidation candidate list was filtered
         # with the allowances as of the top of the pass; earlier commits
-        # in this pass may have consumed them
-        if self._pdb_blocked_set(victims):
+        # in this pass may have consumed them. `forced` (claim carries a
+        # terminationGracePeriod) bypasses it — the caller's gate already
+        # waived PDBs per the reference override, and re-blocking here
+        # would silently drop the forced disruption
+        if not forced and self._pdb_blocked_set(victims):
             return
         out, ok = self._simulate_removal(pool, victims, cat, views, None)
         if not ok:

@@ -45,6 +45,16 @@ class NodeView:
         return cost
 
     def has_do_not_disrupt(self) -> bool:
+        """Voluntary-disruption block: any resident pod carries the
+        annotation, or the NODE/claim itself does (reference node-level
+        controls, disruption.md:385-396 — karpenter.sh/do-not-disrupt on
+        the Node object blocks all voluntary disruption)."""
+        from ..models.pod import DO_NOT_DISRUPT
+        if self.node is not None and \
+                self.node.annotations.get(DO_NOT_DISRUPT) == "true":
+            return True
+        if self.claim.annotations.get(DO_NOT_DISRUPT) == "true":
+            return True
         return any(p.do_not_disrupt() for p in self.pods)
 
 

@@ -124,3 +124,96 @@ def test_validation_pass_deletes_victims():
         timeout=900)
     assert all(sim.store.nodeclaims.get(v) is None
                or sim.store.nodeclaims[v].is_deleting() for v in victims)
+
+
+class TestNodeLevelControls:
+    """Reference node-level controls (disruption.md:385-396): the
+    do-not-disrupt annotation on the NODE blocks all voluntary
+    disruption; a terminationGracePeriod on the claim overrides the
+    block for drift/expiration (disruption.md:260-268)."""
+
+    def _sim_with_annotated_node(self):
+        from karpenter_tpu.models.pod import DO_NOT_DISRUPT
+        sim = make_sim()
+        pods = add_pods(sim, 2)
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        node = sim.store.node_for_nodeclaim(claim)
+        node.annotations[DO_NOT_DISRUPT] = "true"
+        return sim, claim, pods
+
+    def test_node_annotation_blocks_emptiness(self):
+        sim, claim, pods = self._sim_with_annotated_node()
+        for p in pods:
+            sim.store.delete_pod(p.namespace, p.name)
+        sim.engine.run_for(600, step=5)
+        live = sim.store.nodeclaims.get(claim.name)
+        assert live is not None and not live.is_deleting(), (
+            "empty pass reaped a node annotated do-not-disrupt")
+
+    def test_node_annotation_blocks_drift(self):
+        sim, claim, _ = self._sim_with_annotated_node()
+        sim.store.nodeclasses["default"].user_data = "v2"
+        sim.engine.run_for(600, step=5)
+        live = sim.store.nodeclaims.get(claim.name)
+        assert live is not None and not live.is_deleting(), (
+            "drift rolled a node annotated do-not-disrupt")
+
+    def test_grace_period_overrides_block_for_drift(self):
+        sim, claim, _ = self._sim_with_annotated_node()
+        claim.termination_grace_period = 300.0
+        sim.store.nodeclasses["default"].user_data = "v2"
+        sim.engine.run_until(
+            lambda: (sim.store.nodeclaims.get(claim.name) is None
+                     or sim.store.nodeclaims[claim.name].is_deleting()
+                     or sim.disruption._pending),
+            timeout=900)
+        committed = (sim.store.nodeclaims.get(claim.name) is None
+                     or sim.store.nodeclaims[claim.name].is_deleting()
+                     or any(claim.name in pd.victim_claims
+                            for pd in sim.disruption._pending))
+        assert committed, (
+            "terminationGracePeriod must let drift proceed past "
+            "do-not-disrupt")
+
+
+class TestForcedOverride:
+    def test_grace_period_forces_drift_past_blocking_pdb(self):
+        """terminationGracePeriod must carry the drift THROUGH the
+        blocking-PDB re-check in _replace, not just the top-of-loop
+        gate (disruption.md:260-268)."""
+        from karpenter_tpu.models.pod import PodDisruptionBudget
+        sim = make_sim()
+        pods = add_pods(sim, 2, prefix="pdb", labels={"app": "web"})
+        settle(sim)
+        sim.store.add_pdb(PodDisruptionBudget(
+            name="web", label_selector={"app": "web"},
+            max_unavailable=0))  # fully blocking
+        claim = next(iter(sim.store.nodeclaims.values()))
+        claim.termination_grace_period = 300.0
+        sim.store.nodeclasses["default"].user_data = "v2"  # drift
+        sim.engine.run_until(
+            lambda: (claim.is_deleting() or sim.disruption._pending),
+            timeout=900)
+        committed = claim.is_deleting() or any(
+            claim.name in pd.victim_claims
+            for pd in sim.disruption._pending)
+        assert committed, (
+            "blocking PDB silently dropped a terminationGracePeriod-"
+            "forced drift")
+
+    def test_annotation_during_replacement_boot_aborts(self):
+        """Node-level do-not-disrupt applied while the replacement boots
+        must abort the pending disruption at re-validation."""
+        from karpenter_tpu.models.pod import DO_NOT_DISRUPT
+        sim, pd = make_pending_sim()
+        victim = sim.store.nodeclaims[pd.victim_claims[0]]
+        node = sim.store.node_for_nodeclaim(victim)
+        node.annotations[DO_NOT_DISRUPT] = "true"
+        sim.engine.run_until(lambda: not sim.disruption._pending,
+                             timeout=900)
+        live = sim.store.nodeclaims.get(victim.name)
+        assert live is not None and not live.is_deleting(), (
+            "victim annotated do-not-disrupt mid-boot was still drained")
+        assert any(r == "DisruptionAborted"
+                   for _, _, r, _ in sim.store.events)
