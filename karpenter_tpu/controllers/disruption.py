@@ -135,10 +135,15 @@ class DisruptionController:
         # landed) after the decision invalidates it — node-level controls
         # block voluntary disruption up to the last moment, unless the
         # claim's terminationGracePeriod forces it
+        forced = (pd.reason in ("Drifted", "Expired"))
         for v in views:
-            if (v.name in victim_set and v.has_do_not_disrupt()
-                    and v.claim.termination_grace_period is None):
-                return False
+            if v.name in victim_set and v.has_do_not_disrupt():
+                # the grace-period override is scoped to drift/expiration
+                # (disruption.md:260-268); a consolidation decision never
+                # outlives a do-not-disrupt annotation
+                if not (forced
+                        and v.claim.termination_grace_period is not None):
+                    return False
         pods = [p for v in views if v.name in victim_set for p in v.pods]
         if not pods:
             return True  # victims drained on their own: trivially safe

@@ -50,6 +50,25 @@ class TerminationController:
             self._terminate_one(claim, now)
         return self.requeue
 
+    def _evict_allowed(self, claim: NodeClaim, node, pods) -> None:
+        """One eviction pass: unbind pods that PDBs allow and that don't
+        carry do-not-disrupt (eviction-API semantics — PDB pacing per
+        budget; blocked pods stay bound and are retried next reconcile)."""
+        allowed = {name: self.store.pdb_disruptions_allowed(pdb)
+                   for name, pdb in self.store.pdbs.items()}
+        for p in pods:
+            if p.do_not_disrupt():
+                continue  # never voluntarily evicted (pod-level control)
+            matching = [n for n, pdb in self.store.pdbs.items()
+                        if pdb.matches(p)]
+            if any(allowed[m] <= 0 for m in matching):
+                continue  # blocked this pass; retry next reconcile
+            for m in matching:
+                allowed[m] -= 1
+            if p.annotations.get(NOMINATED) == claim.name:
+                self.store.unnominate_pod(p)
+            self.store.unbind_pod(p)
+
     def _terminate_one(self, claim: NodeClaim, now: float) -> None:
         node = self.store.node_for_nodeclaim(claim)
         if node is not None:
@@ -59,6 +78,18 @@ class TerminationController:
             start = self._drain_started.setdefault(claim.name, now)
             grace = claim.termination_grace_period or self.drain_grace
             pods = self.store.pods_on_node(node.name)
+            if (claim.termination_grace_period is None
+                    and any(p.do_not_disrupt() for p in pods)):
+                # reference semantics (disruption.md:181-182): pods with
+                # the do-not-disrupt annotation block draining
+                # INDEFINITELY — only an explicit terminationGracePeriod
+                # on the claim forces them out. Keep waiting; evict what
+                # is evictable meanwhile. The drain clock RESTARTS here:
+                # when the block finally lifts, remaining pods get a full
+                # grace window, not an instant force-evict
+                self._drain_started[claim.name] = now
+                self._evict_allowed(claim, node, pods)
+                return
             if pods and now - start < grace:
                 # evict: unbind, pods return to pending for rescheduling.
                 # Keep nominations pointing at OTHER claims (a pre-spun
@@ -69,18 +100,7 @@ class TerminationController:
                 # semantics). After `grace` the force path tears down
                 # regardless — terminationGracePeriod outranks PDBs, as in
                 # the reference.
-                allowed = {name: self.store.pdb_disruptions_allowed(pdb)
-                           for name, pdb in self.store.pdbs.items()}
-                for p in pods:
-                    matching = [n for n, pdb in self.store.pdbs.items()
-                                if pdb.matches(p)]
-                    if any(allowed[m] <= 0 for m in matching):
-                        continue  # blocked this pass; retry next reconcile
-                    for m in matching:
-                        allowed[m] -= 1
-                    if p.annotations.get(NOMINATED) == claim.name:
-                        self.store.unnominate_pod(p)
-                    self.store.unbind_pod(p)
+                self._evict_allowed(claim, node, pods)
                 return  # wait a tick for rescheduling before teardown
             # grace expired (or node empty): force path. Any pod still
             # bound — e.g. held through grace by a zero PDB budget — is

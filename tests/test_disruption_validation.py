@@ -217,3 +217,58 @@ class TestForcedOverride:
             "victim annotated do-not-disrupt mid-boot was still drained")
         assert any(r == "DisruptionAborted"
                    for _, _, r, _ in sim.store.events)
+
+
+class TestDrainBlocking:
+    """Drain semantics for do-not-disrupt pods (disruption.md:181-182 +
+    :260-268): they block draining indefinitely; an explicit
+    terminationGracePeriod forces them out after the window."""
+
+    def _node_with_protected_pod(self):
+        sim = make_sim()
+        protected = sim.store.add_pod(Pod(
+            name="keep",
+            annotations={"karpenter.tpu/do-not-disrupt": "true"},
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        victim_pod = sim.store.add_pod(Pod(
+            name="evictable",
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        return sim, claim, protected, victim_pod
+
+    def test_drain_waits_indefinitely_without_grace(self):
+        sim, claim, protected, evictable = self._node_with_protected_pod()
+        sim.termination.delete_nodeclaim(claim, sim.clock.now(), "Test")
+        sim.engine.run_for(600, step=5)  # 20x the default 30s drain grace
+        assert claim.name in sim.store.nodeclaims, (
+            "node with a do-not-disrupt pod was torn down without a "
+            "terminationGracePeriod")
+        live = sim.store.pods[f"{protected.namespace}/{protected.name}"]
+        assert live.node_name is not None, "protected pod was evicted"
+        # the evictable pod left and rescheduled meanwhile
+        other = sim.store.pods[f"{evictable.namespace}/{evictable.name}"]
+        assert other.node_name is not None
+
+    def test_grace_period_forces_protected_pods_out(self):
+        sim, claim, protected, _ = self._node_with_protected_pod()
+        claim.termination_grace_period = 60.0
+        sim.termination.delete_nodeclaim(claim, sim.clock.now(), "Test")
+        sim.engine.run_until(lambda: claim.name not in sim.store.nodeclaims,
+                             timeout=600)
+        assert claim.name not in sim.store.nodeclaims
+        # protected pod rescheduled elsewhere, not stranded
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=600)
+
+    def test_annotation_removed_unblocks_drain(self):
+        sim, claim, protected, _ = self._node_with_protected_pod()
+        sim.termination.delete_nodeclaim(claim, sim.clock.now(), "Test")
+        sim.engine.run_for(120, step=5)
+        assert claim.name in sim.store.nodeclaims
+        live = sim.store.pods[f"{protected.namespace}/{protected.name}"]
+        del live.annotations["karpenter.tpu/do-not-disrupt"]
+        sim.engine.run_until(lambda: claim.name not in sim.store.nodeclaims,
+                             timeout=600)
+        assert claim.name not in sim.store.nodeclaims
