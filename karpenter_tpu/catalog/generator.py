@@ -200,11 +200,15 @@ def _build_type(cfg: GeneratorConfig, name: str, category: str, family: str,
         labels[L.INSTANCE_ACCELERATOR_MANUFACTURER] = "tensorco"
         labels[L.INSTANCE_ACCELERATOR_COUNT] = str(accels)
 
+    from ..models.volume import DEFAULT_ATTACH_LIMIT, VOLUME_ATTACH_RESOURCE
     capacity = Resources({
         CPU: float(vcpu),
         MEMORY: mem_bytes,
         PODS: float(pods),
         EPHEMERAL_STORAGE: 100.0 * _GIB,
+        # per-node attachable-volume limit (the EBS CSI attach-limit
+        # analog, models/volume.py): volume-bearing pods consume this
+        VOLUME_ATTACH_RESOURCE: float(DEFAULT_ATTACH_LIMIT),
     })
     if gpus:
         capacity[NVIDIA_GPU] = float(gpus)

@@ -144,6 +144,10 @@ class Pod:
     affinity_terms: List[PodAffinityTerm] = field(default_factory=list)
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
+    # PersistentVolumeClaim names (same namespace): the store resolves
+    # bound claims into a zone node_selector + an attachable-volumes
+    # resource request at admission (models/volume.py)
+    pvc_names: List[str] = field(default_factory=list)
     priority: int = 0
     deletion_cost: int = 0
     owner: Optional[str] = None  # replicaset/deployment key, for spread selectors
@@ -233,6 +237,13 @@ class Pod:
                          for t in self.affinity_terms)) if self.affinity_terms else empty,
         )
         return self._sig
+
+    def invalidate_group_key(self) -> None:
+        """Drop the cached signature/intern id after a constraint-bearing
+        field changed post-admission (e.g. a PVC binding injected a zone
+        selector) — callers must re-run store indexing afterwards."""
+        self._sig = None
+        self._gid = None
 
     def group_key(self) -> int:
         """Process-interned int id of constraint_signature().

@@ -42,6 +42,10 @@ class Store:
         self.nodes: Dict[str, Node] = {}
         self.daemonsets: Dict[str, object] = {}
         self.pdbs: Dict[str, object] = {}
+        self.pvcs: Dict[str, object] = {}  # PersistentVolumeClaims by key
+        # pvc key -> referencing pod keys: add_pvc re-decoration must not
+        # scan 100k pods per claim event
+        self._pods_by_pvc: Dict[str, set] = {}
         self._watchers: Dict[str, List[Callable]] = defaultdict(list)
         self.events: List[tuple] = []  # (kind, object-name, reason, message)
         # set by state.rehydrate.rehydrate(); until then the store may be a
@@ -73,6 +77,12 @@ class Store:
             # as a ghost pod every reconcile, forever)
             self._index_discard(old, key)
         self.pods[key] = pod
+        for name in set(pod.pvc_names):
+            self._pods_by_pvc.setdefault(
+                f"{pod.namespace}/{name}", set()).add(key)
+        # volume constraints resolve BEFORE interning: the injected zone
+        # affinity and attach-count request are part of the signature
+        self._apply_volume_constraints(pod)
         # amortize constraint-signature interning to admission time: the
         # solve-time encode then groups 100k pods by one int read per pod
         # instead of re-walking Python constraint objects every reconcile
@@ -80,6 +90,67 @@ class Store:
         self._index_update(pod, key)
         self._notify("pod", "add", pod)
         return pod
+
+    # --- persistent volume claims (volume topology + attach limits) ---
+    def add_pvc(self, pvc) -> None:
+        """Register/update a claim; pending pods referencing it are
+        re-decorated via the pvc→pods index (a PV binding after pod
+        admission must still pin the pod's zone before it schedules —
+        core volume-topology behavior). A nominated pod whose nominated
+        claim no longer satisfies the new pin is un-nominated so the
+        provisioner re-solves with the constraint."""
+        self.pvcs[pvc.key] = pvc
+        from ..controllers.provisioner import NOMINATED
+        from ..models import labels as L
+        for key in list(self._pods_by_pvc.get(pvc.key, ())):
+            pod = self.pods.get(key)
+            if pod is None or pod.node_name is not None:
+                continue
+            if pvc.bound_zone() is None and not pod.node_affinity:
+                continue  # zoneless claim, nothing to re-derive
+            self._index_discard(pod, key)
+            self._apply_volume_constraints(pod)
+            pod.invalidate_group_key()
+            pod.group_key()
+            self._index_update(pod, key)
+            nominated = pod.annotations.get(NOMINATED)
+            if nominated:
+                claim = self.nodeclaims.get(nominated)
+                want = pod.scheduling_requirements().get(L.ZONE)
+                if (claim is None
+                        or (want is not None and claim.zone
+                            and not want.contains(claim.zone))):
+                    # the pre-binding nomination no longer satisfies the
+                    # volume's zone — return the pod to pending
+                    self.unnominate_pod(pod)
+
+    def _apply_volume_constraints(self, pod: Pod) -> None:
+        """Lower PVC effects onto existing scheduling machinery
+        (models/volume.py docstring): each bound zonal claim contributes a
+        required node-affinity IN term — the Requirements set-algebra then
+        INTERSECTS it with user selectors and other claims, so conflicting
+        zones make the pod unschedulable instead of silently landing where
+        one of its volumes isn't. Unique claims each consume one
+        attachable-volume resource unit (RWX claims shared across pods
+        still charge per pod — the resource model is per-pod; noted
+        limitation)."""
+        if not pod.pvc_names:
+            return
+        from ..models import labels as L
+        from ..models.volume import VOLUME_ATTACH_RESOURCE
+        unique = sorted(set(pod.pvc_names))
+        pod.requests[VOLUME_ATTACH_RESOURCE] = float(len(unique))
+        # volume-injected terms are tagged so re-binding replaces, never
+        # accumulates, stale pins (signature ignores the marker key)
+        pod.node_affinity = [t for t in pod.node_affinity
+                             if "_volume" not in t]
+        for name in unique:
+            pvc = self.pvcs.get(f"{pod.namespace}/{name}")
+            zone = pvc.bound_zone() if pvc is not None else None
+            if zone is not None:
+                pod.node_affinity.append(
+                    {"key": L.ZONE, "operator": "In", "values": (zone,),
+                     "_volume": f"{pod.namespace}/{name}"})
 
     def _index_update(self, pod: Pod, key: str) -> None:
         """Insert/remove a pod from the pending-group index according to
@@ -102,6 +173,12 @@ class Store:
         key = f"{namespace}/{name}"
         pod = self.pods.pop(key, None)
         if pod:
+            for pname in set(pod.pvc_names):
+                refs = self._pods_by_pvc.get(f"{namespace}/{pname}")
+                if refs is not None:
+                    refs.discard(key)
+                    if not refs:
+                        del self._pods_by_pvc[f"{namespace}/{pname}"]
             self._index_discard(pod, key)
             self._notify("pod", "delete", pod)
 

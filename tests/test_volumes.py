@@ -1,0 +1,143 @@
+"""Volume topology + attachable-volume limits.
+
+Reference: core scheduler volume topology (a pod whose PVC is bound to a
+zonal PV must schedule into that zone — test/suites/storage e2e) and
+per-node attach limits (EBS CSI). Here both lower onto existing
+machinery: admission-time zone selectors and an attachable-volumes
+resource (models/volume.py).
+"""
+
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.models.volume import (DEFAULT_ATTACH_LIMIT,
+                                         VOLUME_ATTACH_RESOURCE,
+                                         PersistentVolumeClaim)
+from karpenter_tpu.sim import make_sim
+
+
+def settle(sim, timeout=300):
+    ok = sim.engine.run_until(
+        lambda: all(p.node_name for p in sim.store.pods.values()),
+        timeout=timeout)
+    assert ok, [p.name for p in sim.store.pods.values() if not p.node_name]
+
+
+class TestVolumeTopology:
+    def test_bound_pvc_pins_pod_to_pv_zone(self):
+        sim = make_sim()
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="data", volume_name="pv-1", zone="zone-b"))
+        sim.store.add_pod(Pod(
+            name="db", pvc_names=["data"],
+            requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        assert claim.zone == "zone-b", (
+            f"pod with a zone-b PV landed in {claim.zone}")
+
+    def test_unbound_wait_for_first_consumer_constrains_nothing(self):
+        sim = make_sim()
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="later", storage_class="standard"))  # unbound
+        p = sim.store.add_pod(Pod(
+            name="w", pvc_names=["later"],
+            requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        settle(sim)
+        assert L.ZONE not in p.node_selector
+        # but the attach slot is still accounted
+        assert p.requests.get(VOLUME_ATTACH_RESOURCE) == 1.0
+
+    def test_pvc_bound_after_pod_admission_still_pins(self):
+        """The PV binds AFTER the pod was admitted but before it
+        schedules: the zone pin must take effect (store.add_pvc
+        re-decorates pending pods)."""
+        sim = make_sim()
+        sim.store.add_pod(Pod(
+            name="late", pvc_names=["data2"],
+            requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="data2", volume_name="pv-2", zone="zone-c"))
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        assert claim.zone == "zone-c"
+
+    def test_conflicting_zonal_claims_unschedulable(self):
+        """Two PVCs bound to DIFFERENT zones cannot be satisfied: the
+        zone affinities intersect to the empty set and the pod stays
+        pending — never silently scheduled where one volume isn't
+        (k8s volume-topology semantics)."""
+        sim = make_sim()
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="a", volume_name="pv-a", zone="zone-a"))
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="b", volume_name="pv-b", zone="zone-b"))
+        p = sim.store.add_pod(Pod(
+            name="torn", pvc_names=["a", "b"],
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        sim.engine.run_for(120, step=1)
+        assert p.node_name is None, (
+            "pod with zone-conflicting volumes was scheduled")
+
+    def test_user_selector_conflicting_with_pv_zone_unschedulable(self):
+        """A user zone selector that contradicts the bound PV's zone must
+        block scheduling, not silently win."""
+        sim = make_sim()
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="pinned", volume_name="pv-p", zone="zone-b"))
+        p = sim.store.add_pod(Pod(
+            name="wrong", pvc_names=["pinned"],
+            node_selector={L.ZONE: "zone-a"},
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        sim.engine.run_for(120, step=1)
+        assert p.node_name is None
+
+    def test_rebind_replaces_stale_pin(self):
+        """A claim re-binding to a different zone replaces the injected
+        pin instead of accumulating both."""
+        sim = make_sim()
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="move", volume_name="pv-1", zone="zone-a"))
+        p = sim.store.add_pod(Pod(
+            name="m", pvc_names=["move"],
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="move", volume_name="pv-2", zone="zone-c"))
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        assert claim.zone == "zone-c"
+        vol_terms = [t for t in p.node_affinity if "_volume" in t]
+        assert len(vol_terms) == 1 and vol_terms[0]["values"] == ("zone-c",)
+
+    def test_duplicate_claim_references_count_once(self):
+        sim = make_sim()
+        sim.store.add_pvc(PersistentVolumeClaim(name="dup"))
+        p = sim.store.add_pod(Pod(
+            name="d", pvc_names=["dup", "dup"],
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        assert p.requests.get(VOLUME_ATTACH_RESOURCE) == 1.0
+
+
+class TestAttachLimits:
+    def test_volume_pods_capped_per_node(self):
+        """More volume-bearing pods than one node's attach limit must
+        spread over >=2 nodes even though cpu/memory would fit on one."""
+        sim = make_sim()
+        n = DEFAULT_ATTACH_LIMIT + 5
+        for i in range(n):
+            sim.store.add_pvc(PersistentVolumeClaim(name=f"v{i}"))
+            sim.store.add_pod(Pod(
+                name=f"vp{i}", pvc_names=[f"v{i}"],
+                requests=Resources.parse({"cpu": "10m", "memory": "32Mi"})))
+        settle(sim)
+        per_node: dict = {}
+        for p in sim.store.pods.values():
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        assert len(per_node) >= 2, "attach limit did not split the pods"
+        assert max(per_node.values()) <= DEFAULT_ATTACH_LIMIT
+
+    def test_catalog_advertises_attach_limit(self):
+        from karpenter_tpu.catalog import generate_catalog
+        for t in generate_catalog()[:10]:
+            assert t.capacity.get(VOLUME_ATTACH_RESOURCE) == \
+                DEFAULT_ATTACH_LIMIT
