@@ -145,8 +145,9 @@ class Pod:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     # PersistentVolumeClaim names (same namespace): the store resolves
-    # bound claims into a zone node_selector + an attachable-volumes
-    # resource request at admission (models/volume.py)
+    # bound claims into required zone node-affinity terms + an
+    # attachable-volumes resource request at admission (models/volume.py);
+    # a missing claim injects a conflict term that blocks scheduling
     pvc_names: List[str] = field(default_factory=list)
     priority: int = 0
     deletion_cost: int = 0

@@ -74,8 +74,15 @@ class Store:
         if old is not None and old is not pod:
             # same-key replacement: evict the old OBJECT from the index
             # (its gid may differ — a stranded entry would be re-solved
-            # as a ghost pod every reconcile, forever)
+            # as a ghost pod every reconcile, forever); its PVC refs go
+            # too, or add_pvc events re-decorate a ghost forever
             self._index_discard(old, key)
+            for pname in set(old.pvc_names):
+                refs = self._pods_by_pvc.get(f"{old.namespace}/{pname}")
+                if refs is not None:
+                    refs.discard(key)
+                    if not refs:
+                        del self._pods_by_pvc[f"{old.namespace}/{pname}"]
         self.pods[key] = pod
         for name in set(pod.pvc_names):
             self._pods_by_pvc.setdefault(
@@ -100,8 +107,6 @@ class Store:
         claim no longer satisfies the new pin is un-nominated so the
         provisioner re-solves with the constraint."""
         self.pvcs[pvc.key] = pvc
-        from ..controllers.provisioner import NOMINATED
-        from ..models import labels as L
         for key in list(self._pods_by_pvc.get(pvc.key, ())):
             pod = self.pods.get(key)
             if pod is None or pod.node_name is not None:
@@ -113,7 +118,7 @@ class Store:
             pod.invalidate_group_key()
             pod.group_key()
             self._index_update(pod, key)
-            nominated = pod.annotations.get(NOMINATED)
+            nominated = pod.annotations.get(L.NOMINATED)
             if nominated:
                 claim = self.nodeclaims.get(nominated)
                 want = pod.scheduling_requirements().get(L.ZONE)
@@ -146,7 +151,18 @@ class Store:
                              if "_volume" not in t]
         for name in unique:
             pvc = self.pvcs.get(f"{pod.namespace}/{name}")
-            zone = pvc.bound_zone() if pvc is not None else None
+            if pvc is None:
+                # referenced claim doesn't exist (informer-order race):
+                # the pod must NOT schedule — if the claim later arrives
+                # bound to some zone, a pod already running elsewhere is
+                # permanently separated from its volume. An empty In()
+                # is a requirements conflict: matches nothing, so the
+                # pod stays pending until add_pvc re-decorates it.
+                pod.node_affinity.append(
+                    {"key": L.ZONE, "operator": "In", "values": (),
+                     "_volume": f"{pod.namespace}/{name}"})
+                continue
+            zone = pvc.bound_zone()
             if zone is not None:
                 pod.node_affinity.append(
                     {"key": L.ZONE, "operator": "In", "values": (zone,),

@@ -44,9 +44,28 @@ class TestVolumeTopology:
             name="w", pvc_names=["later"],
             requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
         settle(sim)
-        assert L.ZONE not in p.node_selector
+        # no volume pin injected (the pins live in node_affinity)
+        assert not [t for t in p.node_affinity if "_volume" in t]
         # but the attach slot is still accounted
         assert p.requests.get(VOLUME_ATTACH_RESOURCE) == 1.0
+
+    def test_missing_claim_blocks_scheduling_until_it_arrives(self):
+        """A pod referencing a claim that doesn't exist must stay pending
+        (k8s blocks on missing claims); once the claim arrives the pod
+        schedules — into the PV's zone if it came bound."""
+        sim = make_sim()
+        p = sim.store.add_pod(Pod(
+            name="orphan", pvc_names=["ghost"],
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        sim.engine.run_for(60, step=1)
+        assert p.node_name is None, (
+            "pod scheduled while its claim didn't exist")
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="ghost", volume_name="pv-g", zone="zone-b"))
+        settle(sim)
+        claim = next(c for c in sim.store.nodeclaims.values()
+                     if c.node_name == p.node_name)
+        assert claim.zone == "zone-b"
 
     def test_pvc_bound_after_pod_admission_still_pins(self):
         """The PV binds AFTER the pod was admitted but before it
