@@ -182,6 +182,7 @@ class DisruptionController:
 
     # --- per-pool pass ---
     def _reconcile_pool(self, pool: NodePool, now: float) -> None:
+        self._hash_memo = {}  # templates may have mutated since last pass
         node_class = self.store.nodeclasses.get(pool.node_class)
         cat = self.solver.tensors(node_class)
         views = [v for v in build_node_views(self.store, cat, now)
@@ -211,7 +212,7 @@ class DisruptionController:
             if not forced and (self._pdb_blocked(v)
                                or v.has_do_not_disrupt()):
                 continue
-            if self._is_drifted(v, node_class):
+            if self._is_drifted(v, node_class, pool):
                 self._replace(pool, [v], "Drifted", now, cat, views,
                               forced=forced)
             elif (pool.expire_after is not None
@@ -269,6 +270,19 @@ class DisruptionController:
                 budget -= 1
 
     # --- drift ---
+    def _memo_hash(self, obj) -> str:
+        """Per-reconcile memo of template hashes: the object is fixed for
+        the pass, so hash it once per object per reconcile. The memo is
+        reset each _reconcile_pool (mutation between passes must land)."""
+        memo = getattr(self, "_hash_memo", None)
+        if memo is None:
+            memo = self._hash_memo = {}
+        key = id(obj)
+        h = memo.get(key)
+        if h is None:
+            h = memo[key] = obj.hash()
+        return h
+
     def _live_reservation_ids(self) -> set:
         """Reservation ids currently offered by the catalog, memoized per
         catalog epoch (the drift pass asks once per node)."""
@@ -281,16 +295,26 @@ class DisruptionController:
             return ids
         return cached[1]
 
-    def _is_drifted(self, v: NodeView, node_class) -> bool:
-        """Drift reasons (reference drift.go:35-41 — all five): static
-        nodeclass-hash mismatch; node image no longer in the resolved image
-        set; node zone no longer in the resolved zones; node network-group
-        set diverged from the resolved set (the security-group reason);
-        and a reserved node whose capacity reservation vanished from the
-        catalog (the capacity-reservation reason)."""
+    def _is_drifted(self, v: NodeView, node_class,
+                    pool: Optional[NodePool] = None) -> bool:
+        """Drift reasons (reference drift.go:35-41 — all five — plus the
+        core's NodePool drift): static nodeclass-hash mismatch; static
+        NODEPOOL-hash mismatch (template taints/labels changed); DYNAMIC
+        requirements drift (the node's labels no longer satisfy the
+        pool's live requirements); node image no longer in the resolved
+        image set; node zone no longer in the resolved zones; node
+        network-group set diverged from the resolved set (the
+        security-group reason); and a reserved node whose capacity
+        reservation vanished from the catalog (the capacity-reservation
+        reason)."""
         if node_class is None:
             return False
-        from ..models.nodepool import NODECLASS_HASH_VERSION
+        from ..models.nodepool import (NODECLASS_HASH_VERSION,
+                                       NODEPOOL_HASH_VERSION)
+        # the templates are fixed across the whole pool pass — hash once
+        # per reconcile, not once per node (json+sha256 per node was
+        # measurable at fleet scale)
+        nc_hash = self._memo_hash(node_class)
         stamped = v.claim.annotations.get("karpenter.tpu/nodeclass-hash")
         stamped_ver = v.claim.annotations.get("karpenter.tpu/nodeclass-hash-version")
         if stamped is not None and stamped_ver != NODECLASS_HASH_VERSION:
@@ -298,10 +322,39 @@ class DisruptionController:
             # computed under a different field set, so a mismatch says
             # nothing about real drift — re-stamp instead of rolling the
             # fleet (reference ec2nodeclass-hash-version migration)
-            v.claim.annotations["karpenter.tpu/nodeclass-hash"] = node_class.hash()
+            v.claim.annotations["karpenter.tpu/nodeclass-hash"] = nc_hash
             v.claim.annotations["karpenter.tpu/nodeclass-hash-version"] = NODECLASS_HASH_VERSION
-        elif stamped is not None and stamped != node_class.hash():
+        elif stamped is not None and stamped != nc_hash:
             return True
+        if pool is not None:
+            p_hash = self._memo_hash(pool)
+            pstamped = v.claim.annotations.get("karpenter.tpu/nodepool-hash")
+            pver = v.claim.annotations.get("karpenter.tpu/nodepool-hash-version")
+            if pstamped is not None and pver != NODEPOOL_HASH_VERSION:
+                v.claim.annotations["karpenter.tpu/nodepool-hash"] = p_hash
+                v.claim.annotations["karpenter.tpu/nodepool-hash-version"] = \
+                    NODEPOOL_HASH_VERSION
+            elif pstamped is not None and pstamped != p_hash:
+                return True
+            # dynamic requirements drift: the pool's LIVE requirements
+            # must still accept this node's identity labels (the core
+            # compares requirement-by-requirement, not by hash). Absence
+            # counts as drift only for requirements that MATERIALIZE as
+            # node labels — single-valued In pins (template_labels stamps
+            # exactly those); judging absence for multi-valued/Exists
+            # requirements would roll replacements forever, since they
+            # never carry such labels either
+            if v.node is not None and len(pool.requirements):
+                for key in pool.requirements.keys():
+                    want = pool.requirements.get(key)
+                    have = v.node.labels.get(key)
+                    if have is not None:
+                        if not want.contains(have):
+                            return True
+                    elif (not want.complement and want.gt is None
+                          and want.lt is None and not want.dne
+                          and len(want.values) == 1):
+                        return True  # pinned label the node never got
         if (node_class.resolved_images and v.claim.image_id
                 and v.claim.image_id not in node_class.resolved_images):
             return True

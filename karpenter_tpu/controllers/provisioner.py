@@ -249,9 +249,12 @@ class Provisioner:
                 expire_after=pool.expire_after,
                 termination_grace_period=pool.termination_grace_period,
                 created_at=now)
-            from ..models.nodepool import NODECLASS_HASH_VERSION
+            from ..models.nodepool import (NODECLASS_HASH_VERSION,
+                                           NODEPOOL_HASH_VERSION)
             claim.annotations["karpenter.tpu/nodeclass-hash"] = node_class.hash()
             claim.annotations["karpenter.tpu/nodeclass-hash-version"] = NODECLASS_HASH_VERSION
+            claim.annotations["karpenter.tpu/nodepool-hash"] = pool.hash()
+            claim.annotations["karpenter.tpu/nodepool-hash-version"] = NODEPOOL_HASH_VERSION
             claim.instance_type = launch.instance_type
             self.store.add_nodeclaim(claim)
             claims.append((claim, launch))
@@ -278,7 +281,11 @@ class Provisioner:
                       L.TAG_NODECLASS_HASH:
                           claim.annotations["karpenter.tpu/nodeclass-hash"],
                       L.TAG_NODECLASS_HASH_VERSION:
-                          claim.annotations["karpenter.tpu/nodeclass-hash-version"]},
+                          claim.annotations["karpenter.tpu/nodeclass-hash-version"],
+                      L.TAG_NODEPOOL_HASH:
+                          claim.annotations["karpenter.tpu/nodepool-hash"],
+                      L.TAG_NODEPOOL_HASH_VERSION:
+                          claim.annotations["karpenter.tpu/nodepool-hash-version"]},
                 network_groups=list(node_class.resolved_network_groups),
                 profile=node_class.resolved_profile))
         # single launch-floor choke point (reference contract: Truncate +

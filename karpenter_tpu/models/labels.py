@@ -87,6 +87,8 @@ TAG_NODEPOOL = NODEPOOL
 TAG_NODECLASS = f"{_G}/nodeclass"
 TAG_NODECLASS_HASH = f"{_G}/nodeclass-hash"
 TAG_NODECLASS_HASH_VERSION = f"{_G}/nodeclass-hash-version"
+TAG_NODEPOOL_HASH = f"{_G}/nodepool-hash"
+TAG_NODEPOOL_HASH_VERSION = f"{_G}/nodepool-hash-version"
 
 # restricted: users may not set these directly on NodePool templates
 RESTRICTED_LABELS = frozenset({NODEPOOL, NODE_INITIALIZED, NODE_REGISTERED, HOSTNAME})

@@ -28,6 +28,7 @@ from .resources import Resources
 # differs (reference: karpenter.k8s.aws/ec2nodeclass-hash-version,
 # ec2nodeclass.go:480 hash version v4 + the hash controller's migration).
 NODECLASS_HASH_VERSION = "v3"  # v3: instance_store_policy joined the blob
+NODEPOOL_HASH_VERSION = "v1"   # template static-field hash (drift)
 
 
 @dataclass
@@ -174,6 +175,30 @@ class NodePool:
     def add_requirement(self, req: Requirement) -> "NodePool":
         self.requirements.add(req)
         return self
+
+    def _hash_fields(self) -> dict:
+        """The static template fields the NodePool drift hash covers
+        (reference: the core stamps karpenter.sh/nodepool-hash from the
+        template's static fields; requirements/limits are NOT hashed —
+        requirement changes are DYNAMIC drift, compared live against the
+        node's labels, and limits gate provisioning only). Pinned to
+        NODEPOOL_HASH_VERSION by tests/test_hash_version.py — the pair
+        changes together or not at all."""
+        return {
+            "labels": dict(sorted(self.labels.items())),
+            "taints": sorted((t.key, t.value, t.effect)
+                             for t in self.taints),
+            "startup_taints": sorted((t.key, t.value, t.effect)
+                                     for t in self.startup_taints),
+            "node_class": self.node_class,
+            "termination_grace_period": self.termination_grace_period,
+        }
+
+    def hash(self) -> str:
+        """Static drift hash stamped on launched claims; a template
+        change (new taint, relabel) rolls the pool via the drift pass."""
+        blob = json.dumps(self._hash_fields(), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     def template_labels(self) -> Dict[str, str]:
         """Node labels every launched node of this pool wears: spec

@@ -22,7 +22,8 @@ from ..models.resources import Resources
 from .store import Store
 
 from ..models.labels import (TAG_NODECLAIM, TAG_NODECLASS, TAG_NODECLASS_HASH,
-                             TAG_NODECLASS_HASH_VERSION, TAG_NODEPOOL)
+                             TAG_NODECLASS_HASH_VERSION, TAG_NODEPOOL,
+                             TAG_NODEPOOL_HASH, TAG_NODEPOOL_HASH_VERSION)
 
 
 def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0) -> Dict[str, int]:
@@ -149,7 +150,9 @@ def _adopt(store: Store, inst, name: str, node, types: Dict[str, object],
     if inst.reservation_id:
         claim.annotations["karpenter.tpu/reservation-id"] = inst.reservation_id
     for tag, anno in ((TAG_NODECLASS_HASH, TAG_NODECLASS_HASH),
-                      (TAG_NODECLASS_HASH_VERSION, TAG_NODECLASS_HASH_VERSION)):
+                      (TAG_NODECLASS_HASH_VERSION, TAG_NODECLASS_HASH_VERSION),
+                      (TAG_NODEPOOL_HASH, TAG_NODEPOOL_HASH),
+                      (TAG_NODEPOOL_HASH_VERSION, TAG_NODEPOOL_HASH_VERSION)):
         if tag in inst.tags:
             claim.annotations[anno] = inst.tags[tag]
     it = types.get(inst.instance_type)

@@ -272,3 +272,97 @@ class TestDrainBlocking:
         sim.engine.run_until(lambda: claim.name not in sim.store.nodeclaims,
                              timeout=600)
         assert claim.name not in sim.store.nodeclaims
+
+
+class TestNodePoolDrift:
+    def test_template_taint_change_rolls_the_pool(self):
+        sim = make_sim()
+        add_pods(sim, 2, tolerations=[])
+        settle(sim)
+        old = set(sim.store.nodeclaims)
+        from karpenter_tpu.models.pod import Taint, Toleration
+        # every pod must tolerate the new taint or nothing reschedules
+        for p in sim.store.pods.values():
+            p.tolerations.append(Toleration(key="team", operator="Exists"))
+            p.invalidate_group_key(); p.group_key()
+        sim.store.nodepools["default"].taints.append(
+            Taint(key="team", value="a", effect="NoSchedule"))
+        sim.engine.run_for(900, step=10)
+        assert not (set(sim.store.nodeclaims) & old), (
+            "nodepool template taint change did not roll the fleet")
+        assert all(p.node_name for p in sim.store.pods.values())
+
+    def test_requirements_drift_rolls_mismatched_nodes(self):
+        """Tightening the pool's requirements drifts nodes whose labels
+        no longer satisfy them (dynamic drift, no hash involved)."""
+        from karpenter_tpu.models import labels as L
+        from karpenter_tpu.models.requirements import (Operator, Requirement)
+        sim = make_sim()
+        add_pods(sim, 2)
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        node = sim.store.node_for_nodeclaim(claim)
+        zone = node.labels[L.ZONE]
+        other = [z for z in ("zone-a", "zone-b", "zone-c") if z != zone][0]
+        sim.store.nodepools["default"].requirements.add(
+            Requirement(L.ZONE, Operator.IN, (other,)))
+        sim.engine.run_until(
+            lambda: claim.name not in sim.store.nodeclaims
+            or claim.is_deleting() or sim.disruption._pending,
+            timeout=900)
+        rolled = (claim.name not in sim.store.nodeclaims
+                  or claim.is_deleting()
+                  or any(claim.name in pd.victim_claims
+                         for pd in sim.disruption._pending))
+        assert rolled, "requirements drift did not flag the node"
+
+
+class TestNodePoolDriftPersistence:
+    def test_nodepool_hash_survives_restart(self):
+        """The nodepool-hash stamp round-trips through instance adoption
+        tags: a template change AFTER an operator restart must still roll
+        the adopted fleet."""
+        sim = make_sim()
+        add_pods(sim, 2)
+        settle(sim)
+        # operator restart: new stack adopts the fleet from cloud state
+        sim2 = make_sim(cloud=sim.cloud)
+        claim = next(iter(sim2.store.nodeclaims.values()))
+        assert claim.annotations.get("karpenter.tpu/nodepool-hash"), (
+            "adopted claim lost its nodepool-hash stamp")
+        old = set(sim2.store.nodeclaims)
+        sim2.store.nodepools["default"].labels["team"] = "ml"
+        sim2.engine.run_for(900, step=10)
+        assert not (set(sim2.store.nodeclaims) & old), (
+            "template change after restart did not roll the adopted fleet")
+
+    def test_absent_pinned_label_is_drift(self):
+        """A single-valued requirement pin added to the pool drifts
+        pre-existing nodes that never got the label (absence semantics,
+        restricted to materializable pins so replacements converge)."""
+        from karpenter_tpu.models.requirements import (Operator, Requirement)
+        sim = make_sim()
+        add_pods(sim, 1)
+        settle(sim)
+        claim = next(iter(sim.store.nodeclaims.values()))
+        sim.store.nodepools["default"].requirements.add(
+            Requirement("team.example/name", Operator.IN, ("ml",)))
+        sim.engine.run_until(
+            lambda: claim.name not in sim.store.nodeclaims
+            or claim.is_deleting() or sim.disruption._pending,
+            timeout=900)
+        rolled = (claim.name not in sim.store.nodeclaims
+                  or claim.is_deleting()
+                  or any(claim.name in pd.victim_claims
+                         for pd in sim.disruption._pending))
+        assert rolled
+        # the fleet CONVERGES: replacements carry the pin and stop rolling
+        sim.engine.run_for(600, step=10)
+        assert all(p.node_name for p in sim.store.pods.values())
+        live = [c for c in sim.store.nodeclaims.values()
+                if not c.is_deleting()]
+        assert live
+        for c in live:
+            node = sim.store.node_for_nodeclaim(c)
+            if node is not None:
+                assert node.labels.get("team.example/name") == "ml"

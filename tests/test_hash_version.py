@@ -63,3 +63,50 @@ def test_hash_changes_when_any_hashed_field_changes():
     hashes = {c.hash() for c in changed}
     assert base.hash() not in hashes
     assert len(hashes) == len(changed)  # each field change is distinct
+
+
+# --- NodePool hash discipline (same pairing rule) ---
+
+from karpenter_tpu.models.nodepool import (NODEPOOL_HASH_VERSION, NodePool)
+from karpenter_tpu.models.pod import Taint
+
+NODEPOOL_HASHED_FIELDS = {
+    "v1": (
+        "labels",
+        "node_class",
+        "startup_taints",
+        "taints",
+        "termination_grace_period",
+    ),
+}
+
+
+def test_nodepool_hash_field_set_is_pinned_to_version():
+    assert NODEPOOL_HASH_VERSION in NODEPOOL_HASHED_FIELDS
+    want = NODEPOOL_HASHED_FIELDS[NODEPOOL_HASH_VERSION]
+    got = tuple(sorted(NodePool(name="x")._hash_fields().keys()))
+    assert got == want, (
+        "the NodePool drift-hash field set changed without a "
+        "NODEPOOL_HASH_VERSION bump — bump and snapshot together.\n"
+        f"  hashed now: {got}\n  {NODEPOOL_HASH_VERSION} snapshot: {want}")
+
+
+def test_nodepool_hash_changes_on_template_fields_only():
+    base = NodePool(name="x")
+    assert NodePool(name="y").hash() == base.hash()  # name not hashed
+    changed = [
+        NodePool(name="x", labels={"team": "a"}),
+        NodePool(name="x", taints=[Taint(key="gpu", effect="NoSchedule")]),
+        NodePool(name="x", startup_taints=[Taint(key="warm",
+                                                 effect="NoSchedule")]),
+        NodePool(name="x", node_class="other"),
+        NodePool(name="x", termination_grace_period=60.0),
+    ]
+    hashes = {c.hash() for c in changed}
+    assert base.hash() not in hashes and len(hashes) == len(changed)
+    # requirements/limits/weight are NOT static-hashed (dynamic drift /
+    # provisioning-time concerns)
+    from karpenter_tpu.models.requirements import (Operator, Requirement)
+    p = NodePool(name="x")
+    p.add_requirement(Requirement("k", Operator.IN, ("v",)))
+    assert p.hash() == base.hash()
