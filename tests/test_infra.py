@@ -429,6 +429,29 @@ class TestOperator:
         assert all(p.node_name for p in store.pods.values())
         assert store.nodeclaims
 
+    def test_build_operator_wires_round5_options(self, tmp_path):
+        """Round-5 wiring: the pricing snapshot path reaches the pricing
+        provider, and LEADER_ELECT_ENDPOINT selects the HTTP lease
+        backend over the file one."""
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.main import build_operator
+        from karpenter_tpu.utils.leaderelection import HTTPLeaseBackend
+        snap = str(tmp_path / "prices.json")
+        cloud = FakeCloud(small_catalog())
+        opts = Options.parse([], env={})
+        opts.metrics_port = 0
+        opts.solver_backend = "host"
+        opts.pricing_snapshot_file = snap
+        opts.leader_elect = True
+        opts.leader_elect_endpoint = "127.0.0.1:8085"
+        runtime, store, raw = build_operator(opts, cloud=cloud)
+        cat = next(c for c in runtime.controllers
+                   if getattr(c, "name", "") == "providers.refresh").catalog
+        assert cat.pricing.snapshot_path == snap
+        assert isinstance(runtime.elector.backend, HTTPLeaseBackend)
+        assert runtime.elector.backend.port == 8085
+
 
 class TestChangeMonitor:
     def test_dedupes_until_change_or_ttl(self):
@@ -514,30 +537,6 @@ class TestClusterStateMetrics:
         assert 'karpenter_cluster_state_pod_count{phase="bound"}' in text
         assert "karpenter_cluster_utilization_percent" in text
         assert "karpenter_nodeclaims_lifecycle_duration_seconds" in text
-
-
-    def test_build_operator_wires_round5_options(self, tmp_path):
-        """Round-5 wiring: the pricing snapshot path reaches the pricing
-        provider, and LEADER_ELECT_ENDPOINT selects the HTTP lease
-        backend over the file one."""
-        from karpenter_tpu.cloud.fake import FakeCloud
-        from karpenter_tpu.catalog import small_catalog
-        from karpenter_tpu.main import build_operator
-        from karpenter_tpu.utils.leaderelection import HTTPLeaseBackend
-        snap = str(tmp_path / "prices.json")
-        cloud = FakeCloud(small_catalog())
-        opts = Options.parse([], env={})
-        opts.metrics_port = 0
-        opts.solver_backend = "host"
-        opts.pricing_snapshot_file = snap
-        opts.leader_elect = True
-        opts.leader_elect_endpoint = "127.0.0.1:8085"
-        runtime, store, raw = build_operator(opts, cloud=cloud)
-        cat = next(c for c in runtime.controllers
-                   if getattr(c, "name", "") == "providers.refresh").catalog
-        assert cat.pricing.snapshot_path == snap
-        assert isinstance(runtime.elector.backend, HTTPLeaseBackend)
-        assert runtime.elector.backend.port == 8085
 
 
 class TestDebugMonitor:
