@@ -119,8 +119,13 @@ def main() -> None:
     _u1, _r1 = transfer_stats()
     detail["c5_uploads_per_solve"] = _u1 - _u0
     detail["c5_reads_per_solve"] = _r1 - _r0
-    assert _u1 - _u0 <= 2 and _r1 - _r0 == 1, (
-        f"transfer budget blown: {_u1 - _u0} uploads / {_r1 - _r0} reads")
+    if _u1 - _u0 > 2 or _r1 - _r0 != 1:
+        # report, don't crash: the driver needs the JSON line even when
+        # the budget regresses (tests/test_transfer_budget.py carries the
+        # hard assert that makes this a red diff)
+        detail["transfer_budget_violated"] = True
+        progress(f"TRANSFER BUDGET BLOWN: {_u1 - _u0} uploads / "
+                 f"{_r1 - _r0} reads per solve")
     # e2e includes the tunnel RTT to the remote TPU (~70ms/read on this
     # rig); kernel_device_ms is what the chip itself spends (pipelined
     # dispatch, one block) — the honest compute comparison vs the C++ FFD
