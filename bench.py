@@ -39,7 +39,42 @@ def timeit(fn, repeats=5):
     return statistics.median(vals)
 
 
+def _accelerator_reachable(timeout_s: float = 180.0) -> bool:
+    """Probe backend init in a SUBPROCESS with a deadline: the tunneled
+    TPU's client can hang indefinitely when the tunnel is down (observed
+    for hours on this rig), and a bench that hangs records nothing."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "jnp.zeros(4).block_until_ready()"],
+            timeout=timeout_s, capture_output=True)
+        if r.returncode != 0:
+            # a FAST failure is a different diagnosis than a hang —
+            # surface the child's error tail, don't swallow it
+            tail = (r.stderr or b"").decode(errors="replace").strip()
+            progress("accelerator init FAILED (not a timeout): "
+                     + tail[-300:])
+            return False
+        return True
+    except subprocess.TimeoutExpired:
+        progress(f"accelerator init timed out after {timeout_s:.0f}s "
+                 "(tunnel down/hung)")
+        return False
+
+
 def main() -> None:
+    platform = "accelerator"
+    if not _accelerator_reachable():
+        # honest degraded mode: the JSON says so, the numbers are NOT
+        # comparable to tunnel runs (no RTT), but the driver gets a
+        # result instead of a hang/crash
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu-fallback"
+        progress("accelerator unreachable — CPU fallback "
+                 "(no tunnel RTT; not comparable to TPU runs)")
     from karpenter_tpu.catalog import generate_catalog, small_catalog
     from karpenter_tpu.models.pod import Pod
     from karpenter_tpu.models.resources import Resources
@@ -250,6 +285,7 @@ def main() -> None:
     detail["c6_interruption_msgs_per_sec"] = round(15_000 / dt)
 
     progress("done")
+    detail["platform"] = platform
     result = {
         "metric": "p50 Solve() latency, 100k pods x full catalog",
         "value": round(tpu_s * 1e3, 1),
