@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
-        deflake run native clean
+        deflake run native trace-report clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -20,6 +20,9 @@ scaletests:  ## the scale grid (node-dense / pod-dense / deprovisioning)
 
 benchmark:  ## one JSON line on the attached TPU (reference: make benchmark)
 	$(PY) bench.py
+
+trace-report:  ## slowest spans from $$KARPENTER_TPU_TRACE_DIR/traces.jsonl (or TRACE=path)
+	$(PY) tools/trace_report.py $(TRACE)
 
 docgen:  ## regenerate docs/reference/* from the live registry + catalog
 	$(PY) tools/gen_docs.py

@@ -123,14 +123,34 @@ def _pin_cpu_if_unreachable() -> str:
 
 def main() -> None:
     platform = _pin_cpu_if_unreachable()
+    import os
+
     from karpenter_tpu.catalog import generate_catalog, small_catalog
     from karpenter_tpu.models.pod import Pod
     from karpenter_tpu.models.resources import Resources
+    from karpenter_tpu.obs import TRACER, write_chrome_trace
     from karpenter_tpu.ops.binpack import solve_host
     from karpenter_tpu.ops.encode import encode_catalog, encode_pods
     from karpenter_tpu.ops.solver import solve_device
 
     detail = {}
+
+    # bench manages its own trace windows (cold c2 + warm c7): the
+    # KARPENTER_TPU_TRACE_DIR auto-enable would otherwise trace every
+    # timed rep and skew the published numbers with span overhead. The
+    # ring is re-sized too — a KARPENTER_TPU_TRACE_RING=1 environment
+    # would evict the warm trace (it is faster than the cold one) and
+    # c7's artifact lookup would find nothing
+    TRACER.configure(enabled=False, ring_size=8)
+
+    # optional live exposition while the bench runs (the runtime serves
+    # the same routes in deployment): /metrics, /debug/traces, /healthz
+    server = None
+    if os.environ.get("KARPENTER_TPU_METRICS_PORT"):
+        from karpenter_tpu.obs.exposition import ExpositionServer
+        server = ExpositionServer(
+            port=int(os.environ["KARPENTER_TPU_METRICS_PORT"])).start()
+        progress(f"exposition server on 127.0.0.1:{server.port}")
 
     shapes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"),
               ("2", "4Gi"), ("4", "16Gi"), ("500m", "4Gi"),
@@ -166,7 +186,14 @@ def main() -> None:
     # --- config 2 + headline: 10k / 100k pods, full catalog ---
     cat = encode_catalog(generate_catalog())
     enc10k = encode_pods(mk_pods(10_000), cat)
-    solve_device(cat, enc10k)
+    # trace the COLD solve: its dispatch span is the honest solve.compile
+    # (first full-catalog shape bucket → XLA compile); tracing then turns
+    # off so the timed sections below run the untraced production path
+    TRACER.configure(enabled=True)
+    with TRACER.trace("bench.solve_cold", config="c2_10k_full",
+                      platform=platform):
+        solve_device(cat, enc10k)
+    TRACER.configure(enabled=False)
     detail["c2_10k_full_ms"] = round(timeit(lambda: solve_device(cat, enc10k)) * 1e3, 1)
 
     progress("c5: 100k x full catalog")
@@ -332,7 +359,49 @@ def main() -> None:
     detail["c6_interruption_15k_ms"] = round(dt * 1e3, 1)
     detail["c6_interruption_msgs_per_sec"] = round(15_000 / dt)
 
+    progress("c7: trace artifact (warm 100k solve, full decomposition)")
+    # --- config 7: the flight-recorder artifact. One warm traced solve of
+    # the headline config; together with the cold c2 trace the Chrome
+    # artifact decomposes a solve into encode / device-put / compile /
+    # dispatch / readback / decode — BENCH_*.json deltas become
+    # explainable by diffing the artifact, not by guessing.
+    TRACER.configure(enabled=True)
+    with TRACER.trace("bench.solve", config="c5_100k", platform=platform):
+        with TRACER.span("solve.encode", pods=100_000):
+            enc_trace = encode_pods(pods100k, cat)
+        solve_device(cat, enc_trace)
+    TRACER.configure(enabled=False)
+    trace_dir = os.environ.get("KARPENTER_TPU_TRACE_DIR") or "."
+    os.makedirs(trace_dir, exist_ok=True)
+    artifact = os.path.join(trace_dir, "trace_bench.json")
+    write_chrome_trace(TRACER.recorder.slowest(), artifact)
+    warm = next(t for t in TRACER.recorder.slowest()
+                if t.root.name == "bench.solve")
+    dev = next(s for s in warm.spans if s.name == "solve.device")
+    kids = [s for s in warm.spans if s.parent_id == dev.span_id]
+    cover = sum(s.duration for s in kids) / max(dev.duration, 1e-9)
+    detail["trace_artifact"] = artifact
+    # fraction of the end-to-end device solve covered by its stage spans
+    # (acceptance: within 10%, i.e. >= 0.9)
+    detail["trace_decomposition_cover"] = round(cover, 3)
+    detail["trace_solve_e2e_ms"] = round(dev.duration * 1e3, 1)
+    detail["trace_stage_ms"] = {
+        s.name.replace("solve.", ""): round(s.duration * 1e3, 2)
+        for s in kids}
+    all_spans = {s.name for t in TRACER.recorder.slowest() for s in t.spans}
+    detail["trace_spans"] = sorted(all_spans)
+    if cover < 0.9:
+        progress(f"TRACE DECOMPOSITION GAP: stages cover only "
+                 f"{cover:.0%} of the device solve")
+    from karpenter_tpu.metrics import REGISTRY as _REG
+    exposed = _REG.expose()
+    detail["trace_metrics_ok"] = (
+        "karpenter_tpu_solver_transfer_host_to_device_bytes" in exposed
+        and "karpenter_tpu_solver_compile_cache_total" in exposed)
+
     progress("done")
+    if server is not None:
+        server.stop()
     detail["platform"] = platform
     result = {
         "metric": "p50 Solve() latency, 100k pods x full catalog",

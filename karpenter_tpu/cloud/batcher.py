@@ -36,6 +36,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..metrics import BATCH_SIZE
+from ..obs.tracer import NOOP_SPAN, TRACER
 from .provider import CloudError
 
 DEFAULT_IDLE = 0.100   # reference: 100ms idle window
@@ -97,8 +98,11 @@ class BatchingCloud:
     def _flush_terminations(self) -> None:
         batch, self._pending = self._pending, []
         self._pending_set = set()
+        sp = (TRACER.span("cloud.terminate", batch=len(batch))
+              if TRACER.enabled else NOOP_SPAN)
         try:
-            self.inner.terminate(batch)  # ONE wire call for N controllers
+            with sp:
+                self.inner.terminate(batch)  # ONE wire call, N controllers
         except CloudError as e:
             self.stats["terminate_errors"] += 1
             if getattr(e, "retryable", False):
@@ -159,7 +163,12 @@ class BatchingCloud:
         if hit is not None:
             self.stats["describe_coalesced"] += 1
             return hit
-        result = self.inner.describe(instance_ids)
+        sp = (TRACER.span("cloud.describe",
+                          ids="all" if instance_ids is None
+                          else len(instance_ids))
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            result = self.inner.describe(instance_ids)
         self._describe_cache.set(key, result)
         self.stats["describe_calls"] += 1
         return result
@@ -167,8 +176,11 @@ class BatchingCloud:
     # --- create_fleet: natural per-reconcile batch, metered ---
     def create_fleet(self, requests: list) -> list:
         BATCH_SIZE.observe(float(len(requests)), op="create_fleet")
+        sp = (TRACER.span("cloud.create_fleet", requests=len(requests))
+              if TRACER.enabled else NOOP_SPAN)
         try:
-            return self.inner.create_fleet(requests)
+            with sp:
+                return self.inner.create_fleet(requests)
         finally:
             self._describe_cache.flush()  # reads must see the new instances
 

@@ -28,6 +28,7 @@ from ..catalog.provider import CatalogProvider
 from ..models import labels as L
 from ..models.nodeclaim import NodeClaim, Phase
 from ..models.nodepool import NodePool
+from ..obs.tracer import NOOP_SPAN, TRACER
 from ..ops.facade import Solver
 from ..state.cluster import NodeView, build_node_views
 from ..state.store import Store
@@ -75,7 +76,10 @@ class DisruptionController:
                 and now - self.store.adopted_at < ADOPTION_SETTLE):
             return self.requeue
         for pool in self.store.nodepools_by_weight():
-            self._reconcile_pool(pool, now)
+            sp = (TRACER.span("disruption.pool", pool=pool.name)
+                  if TRACER.enabled else NOOP_SPAN)
+            with sp:
+                self._reconcile_pool(pool, now)
         return self.requeue
 
     # --- pending replacements: delete victims once replacements are up ---
@@ -387,11 +391,15 @@ class DisruptionController:
                   and not v.claim.is_deleting()
                   and not self._is_pending_victim(v.name)]
         node_class = self.store.nodeclasses.get(pool.node_class)
-        out = self.solver.solve(
-            pods, pool, node_class,
-            existing=[v.virtual for v in others],
-            existing_pods={v.name: v.pods for v in others},
-            daemonsets=list(self.store.daemonsets.values()))
+        sp = (TRACER.span("disruption.simulate", victims=len(victims),
+                          pods=len(pods), others=len(others))
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            out = self.solver.solve(
+                pods, pool, node_class,
+                existing=[v.virtual for v in others],
+                existing_pods={v.name: v.pods for v in others},
+                daemonsets=list(self.store.daemonsets.values()))
         if out.unschedulable:
             return out, False
         if max_new_price is not None:
@@ -457,10 +465,14 @@ class DisruptionController:
                 gi = sig_to_g.get(p.constraint_signature())
                 if gi is not None:
                     counts[i, gi] += 1
+        sp = (TRACER.span("disruption.screen", nodes=len(views),
+                          candidates=len(candidates))
+              if TRACER.enabled else NOOP_SPAN)
         try:
-            screen, _slack = consolidation_screen(
-                cat, enc, views, counts,
-                mesh=self.solver.screen_mesh(len(views)))
+            with sp:
+                screen, _slack = consolidation_screen(
+                    cat, enc, views, counts,
+                    mesh=self.solver.screen_mesh(len(views)))
         except Exception:
             return candidates  # screen is best-effort; fall back to cost order
         ok = {v.name for i, v in enumerate(views) if screen[i]}

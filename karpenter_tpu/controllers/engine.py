@@ -15,6 +15,7 @@ from typing import Callable, Dict, List, Optional, Protocol
 
 from ..cloud.provider import CloudError
 from ..metrics import RECONCILE_DURATION, RECONCILE_ERRORS
+from ..obs.tracer import NOOP_SPAN, TRACER
 
 
 class Controller(Protocol):
@@ -55,24 +56,37 @@ class Engine:
                     now + max(0.0, self.elector.reconcile(now)))
             if not self.elector.is_leader():
                 return
-        for c in self.controllers:
-            if now >= self._next_run.get(c.name, 0.0):
-                t0 = _time.perf_counter()
-                try:
-                    requeue = c.reconcile(now)
-                except CloudError as e:
-                    # retryable cloud errors (rate limits, server errors)
-                    # model transient throttling: back off and retry, the
-                    # way real clients do. Anything else is a bug — crash.
-                    if not getattr(e, "retryable", False):
-                        raise
-                    RECONCILE_ERRORS.inc(controller=c.name,
-                                         disposition="backoff")
-                    requeue = 2.0
-                finally:
-                    RECONCILE_DURATION.observe(_time.perf_counter() - t0,
-                                               controller=c.name)
-                self._next_run[c.name] = now + max(0.0, requeue)
+        # one trace per tick, one span per controller reconcile: the
+        # tracer drops childless roots, so an idle tick (no controller
+        # due) records nothing; when tracing is off both calls return the
+        # shared no-op singleton and the tick is exactly as before
+        tick_sp = (TRACER.trace("engine.tick", sim_now=now)
+                   if TRACER.enabled else NOOP_SPAN)
+        with tick_sp:
+            for c in self.controllers:
+                if now >= self._next_run.get(c.name, 0.0):
+                    sp = (TRACER.span(f"reconcile:{c.name}",
+                                      controller=c.name)
+                          if TRACER.enabled else NOOP_SPAN)
+                    t0 = _time.perf_counter()
+                    try:
+                        with sp:
+                            requeue = c.reconcile(now)
+                    except CloudError as e:
+                        # retryable cloud errors (rate limits, server
+                        # errors) model transient throttling: back off
+                        # and retry, the way real clients do. Anything
+                        # else is a bug — crash.
+                        if not getattr(e, "retryable", False):
+                            raise
+                        RECONCILE_ERRORS.inc(controller=c.name,
+                                             disposition="backoff")
+                        requeue = 2.0
+                    finally:
+                        RECONCILE_DURATION.observe(
+                            _time.perf_counter() - t0, controller=c.name,
+                            exemplar=TRACER.current_trace_id())
+                    self._next_run[c.name] = now + max(0.0, requeue)
 
     def run_for(self, seconds: float, step: float = 0.5) -> None:
         end = self.clock.now() + seconds

@@ -29,6 +29,7 @@ from ..models.nodepool import NodeClassSpec, NodePool
 from ..models.pod import Pod
 from ..metrics import (ICE_ERRORS, NODECLAIMS_CREATED, PODS_SCHEDULED,
                        PODS_UNSCHEDULABLE)
+from ..obs.tracer import NOOP_SPAN, TRACER
 from ..models.resources import Resources
 from ..ops.facade import NodeLaunch, Solver, virtual_node_from_claim
 from ..state.store import Store
@@ -54,7 +55,12 @@ class Provisioner:
         # the store's admission-time index IS the pending-unnominated set,
         # already bucketed by constraint signature — the first pool's
         # encode skips its per-pod grouping pass entirely
-        groups = self.store.pending_unnominated_groups()
+        batch_sp = (TRACER.span("provision.batch")
+                    if TRACER.enabled else NOOP_SPAN)
+        with batch_sp:
+            groups = self.store.pending_unnominated_groups()
+            batch_sp.set(groups=len(groups),
+                         pods=sum(len(g) for g in groups))
         if not groups:
             return self.requeue
         pending = [p for g in groups for p in g]
@@ -63,7 +69,12 @@ class Provisioner:
         for pool in self.store.nodepools_by_weight():
             if not remaining:
                 break
-            out = self._provision_pool(pool, remaining, now, pregrouped)
+            pool_sp = (TRACER.span("provision.pool", pool=pool.name,
+                                   pods=len(remaining))
+                       if TRACER.enabled else NOOP_SPAN)
+            with pool_sp:
+                out = self._provision_pool(pool, remaining, now, pregrouped)
+                pool_sp.set(leftover=len(out))
             if out is not remaining:
                 # the pool actually solved (a not-ready NodeClass gate
                 # returns the identical list object untouched — keep the
@@ -304,8 +315,12 @@ class Provisioner:
                 if (self._floors_hold(pre, floors)
                         and not self._floors_hold(req.overrides, floors)):
                     req.overrides = pre
+        fleet_sp = (TRACER.span("provision.launch", pool=pool.name,
+                                requests=len(requests))
+                    if TRACER.enabled else NOOP_SPAN)
         try:
-            results = self.cloud.create_fleet(requests)
+            with fleet_sp:
+                results = self.cloud.create_fleet(requests)
         except CloudError as e:
             if not getattr(e, "retryable", False):
                 raise
@@ -327,48 +342,51 @@ class Provisioner:
 
         launched: List[NodeClaim] = []
         failed_pods: List[Pod] = []
-        for (claim, launch), res in zip(claims, results):
-            if isinstance(res, Instance):
-                claim.phase = Phase.LAUNCHED
-                claim.provider_id = res.provider_id
-                self.store.index_nodeclaim_instance(claim)
-                claim.instance_type = res.instance_type
-                claim.zone = res.zone
-                claim.capacity_type = res.capacity_type
-                claim.price = res.price
-                claim.launched_at = now
-                claim.image_id = res.image_id
-                claim.network_groups = list(res.network_groups)
-                claim.profile = res.profile
-                itype = next((t for t in self.catalog.list(node_class)
-                              if t.name == res.instance_type), None)
-                if itype is not None:
-                    claim.capacity = Resources(itype.capacity)
-                    claim.allocatable = itype.allocatable()
-                claim.labels[L.ZONE] = res.zone
-                claim.labels[L.CAPACITY_TYPE] = res.capacity_type
-                claim.labels[L.INSTANCE_TYPE] = res.instance_type
-                if res.reservation_id:
-                    claim.annotations["karpenter.tpu/reservation-id"] = res.reservation_id
-                    cap = next((o.reservation_capacity for t in self.catalog.raw_types()
-                                if t.name == res.instance_type
-                                for o in t.offerings
-                                if o.reservation_id == res.reservation_id), 0)
-                    self.catalog.mark_reservation_launched(res.reservation_id, cap)
-                for k in launch.pod_keys:
-                    pod = self.store.pods.get(k)
-                    if pod is not None:
-                        self._nominate(pod, claim)
-                self.stats["launches"] += 1
-                launched.append(claim)
-                NODECLAIMS_CREATED.inc(nodepool=claim.nodepool,
-                                       instance_type=claim.instance_type,
-                                       capacity_type=claim.capacity_type)
-            else:
-                self._handle_launch_error(claim, res)
-                failed_pods.extend(self.store.pods[k] for k in launch.pod_keys
-                                   if k in self.store.pods)
-        return launched, failed_pods
+        bind_sp = (TRACER.span("provision.bind", claims=len(claims))
+                   if TRACER.enabled else NOOP_SPAN)
+        with bind_sp:
+            for (claim, launch), res in zip(claims, results):
+                if isinstance(res, Instance):
+                    claim.phase = Phase.LAUNCHED
+                    claim.provider_id = res.provider_id
+                    self.store.index_nodeclaim_instance(claim)
+                    claim.instance_type = res.instance_type
+                    claim.zone = res.zone
+                    claim.capacity_type = res.capacity_type
+                    claim.price = res.price
+                    claim.launched_at = now
+                    claim.image_id = res.image_id
+                    claim.network_groups = list(res.network_groups)
+                    claim.profile = res.profile
+                    itype = next((t for t in self.catalog.list(node_class)
+                                  if t.name == res.instance_type), None)
+                    if itype is not None:
+                        claim.capacity = Resources(itype.capacity)
+                        claim.allocatable = itype.allocatable()
+                    claim.labels[L.ZONE] = res.zone
+                    claim.labels[L.CAPACITY_TYPE] = res.capacity_type
+                    claim.labels[L.INSTANCE_TYPE] = res.instance_type
+                    if res.reservation_id:
+                        claim.annotations["karpenter.tpu/reservation-id"] = res.reservation_id
+                        cap = next((o.reservation_capacity for t in self.catalog.raw_types()
+                                    if t.name == res.instance_type
+                                    for o in t.offerings
+                                    if o.reservation_id == res.reservation_id), 0)
+                        self.catalog.mark_reservation_launched(res.reservation_id, cap)
+                    for k in launch.pod_keys:
+                        pod = self.store.pods.get(k)
+                        if pod is not None:
+                            self._nominate(pod, claim)
+                    self.stats["launches"] += 1
+                    launched.append(claim)
+                    NODECLAIMS_CREATED.inc(nodepool=claim.nodepool,
+                                           instance_type=claim.instance_type,
+                                           capacity_type=claim.capacity_type)
+                else:
+                    self._handle_launch_error(claim, res)
+                    failed_pods.extend(self.store.pods[k] for k in launch.pod_keys
+                                       if k in self.store.pods)
+            return launched, failed_pods
 
     def _handle_launch_error(self, claim: NodeClaim, err: CloudError) -> None:
         claim.phase = Phase.FAILED

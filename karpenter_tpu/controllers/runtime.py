@@ -19,7 +19,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..cloud.provider import CloudError
-from ..metrics import (RECONCILE_DURATION, RECONCILE_ERRORS, REGISTRY)
+from ..metrics import RECONCILE_DURATION, RECONCILE_ERRORS
+from ..obs.tracer import NOOP_SPAN, TRACER
 from ..utils.clock import RealClock
 
 log = logging.getLogger("karpenter_tpu.runtime")
@@ -119,9 +120,13 @@ class Runtime:
                     pass
                 continue
             name = getattr(c, "name", type(c).__name__)
+            sp = (TRACER.trace(f"reconcile:{name}", controller=name,
+                               driver="runtime")
+                  if TRACER.enabled else NOOP_SPAN)
             t0 = _time.perf_counter()
             try:
-                requeue = c.reconcile(self.clock.now())
+                with sp:
+                    requeue = c.reconcile(self.clock.now())
             except Exception as e:
                 # same contract as the engine: RETRYABLE cloud errors
                 # (throttles, server errors) model transient conditions —
@@ -143,8 +148,10 @@ class Runtime:
                     log.exception("controller %s reconcile crashed", name)
                     requeue = 5.0
             finally:
-                RECONCILE_DURATION.observe(_time.perf_counter() - t0,
-                                           controller=name)
+                RECONCILE_DURATION.observe(
+                    _time.perf_counter() - t0, controller=name,
+                    exemplar=getattr(getattr(sp, "span", None),
+                                     "trace_id", None))
             try:
                 await asyncio.wait_for(self._stop.wait(),
                                        timeout=max(0.01, requeue))
@@ -152,13 +159,22 @@ class Runtime:
                 pass
 
     async def _serve_metrics(self) -> None:
+        # routes come from obs.exposition.render — the same table the
+        # stdlib ExpositionServer serves, so /metrics, /debug/traces and
+        # /healthz behave identically on both servers
+        from ..obs.exposition import render
+
         async def handle(reader, writer):
             try:
-                await reader.readline()
-                body = REGISTRY.expose().encode()
-                writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
-                             b"version=0.0.4\r\nContent-Length: "
-                             + str(len(body)).encode() + b"\r\n\r\n" + body)
+                line = await reader.readline()
+                parts = line.decode("latin-1", "replace").split()
+                path = parts[1] if len(parts) >= 2 else "/metrics"
+                status, ctype, body = render(path)
+                reason = {200: "OK", 404: "Not Found"}.get(status, "OK")
+                writer.write((f"HTTP/1.1 {status} {reason}\r\n"
+                              f"Content-Type: {ctype}\r\n"
+                              f"Content-Length: {len(body)}\r\n\r\n"
+                              ).encode() + body)
                 await writer.drain()
             finally:
                 writer.close()

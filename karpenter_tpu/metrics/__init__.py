@@ -116,5 +116,21 @@ NODEPOOL_LIMIT = REGISTRY.gauge(
     "karpenter_nodepools_limit",
     "A NodePool's spec.limits (reference karpenter_nodepools_limit)",
     ("nodepool", "resource"))
+TRANSFER_BYTES_H2D = REGISTRY.gauge(
+    "karpenter_tpu_solver_transfer_host_to_device_bytes",
+    "Bytes uploaded host-to-device by the last solve — the tunnel-budget "
+    "observable ops/solver.transfer_stats() counts calls for, in bytes, "
+    "visible without reading bench JSON")
+TRANSFER_BYTES_D2H = REGISTRY.gauge(
+    "karpenter_tpu_solver_transfer_device_to_host_bytes",
+    "Bytes read device-to-host by the last solve (the packed result "
+    "vector; growth here means the single-read output packing regressed)")
+COMPILE_CACHE = REGISTRY.counter(
+    "karpenter_tpu_solver_compile_cache_total",
+    "Kernel dispatches by compile-cache outcome: a 'miss' pays an XLA "
+    "compile (tens of seconds on the tunneled TPU), a 'hit' reuses the "
+    "bucketed executable — _bucket()'s quantum=64 padding exists "
+    "precisely to keep this at ~1 miss per shape bucket in production",
+    ("event",))
 
 __all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram"]

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Optional
@@ -24,18 +25,33 @@ DEFAULT_PATH = os.environ.get("KARPENTER_TPU_DURATIONS",
 class DurationRecorder:
     def __init__(self, path: str = DEFAULT_PATH):
         self.path = path
+        # scale tests drive controllers from multiple threads; interleaved
+        # appends would corrupt the JSONL (two writers, one line)
+        self._lock = threading.Lock()
 
     def record(self, name: str, seconds: float,
                dimensions: Optional[Dict[str, str]] = None) -> None:
         evt = {"measure": "duration", "name": name, "seconds": round(seconds, 4),
                "dimensions": dimensions or {}, "recorded_at": time.time()}
-        with open(self.path, "a") as f:
-            f.write(json.dumps(evt) + "\n")
+        line = json.dumps(evt) + "\n"  # serialize outside the lock
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)  # single buffered append per event
 
     @contextmanager
     def measure(self, name: str, sim_clock=None, **dimensions):
-        """Measure wall (or sim) time of a block."""
+        """Measure wall (or sim) time of a block. The event records in a
+        finally with an `outcome` dimension — a raising block used to
+        drop its event entirely, hiding exactly the runs worth seeing."""
         t0 = sim_clock.now() if sim_clock else time.perf_counter()
-        yield
-        t1 = sim_clock.now() if sim_clock else time.perf_counter()
-        self.record(name, t1 - t0, {k: str(v) for k, v in dimensions.items()})
+        outcome = "ok"
+        try:
+            yield
+        except BaseException:
+            outcome = "error"
+            raise
+        finally:
+            t1 = sim_clock.now() if sim_clock else time.perf_counter()
+            dims = {k: str(v) for k, v in dimensions.items()}
+            dims["outcome"] = outcome
+            self.record(name, t1 - t0, dims)

@@ -4,7 +4,11 @@ Mirrors the reference's metric surface (website/docs reference/metrics.md
 catalogs ~19 groups: nodeclaims, pods, scheduler durations, disruption
 decisions, cloudprovider offering gauges, batcher histograms...). No
 external client dependency; text exposition matches the Prometheus format
-so a scraper can consume `registry.expose()` verbatim.
+so a scraper can consume `registry.expose()` verbatim — with one caveat:
+histogram exemplars (`# {trace_id="..."} v` suffixes) are an OpenMetrics
+feature the classic 0.0.4 text parser rejects, so the HTTP exposition
+layer advertises the OpenMetrics content type (obs/exposition.py); call
+`expose(exemplars=False)` for a strictly 0.0.4 document.
 """
 
 from __future__ import annotations
@@ -91,8 +95,17 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
         self._totals: Dict[Tuple[str, ...], int] = {}
+        # (labelset, bucket_index) -> (trace_id, value): last exemplar per
+        # bucket, OpenMetrics-style — a fat latency bucket points at a
+        # captured trace in the flight recorder
+        self._exemplars: Dict[Tuple[Tuple[str, ...], int],
+                              Tuple[str, float]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """exemplar: a trace id to pin to the bucket this value lands in
+        (e.g. obs.TRACER.current_trace_id()); None leaves exemplars
+        untouched."""
         with self._lock:
             k = self._key(labels)
             counts = self._counts.setdefault(k, [0] * len(self.buckets))
@@ -101,6 +114,9 @@ class Histogram(_Metric):
                 counts[j] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
+            if exemplar is not None:
+                self._exemplars[(k, min(i, len(self.buckets)))] = (
+                    str(exemplar), value)
 
     def percentile(self, q: float, **labels) -> Optional[float]:
         k = self._key(labels)
@@ -114,16 +130,27 @@ class Histogram(_Metric):
                 return b
         return self.buckets[-1]
 
-    def expose(self) -> List[str]:
+    def expose(self, exemplars: bool = True) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         for k in sorted(self._totals):
             labels = self._fmt_labels(k)
             base = labels[1:-1] if labels else ""
-            for b, c in zip(self.buckets, self._counts[k]):
+            for i, (b, c) in enumerate(zip(self.buckets, self._counts[k])):
                 sep = "," if base else ""
-                out.append(f'{self.name}_bucket{{{base}{sep}le="{b:g}"}} {c}')
-            out.append(f'{self.name}_bucket{{{base}{"," if base else ""}le="+Inf"}} '
-                       f"{self._totals[k]}")
+                line = f'{self.name}_bucket{{{base}{sep}le="{b:g}"}} {c}'
+                ex = self._exemplars.get((k, i)) if exemplars else None
+                if ex is not None:
+                    # OpenMetrics exemplar syntax: the trace id a sample
+                    # in this bucket came from
+                    line += f' # {{trace_id="{ex[0]}"}} {ex[1]:g}'
+                out.append(line)
+            inf_line = (f'{self.name}_bucket{{{base}{"," if base else ""}'
+                        f'le="+Inf"}} {self._totals[k]}')
+            ex = (self._exemplars.get((k, len(self.buckets)))
+                  if exemplars else None)
+            if ex is not None:
+                inf_line += f' # {{trace_id="{ex[0]}"}} {ex[1]:g}'
+            out.append(inf_line)
             out.append(f"{self.name}_sum{labels} {self._sums[k]:g}")
             out.append(f"{self.name}_count{labels} {self._totals[k]}")
         return out
@@ -148,8 +175,15 @@ class Registry:
         self._metrics.append(m)
         return m
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = True) -> str:
+        """exemplars=False renders a strictly Prometheus-0.0.4 document
+        (the classic parser reads exemplar suffixes as a malformed
+        timestamp and rejects the whole scrape); the default keeps them,
+        and the HTTP layer advertises the OpenMetrics content type."""
         lines: List[str] = []
         for m in self._metrics:
-            lines.extend(m.expose())
+            if isinstance(m, Histogram):
+                lines.extend(m.expose(exemplars=exemplars))
+            else:
+                lines.extend(m.expose())
         return "\n".join(lines) + "\n"

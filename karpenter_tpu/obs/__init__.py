@@ -1,0 +1,10 @@
+"""Observability: span tracing, the solver flight recorder, and HTTP
+exposition. See docs/observability.md for the span taxonomy and how to
+read a bench trace."""
+
+from .tracer import (NOOP_SPAN, TRACER, FlightRecorder, Span, Trace, Tracer,
+                     summarize, to_chrome_events, write_chrome_trace)
+
+__all__ = ["TRACER", "Tracer", "Span", "Trace", "FlightRecorder",
+           "NOOP_SPAN", "to_chrome_events", "write_chrome_trace",
+           "summarize"]

@@ -1,0 +1,89 @@
+"""HTTP exposition: /metrics, /debug/traces, /healthz.
+
+One route table (`render`) shared by BOTH servers so the two can't
+drift: the async runtime's handler (controllers/runtime.py — the
+deployment path, one event loop) and the stdlib ThreadingHTTPServer here
+(`ExpositionServer` — for bench runs and anything without an event
+loop). The reference ships the same trio: controller-runtime's metrics
+endpoint + health probes; /debug/traces is the flight-recorder window
+this framework adds on top.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional, Tuple
+
+from .tracer import TRACER, Tracer, to_chrome_events
+
+
+def render(path: str, tracer: Optional[Tracer] = None,
+           ) -> Tuple[int, str, bytes]:
+    """(status, content_type, body) for an exposition route. Unknown
+    paths 404 — both servers answer identically."""
+    tracer = tracer or TRACER
+    route, _, query = path.partition("?")
+    if route == "/metrics":
+        from ..metrics import REGISTRY
+        # exemplars are an OpenMetrics feature — the classic 0.0.4 parser
+        # reads the '# {trace_id=...}' suffix as a malformed timestamp
+        # and fails the whole scrape, so advertise the OpenMetrics type
+        # (and close with its required EOF marker)
+        body = REGISTRY.expose().encode() + b"# EOF\n"
+        return (200, "application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8", body)
+    if route == "/healthz":
+        return 200, "text/plain", b"ok\n"
+    if route == "/debug/traces":
+        traces = tracer.recorder.slowest()
+        if "format=chrome" in query:
+            body = json.dumps({"traceEvents": to_chrome_events(traces),
+                               "displayTimeUnit": "ms"})
+        else:
+            body = json.dumps({"enabled": tracer.enabled,
+                               "ring_size": tracer.recorder.size,
+                               "count": len(traces),
+                               "traces": [t.to_dict() for t in traces]})
+        return 200, "application/json", body.encode()
+    return 404, "text/plain", b"not found\n"
+
+
+class ExpositionServer:
+    """Stdlib threaded HTTP server for the exposition routes — no event
+    loop required (bench.py, ad-hoc debugging). Daemon threads; stop()
+    is clean but the process exiting without it is also fine."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 tracer: Optional[Tracer] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        tr = tracer or TRACER
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                status, ctype, body = render(self.path, tr)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass  # scrapes must not spam stderr
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ExpositionServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="karpenter-tpu-exposition",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -27,6 +27,7 @@ from ..models.nodepool import NodeClassSpec, NodePool
 from ..models.pod import Pod, term_selects
 from ..models.requirements import Requirements
 from ..models.resources import Resources
+from ..obs.tracer import NOOP_SPAN, TRACER
 from .affinity import apply_zone_affinity
 from .binpack import (SolveResult, SpreadConstraintCounts, VirtualNode,
                       solve_host, split_spread_groups, validate_solution)
@@ -400,11 +401,16 @@ class Solver:
                 return self._retry_reserved_unschedulable(
                     out, blocks_gated, all_pods, nodepool, node_class,
                     spread_occupancy, daemonsets)
-        enc = encode_pods(pods, cat,
-                          extra_requirements=nodepool.requirements,
-                          taints=nodepool.taints + nodepool.startup_taints,
-                          pregrouped=pregrouped,
-                          template_labels=template)
+        sp = (TRACER.span("solve.encode", pods=len(pods),
+                          pregrouped=pregrouped is not None)
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            enc = encode_pods(pods, cat,
+                              extra_requirements=nodepool.requirements,
+                              taints=nodepool.taints + nodepool.startup_taints,
+                              pregrouped=pregrouped,
+                              template_labels=template)
+            sp.set(groups=int(enc.G))
         if fits_cap is not None:
             enc.compat &= fits_cap[None, :]
             if enc.compat_hard is not None:
@@ -423,9 +429,12 @@ class Solver:
                 occupancy += [
                     (self._zone_of(name, existing, cat), placed)
                     for name, placed in plan.existing_placements.items()]
-        enc = apply_zone_affinity(enc, cat, occupancy)
-        enc = split_spread_groups(
-            enc, cat, self._spread_constraints(enc, cat, occupancy))
+        sp = (TRACER.span("solve.spread") if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            enc = apply_zone_affinity(enc, cat, occupancy)
+            enc = split_spread_groups(
+                enc, cat, self._spread_constraints(enc, cat, occupancy))
+            sp.set(groups=int(enc.G))
         if enc.G == 0:
             out = self._merge_plan(SolveOutput([], {}, dropped), plan,
                                    cat, nodepool)
@@ -457,7 +466,10 @@ class Solver:
             # the C++ FFD takes a flat [T, R] allocatable; zone-varying
             # reservations need the masked-max path — host oracle instead
             backend = "host"
-        with maybe_trace(self.profile_dir):
+        run_sp = (TRACER.span("solve.run", backend=backend,
+                              pods=int(enc.counts.sum()), groups=int(enc.G))
+                  if TRACER.enabled else NOOP_SPAN)
+        with run_sp, maybe_trace(self.profile_dir):
             if backend == "host":
                 result = solve_host(cat, enc, existing)
             elif backend == "native":
@@ -486,7 +498,10 @@ class Solver:
                     self._dcat_cache[dkey] = dcat
                 result = solve_device(cat, enc, existing, dcat=dcat,
                                       mesh=mesh)
-        SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=backend)
+        # exemplar: a fat solve-duration bucket points at the captured
+        # trace in the flight recorder (None when tracing is off)
+        SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=backend,
+                               exemplar=TRACER.current_trace_id())
         SOLVE_PODS.observe(float(enc.counts.sum()))
 
         out = self._decode(cat, enc, result, nodepool, dropped)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.tracer import NOOP_SPAN, TRACER
 from .binpack import BIG, EPS, SolveResult, VirtualNode
 from .encode import CatalogTensors, EncodedPods, align_resources
 
@@ -39,9 +40,11 @@ _F32_MAX = jnp.finfo(jnp.float32).max
 # host↔device traffic counters — the hot-boundary discipline
 # (cloud/metering.py meters wire calls; this meters the device tunnel the
 # same way so a transfer regression is a red test, not a judge finding).
-# Incremented by _put/_read; read via transfer_stats().
+# Incremented by _put/_read; read via transfer_stats()/transfer_bytes().
 _TRANSFERS = 0   # host→device array uploads issued by this module
 _READS = 0       # device→host blocking reads issued by this module
+_TRANSFER_BYTES = 0   # host→device bytes
+_READ_BYTES = 0       # device→host bytes
 
 
 def transfer_stats() -> Tuple[int, int]:
@@ -51,27 +54,61 @@ def transfer_stats() -> Tuple[int, int]:
     return _TRANSFERS, _READS
 
 
+def transfer_bytes() -> Tuple[int, int]:
+    """(host→device, device→host) bytes since import — the companion to
+    transfer_stats(): call COUNT is the RTT budget, byte volume is the
+    bandwidth budget. Diff around a solve; solve_device publishes the
+    per-solve deltas on the transfer-bytes gauges."""
+    return _TRANSFER_BYTES, _READ_BYTES
+
+
 def _put(x) -> jax.Array:
     """Host→device upload, counted. On the deployment rig the TPU sits
     behind a network tunnel where every independent upload can cost a full
     RTT (~70-100 ms measured) — per-solve upload COUNT, not bytes, is the
     latency budget."""
-    global _TRANSFERS
+    global _TRANSFERS, _TRANSFER_BYTES
     _TRANSFERS += 1
-    return jnp.asarray(x)
+    out = jnp.asarray(x)
+    _TRANSFER_BYTES += out.nbytes
+    return out
 
 
 def _put_sharded(x, sharding) -> jax.Array:
     """Counted jax.device_put with an explicit sharding (mesh path)."""
-    global _TRANSFERS
+    global _TRANSFERS, _TRANSFER_BYTES
     _TRANSFERS += 1
-    return jax.device_put(x, sharding)
+    out = jax.device_put(x, sharding)
+    _TRANSFER_BYTES += out.nbytes
+    return out
 
 
 def _read(arr) -> np.ndarray:
-    global _READS
+    global _READS, _READ_BYTES
     _READS += 1
-    return np.asarray(arr)
+    out = np.asarray(arr)
+    _READ_BYTES += out.nbytes
+    return out
+
+
+# compile-cache observability: jax.jit keys its executable cache on
+# (statics, input shapes/dtypes); this mirrors that key so every packed
+# dispatch can be classified hit/miss BEFORE the call — _bucket()'s
+# quantum-64 re-padding exists precisely so production solves converge to
+# all-hits, and the COMPILE_CACHE counter makes that a scrapeable fact
+# instead of a test-only assertion.
+_compile_seen: set = set()
+
+
+def _dispatch_cache_event(key: tuple) -> str:
+    """Classify a packed-kernel dispatch as 'hit'/'miss' and count it."""
+    from ..metrics import COMPILE_CACHE
+    if key in _compile_seen:
+        COMPILE_CACHE.inc(event="hit")
+        return "hit"
+    _compile_seen.add(key)
+    COMPILE_CACHE.inc(event="miss")
+    return "miss"
 
 
 @dataclass(frozen=True)
@@ -97,12 +134,19 @@ def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
     else:
         put = _put
     zovh = align_zone_overhead(cat, R)
-    return DeviceCatalog(
-        alloc=put(align_resources(cat.allocatable, R)),
-        price=put(cat.price),
-        avail=put(cat.available),
-        ovh_z=put(zovh) if zovh is not None else None,
-    )
+    sp = (TRACER.span("solve.catalog_put", T=int(cat.T), R=int(R),
+                      mesh=mesh is not None)
+          if TRACER.enabled else NOOP_SPAN)
+    with sp:
+        b0 = transfer_bytes()[0]
+        dcat = DeviceCatalog(
+            alloc=put(align_resources(cat.allocatable, R)),
+            price=put(cat.price),
+            avail=put(cat.available),
+            ovh_z=put(zovh) if zovh is not None else None,
+        )
+        sp.set(h2d_bytes=transfer_bytes()[0] - b0)
+    return dcat
 
 
 # catalog-epoch device cache for DIRECT solve_device callers (the facade
@@ -480,6 +524,12 @@ def _mesh_packed_fn(mesh, n_max: int, k_max: int, track: bool,
     if fn is None:
         if len(_mesh_fn_cache) >= _MESH_FN_CACHE_MAX:
             _mesh_fn_cache.clear()
+            # the jitted wrappers just died — dispatches with previously
+            # seen mesh shapes will recompile, and reporting them as
+            # 'hit' would hide exactly the compile stall the counter
+            # exists to expose
+            _compile_seen.difference_update(
+                {k for k in _compile_seen if k[0] == "mesh"})
         fn = jax.jit(
             partial(_solve_kernel_packed_impl, n_max=n_max, k_max=k_max,
                     track_conflicts=track, zone_ovh=zone_ovh),
@@ -661,68 +711,111 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
 
     mesh: a jax.sharding.Mesh with a "nodes" axis — the node axis shards
     across the mesh's chips (catalog + group inputs replicated; GSPMD
-    inserts the ICI collectives), the production multi-chip path."""
+    inserts the ICI collectives), the production multi-chip path.
+
+    Tracing wrapper: when the process tracer is on, the whole solve runs
+    under a `solve.device` span whose children decompose it into
+    device-put / compile-or-dispatch / readback / decode stages (see
+    docs/observability.md); the per-solve transfer-byte deltas land on
+    the two transfer gauges either way, so tunnel-volume growth is
+    scrapeable without a bench run."""
+    from ..metrics import TRANSFER_BYTES_D2H, TRANSFER_BYTES_H2D
+    u0, d0 = transfer_bytes()
+    if TRACER.enabled:
+        span = TRACER.span(
+            "solve.device",
+            backend="mesh" if mesh is not None else "device",
+            pods=int(enc.counts.sum()), groups=int(enc.G))
+    else:
+        span = NOOP_SPAN
+    with span:
+        result = _solve_device_impl(cat, enc, existing, n_max, dcat, mesh)
+        u1, d1 = transfer_bytes()
+        TRANSFER_BYTES_H2D.set(u1 - u0)
+        TRANSFER_BYTES_D2H.set(d1 - d0)
+        span.set(h2d_bytes=u1 - u0, d2h_bytes=d1 - d0)
+    return result
+
+
+def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
+                       existing: Optional[List[VirtualNode]] = None,
+                       n_max: Optional[int] = None,
+                       dcat: Optional[DeviceCatalog] = None,
+                       mesh=None) -> SolveResult:
     assert not enc.spread_zone.any(), "run split_spread_groups before solve"
-    R = enc.requests.shape[1]
-    existing = existing or []
-    n_existing = len(existing)
-    total_pods = int(enc.counts.sum())
-    G = enc.G
-    auto_n = n_max is None
-    if auto_n:
-        # node budget from per-group best-type slots (the kernel's per-step
-        # cost is O(n_max), so a tight guess matters: 100k small pods pack
-        # ~100/node, not 4)
-        n_max = _auto_node_budget(cat, enc, n_existing)
-    if mesh is not None:
-        ms = int(mesh.size)
-        n_max = -(-n_max // ms) * ms  # shardable node axis
-    Gp = _bucket(G, 8)
+    prep_sp = (TRACER.span("solve.prep") if TRACER.enabled else NOOP_SPAN)
+    with prep_sp:
+        R = enc.requests.shape[1]
+        existing = existing or []
+        n_existing = len(existing)
+        total_pods = int(enc.counts.sum())
+        G = enc.G
+        auto_n = n_max is None
+        if auto_n:
+            # node budget from per-group best-type slots (the kernel's
+            # per-step cost is O(n_max), so a tight guess matters: 100k
+            # small pods pack ~100/node, not 4)
+            n_max = _auto_node_budget(cat, enc, n_existing)
+        if mesh is not None:
+            ms = int(mesh.size)
+            n_max = -(-n_max // ms) * ms  # shardable node axis
+        Gp = _bucket(G, 8)
 
-    if dcat is not None and (
-            dcat.alloc.shape[1] < R
-            or (dcat.ovh_z is not None) != (cat.zone_overhead is not None)):
-        dcat = None
-    if dcat is None:
-        dcat = (device_catalog(cat, R, mesh=mesh) if mesh is not None
-                else _auto_dcat(cat, R))
+        if dcat is not None and (
+                dcat.alloc.shape[1] < R
+                or (dcat.ovh_z is not None) != (cat.zone_overhead is not None)):
+            dcat = None
+        if dcat is None:
+            dcat = (device_catalog(cat, R, mesh=mesh) if mesh is not None
+                    else _auto_dcat(cat, R))
 
-    # pad group inputs; padded groups have count 0 → no-ops in the scan
-    (requests, counts, compat, allow_zone, allow_cap,
-     max_per_node) = _group_inputs(enc, Gp)
+        # pad group inputs; padded groups have count 0 → no-ops in the scan
+        (requests, counts, compat, allow_zone, allow_cap,
+         max_per_node) = _group_inputs(enc, Gp)
 
-    node_type = np.zeros(n_existing, np.int32)
-    node_cum = np.zeros((n_existing, R), np.float32)
-    node_zmask = np.zeros((n_existing, cat.Z), bool)
-    node_cmask = np.zeros((n_existing, cat.C), bool)
-    node_open = np.zeros(n_existing, bool)
-    for i, n in enumerate(existing):
-        assert len(n.cum) <= R, (
-            f"existing node cum has {len(n.cum)} resources but the current "
-            f"axis is {R} — the resource axis only grows within a process")
-        node_type[i] = n.type_idx
-        node_cum[i, : len(n.cum)] = n.cum
-        node_zmask[i] = n.zone_mask
-        node_cmask[i] = n.cap_mask
-        node_open[i] = True
+        node_type = np.zeros(n_existing, np.int32)
+        node_cum = np.zeros((n_existing, R), np.float32)
+        node_zmask = np.zeros((n_existing, cat.Z), bool)
+        node_cmask = np.zeros((n_existing, cat.C), bool)
+        node_open = np.zeros(n_existing, bool)
+        for i, n in enumerate(existing):
+            assert len(n.cum) <= R, (
+                f"existing node cum has {len(n.cum)} resources but the "
+                f"current axis is {R} — the resource axis only grows "
+                f"within a process")
+            node_type[i] = n.type_idx
+            node_cum[i, : len(n.cum)] = n.cum
+            node_zmask[i] = n.zone_mask
+            node_cmask[i] = n.cap_mask
+            node_open[i] = True
 
-    track = enc.conflict is not None
-    zone_ovh = dcat.ovh_z is not None
-    conflict_np = (_pad_to(_pad_to(enc.conflict, Gp, 0), Gp, 1) if track
-                   else np.zeros((Gp, 1), bool))
-    # prior occupancy / resident bans exist only when existing nodes carry
-    # them; otherwise ship [Gp, 1] zero dummies that broadcast over the node
-    # axis inside the kernel — saves a [Gp, n_max] int32 + bool host→device
-    # transfer per solve (the common fresh-solve case)
-    has_prior = any(n.prior_by_group for n in existing)
-    has_banned = any(n.banned_groups is not None for n in existing)
-    # single-device uploads: ONE packed group matrix; node state only when
-    # resuming onto existing nodes; dummies synthesized inside the jit
-    cols = _request_cols(enc, cat)
+        track = enc.conflict is not None
+        zone_ovh = dcat.ovh_z is not None
+        conflict_np = (_pad_to(_pad_to(enc.conflict, Gp, 0), Gp, 1) if track
+                       else np.zeros((Gp, 1), bool))
+        # prior occupancy / resident bans exist only when existing nodes
+        # carry them; otherwise ship [Gp, 1] zero dummies that broadcast
+        # over the node axis inside the kernel — saves a [Gp, n_max] int32
+        # + bool host→device transfer per solve (the common fresh-solve
+        # case)
+        has_prior = any(n.prior_by_group for n in existing)
+        has_banned = any(n.banned_groups is not None for n in existing)
+        # single-device uploads: ONE packed group matrix; node state only
+        # when resuming onto existing nodes; dummies synthesized inside
+        # the jit
+        cols = _request_cols(enc, cat)
+        prep_sp.set(n_max=int(n_max), groups_padded=int(Gp))
     if mesh is None:
-        gbuf_dev = _put(_pack_groups(requests, counts, compat, allow_zone,
-                                     allow_cap, max_per_node, list(cols)))
-        conflict_dev = _put(conflict_np) if track else None
+        sp = (TRACER.span("solve.device_put") if TRACER.enabled
+              else NOOP_SPAN)
+        with sp:
+            b0 = transfer_bytes()[0]
+            gbuf_dev = _put(_pack_groups(requests, counts, compat,
+                                         allow_zone, allow_cap,
+                                         max_per_node, list(cols)))
+            conflict_dev = _put(conflict_np) if track else None
+            sp.set(gbuf_shape=str(tuple(gbuf_dev.shape)),
+                   h2d_bytes=transfer_bytes()[0] - b0)
     # sparse-take budget: nnz ≈ n_used + cross-node sharing, far below the
     # [Gp·n_max] flat size; regrown + rerun on overflow (rare)
     k_max = _bucket(2 * n_max)
@@ -746,36 +839,69 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
             rep_sh = NamedSharding(mesh, P())
             gn_sh = NamedSharding(mesh, P(None, "nodes"))
             put = _put_sharded
-            packed = _mesh_packed_fn(mesh, n_max, k_max, track, zone_ovh)(
-                dcat.alloc, dcat.price, dcat.avail,
-                put(requests, rep_sh), put(counts, rep_sh),
-                put(compat, rep_sh), put(allow_zone, rep_sh),
-                put(allow_cap, rep_sh), put(max_per_node, rep_sh),
-                put(prior, gn_sh if has_prior else rep_sh),
-                put(banned, gn_sh if has_banned else rep_sh),
-                put(conflict_np, rep_sh),
-                zovh if zone_ovh else put(np.asarray(zovh), rep_sh),
-                put(_pad_to(node_type, n_max), nodes_sh),
-                put(_pad_to(node_cum, n_max), nodes_sh),
-                put(_pad_to(node_zmask, n_max), nodes_sh),
-                put(_pad_to(node_cmask, n_max), nodes_sh),
-                put(_pad_to(node_open, n_max), nodes_sh),
-                put(np.asarray(n_existing, np.int32), rep_sh))
+            event = _dispatch_cache_event(
+                ("mesh", mesh, n_max, k_max, track, zone_ovh,
+                 requests.shape, prior.shape, banned.shape))
+            sp = (TRACER.span("solve.compile" if event == "miss"
+                              else "solve.dispatch", cache=event,
+                              backend="mesh",
+                              note="includes replicated input puts")
+                  if TRACER.enabled else NOOP_SPAN)
+            with sp:
+                packed = _mesh_packed_fn(mesh, n_max, k_max, track,
+                                         zone_ovh)(
+                    dcat.alloc, dcat.price, dcat.avail,
+                    put(requests, rep_sh), put(counts, rep_sh),
+                    put(compat, rep_sh), put(allow_zone, rep_sh),
+                    put(allow_cap, rep_sh), put(max_per_node, rep_sh),
+                    put(prior, gn_sh if has_prior else rep_sh),
+                    put(banned, gn_sh if has_banned else rep_sh),
+                    put(conflict_np, rep_sh),
+                    zovh if zone_ovh else put(np.asarray(zovh), rep_sh),
+                    put(_pad_to(node_type, n_max), nodes_sh),
+                    put(_pad_to(node_cum, n_max), nodes_sh),
+                    put(_pad_to(node_zmask, n_max), nodes_sh),
+                    put(_pad_to(node_cmask, n_max), nodes_sh),
+                    put(_pad_to(node_open, n_max), nodes_sh),
+                    put(np.asarray(n_existing, np.int32), rep_sh))
         else:
-            nbuf = (None if n_existing == 0 else
-                    _put(_pack_nodes(_pad_to(node_type, n_max),
-                                     _pad_to(node_cum, n_max),
-                                     _pad_to(node_zmask, n_max),
-                                     _pad_to(node_cmask, n_max),
-                                     _pad_to(node_open, n_max), list(cols))))
-            packed = _solve_onebuf(
-                dcat.alloc, dcat.price, dcat.avail, gbuf_dev,
-                _put(prior) if has_prior else None,
-                _put(banned) if has_banned else None,
-                conflict_dev, dcat.ovh_z if zone_ovh else None, nbuf,
-                n_max=n_max, k_max=k_max, cols=cols,
-                track_conflicts=track, zone_ovh=zone_ovh)
-        buf = _read(packed)  # ONE host read
+            sp = (TRACER.span("solve.device_put") if TRACER.enabled
+                  else NOOP_SPAN)
+            with sp:
+                b0 = transfer_bytes()[0]
+                nbuf = (None if n_existing == 0 else
+                        _put(_pack_nodes(_pad_to(node_type, n_max),
+                                         _pad_to(node_cum, n_max),
+                                         _pad_to(node_zmask, n_max),
+                                         _pad_to(node_cmask, n_max),
+                                         _pad_to(node_open, n_max),
+                                         list(cols))))
+                prior_dev = _put(prior) if has_prior else None
+                banned_dev = _put(banned) if has_banned else None
+                sp.set(h2d_bytes=transfer_bytes()[0] - b0,
+                       resumed_nodes=n_existing)
+            event = _dispatch_cache_event(
+                ("onebuf", dcat.alloc.shape, dcat.price.shape,
+                 tuple(gbuf_dev.shape),
+                 None if prior_dev is None else tuple(prior_dev.shape),
+                 None if banned_dev is None else tuple(banned_dev.shape),
+                 nbuf is None, zone_ovh, track, n_max, k_max, cols))
+            sp = (TRACER.span("solve.compile" if event == "miss"
+                              else "solve.dispatch", cache=event,
+                              backend="device", n_max=n_max, k_max=k_max)
+                  if TRACER.enabled else NOOP_SPAN)
+            with sp:
+                packed = _solve_onebuf(
+                    dcat.alloc, dcat.price, dcat.avail, gbuf_dev,
+                    prior_dev, banned_dev,
+                    conflict_dev, dcat.ovh_z if zone_ovh else None, nbuf,
+                    n_max=n_max, k_max=k_max, cols=cols,
+                    track_conflicts=track, zone_ovh=zone_ovh)
+        sp = (TRACER.span("solve.readback") if TRACER.enabled
+              else NOOP_SPAN)
+        with sp:
+            buf = _read(packed)  # ONE host read
+            sp.set(d2h_bytes=int(buf.nbytes), shape=str(tuple(buf.shape)))
         nused, overflowed, nnz = int(buf[0]), bool(buf[1]), int(buf[2])
         o = 3
         unsched = buf[o: o + Gp]; o += Gp
@@ -794,64 +920,68 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
             n_max = -(-n_max // ms) * ms
         k_max = _bucket(2 * n_max)
 
-    # --- host-side reconstruction (vectorized, no device reads) ---
-    # pods_by_group keys refer to THIS enc's group indices; existing nodes'
-    # prior occupancy is baked into their input cum, so their dict reports
-    # only placements from this solve (same convention as solve_host).
-    n_total = min(nused, n_max)
-    take_g = idx[:nnz] // n_max
-    take_n = idx[:nnz] % n_max
-    take_v = vals[:nnz]
+    sp = (TRACER.span("solve.decode") if TRACER.enabled
+          else NOOP_SPAN)
+    with sp:
+        # --- host-side reconstruction (vectorized, no device reads) ---
+        # pods_by_group keys refer to THIS enc's group indices; existing nodes'
+        # prior occupancy is baked into their input cum, so their dict reports
+        # only placements from this solve (same convention as solve_host).
+        n_total = min(nused, n_max)
+        take_g = idx[:nnz] // n_max
+        take_n = idx[:nnz] % n_max
+        take_v = vals[:nnz]
 
-    # cum: accumulate in ascending group order with the same f32 ops as the
-    # kernel so golden tests agree bitwise
-    cum = np.zeros((n_total, R), np.float32)
-    cum[:n_existing] = node_cum[:n_existing]
-    zmask = np.ones((n_total, cat.Z), bool)
-    cmask = np.ones((n_total, cat.C), bool)
-    zmask[:n_existing] = node_zmask[:n_existing]
-    cmask[:n_existing] = node_cmask[:n_existing]
-    fresh = np.ones(n_total, bool)
-    fresh[:n_existing] = False
-    t_avail_z = cat.available.any(axis=2)  # [T, Z]
-    t_avail_c = cat.available.any(axis=1)  # [T, C]
-    nt = ntype[:n_total]
-    zmask[fresh] = t_avail_z[nt[fresh]]
-    cmask[fresh] = t_avail_c[nt[fresh]]
+        # cum: accumulate in ascending group order with the same f32 ops as the
+        # kernel so golden tests agree bitwise
+        cum = np.zeros((n_total, R), np.float32)
+        cum[:n_existing] = node_cum[:n_existing]
+        zmask = np.ones((n_total, cat.Z), bool)
+        cmask = np.ones((n_total, cat.C), bool)
+        zmask[:n_existing] = node_zmask[:n_existing]
+        cmask[:n_existing] = node_cmask[:n_existing]
+        fresh = np.ones(n_total, bool)
+        fresh[:n_existing] = False
+        t_avail_z = cat.available.any(axis=2)  # [T, Z]
+        t_avail_c = cat.available.any(axis=1)  # [T, C]
+        nt = ntype[:n_total]
+        zmask[fresh] = t_avail_z[nt[fresh]]
+        cmask[fresh] = t_avail_c[nt[fresh]]
 
-    # per-group vectorized accumulation in ascending group order — the same
-    # f32 add sequence per node as the kernel's scan, so values agree bitwise
-    pods_by_node: List[dict] = [dict() for _ in range(n_total)]
-    in_range = take_n < n_total
-    for g in range(G):
-        sel = (take_g == g) & in_range
-        if not sel.any():
-            continue
-        ns = take_n[sel]
-        vs = take_v[sel]
-        cum[ns] = cum[ns] + vs[:, None].astype(np.float32) * enc.requests[g][None, :].astype(np.float32)
-        zmask[ns] &= enc.allow_zone[g]
-        cmask[ns] &= enc.allow_cap[g]
-        for n, v in zip(ns.tolist(), vs.tolist()):
-            pods_by_node[n][g] = v
+        # per-group vectorized accumulation in ascending group order — the same
+        # f32 add sequence per node as the kernel's scan, so values agree bitwise
+        pods_by_node: List[dict] = [dict() for _ in range(n_total)]
+        in_range = take_n < n_total
+        for g in range(G):
+            sel = (take_g == g) & in_range
+            if not sel.any():
+                continue
+            ns = take_n[sel]
+            vs = take_v[sel]
+            cum[ns] = cum[ns] + vs[:, None].astype(np.float32) * enc.requests[g][None, :].astype(np.float32)
+            zmask[ns] &= enc.allow_zone[g]
+            cmask[ns] &= enc.allow_cap[g]
+            for n, v in zip(ns.tolist(), vs.tolist()):
+                pods_by_node[n][g] = v
 
-    nodes: List[VirtualNode] = []
-    for i in range(n_total):
-        nodes.append(VirtualNode(
-            type_idx=int(nt[i]), zone_mask=zmask[i], cap_mask=cmask[i],
-            cum=cum[i], pods_by_group=pods_by_node[i],
-            banned_groups=existing[i].banned_groups if i < n_existing else None,
-            existing_name=existing[i].existing_name if i < n_existing else None))
+        nodes: List[VirtualNode] = []
+        for i in range(n_total):
+            nodes.append(VirtualNode(
+                type_idx=int(nt[i]), zone_mask=zmask[i], cap_mask=cmask[i],
+                cum=cum[i], pods_by_group=pods_by_node[i],
+                banned_groups=existing[i].banned_groups if i < n_existing else None,
+                existing_name=existing[i].existing_name if i < n_existing else None))
 
-    unschedulable = {g: int(unsched[g]) for g in range(G) if unsched[g] > 0}
-    result = SolveResult(nodes=nodes, unschedulable=unschedulable)
-    # launch decisions straight from the dense arrays already in hand —
-    # finalize_offerings would re-stack per-node masks from the objects
-    # (several ms at 2k+ nodes, pure Python attribute traffic); the
-    # policy itself is the shared cheapest_offerings
-    fi = np.nonzero(fresh)[0]
-    if fi.size:
-        from .binpack import cheapest_offerings
-        result.launches = cheapest_offerings(nt[fi], zmask[fi], cmask[fi],
-                                             cat)
-    return result
+        unschedulable = {g: int(unsched[g]) for g in range(G) if unsched[g] > 0}
+        result = SolveResult(nodes=nodes, unschedulable=unschedulable)
+        # launch decisions straight from the dense arrays already in hand —
+        # finalize_offerings would re-stack per-node masks from the objects
+        # (several ms at 2k+ nodes, pure Python attribute traffic); the
+        # policy itself is the shared cheapest_offerings
+        fi = np.nonzero(fresh)[0]
+        if fi.size:
+            from .binpack import cheapest_offerings
+            result.launches = cheapest_offerings(nt[fi], zmask[fi], cmask[fi],
+                                                 cat)
+        sp.set(nodes=len(nodes), nnz=int(nnz))
+        return result

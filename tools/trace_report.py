@@ -1,0 +1,85 @@
+"""Summarize the slowest spans from a trace JSONL (or a Chrome artifact).
+
+`make trace-report` — reads the traces the tracer appended to
+$KARPENTER_TPU_TRACE_DIR/traces.jsonl (or a path argument, which may also
+be a bench trace_bench.json Chrome artifact) and prints, per span name:
+count, total seconds, and max seconds, slowest-total first — the
+60-second answer to "where did the time go" without opening Perfetto.
+
+Usage:
+    python tools/trace_report.py [path] [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_spans(path: str):
+    """Yield (name, duration_seconds, trace_id) from a tracer JSONL or a
+    Chrome trace-event artifact."""
+    with open(path) as f:
+        first = f.readline()
+        f.seek(0)
+        try:
+            # a tracer JSONL line is itself a complete JSON object with a
+            # "spans" key; a Chrome artifact's first line won't parse
+            # alone (pretty-printed) or parses to a traceEvents document
+            head = json.loads(first)
+            is_jsonl = "spans" in head
+        except json.JSONDecodeError:
+            is_jsonl = False
+        if not is_jsonl:  # Chrome artifact: {"traceEvents": [...]}
+            for ev in json.load(f).get("traceEvents", []):
+                if ev.get("ph") == "X":
+                    yield (ev["name"], ev.get("dur", 0.0) / 1e6,
+                           ev.get("args", {}).get("trace_id", ""))
+            return
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            trace = json.loads(line)
+            for s in trace.get("spans", []):
+                yield s["name"], s.get("duration", 0.0), trace["trace_id"]
+
+
+def report(path: str, top: int = 20) -> str:
+    agg = {}  # name -> [count, total, max, slowest trace_id]
+    for name, dur, tid in load_spans(path):
+        row = agg.setdefault(name, [0, 0.0, 0.0, ""])
+        row[0] += 1
+        row[1] += dur
+        if dur > row[2]:
+            row[2], row[3] = dur, tid
+    if not agg:
+        return f"no spans in {path}"
+    out = [f"trace report: {path}",
+           f"{'span':<28} {'count':>6} {'total_s':>9} {'max_s':>9}  slowest trace",
+           "-" * 76]
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+    for name, (count, total, mx, tid) in ranked:
+        out.append(f"{name:<28} {count:>6} {total:>9.3f} {mx:>9.3f}  {tid}")
+    return "\n".join(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    default = os.path.join(
+        os.environ.get("KARPENTER_TPU_TRACE_DIR", "."), "traces.jsonl")
+    ap.add_argument("path", nargs="?", default=default)
+    ap.add_argument("--top", type=int, default=20)
+    args = ap.parse_args()
+    if not os.path.exists(args.path):
+        print(f"no trace file at {args.path} — set KARPENTER_TPU_TRACE_DIR "
+              "or pass a path (traces.jsonl or trace_bench.json)",
+              file=sys.stderr)
+        raise SystemExit(1)
+    print(report(args.path, args.top))
+
+
+if __name__ == "__main__":
+    main()
