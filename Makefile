@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
-        deflake run native trace-report clean
+        deflake run native trace-report chaos clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -23,6 +23,10 @@ benchmark:  ## one JSON line on the attached TPU (reference: make benchmark)
 
 trace-report:  ## slowest spans from $$KARPENTER_TPU_TRACE_DIR/traces.jsonl (or TRACE=path)
 	$(PY) tools/trace_report.py $(TRACE)
+
+chaos:  ## chaos scenario catalog (incl. slow soaks) + seed-reproducibility check
+	$(PY) -m pytest tests/test_faults.py tests/test_chaos.py -q
+	$(PY) -m karpenter_tpu.faults all --repeat 2
 
 docgen:  ## regenerate docs/reference/* from the live registry + catalog
 	$(PY) tools/gen_docs.py

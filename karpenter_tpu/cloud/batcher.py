@@ -35,7 +35,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..metrics import BATCH_SIZE
+from ..metrics import BATCH_SIZE, DEGRADED_MODE
 from ..obs.tracer import NOOP_SPAN, TRACER
 from .provider import CloudError
 
@@ -49,18 +49,29 @@ class BatchingCloud:
 
     def __init__(self, inner, clock, idle: float = DEFAULT_IDLE,
                  max_window: float = DEFAULT_MAX,
-                 max_items: int = DEFAULT_MAX_ITEMS):
+                 max_items: int = DEFAULT_MAX_ITEMS,
+                 rng: Optional[object] = None):
         self.inner = inner
         self.clock = clock
         self.idle = idle
         self.max_window = max_window
         self.max_items = max_items
+        # full-jitter source: N batchers doubling a deterministic backoff
+        # retry in LOCKSTEP and re-hammer the throttled cloud together;
+        # uniform(0, backoff) desynchronizes them (AWS full-jitter). The
+        # default is entropy-seeded — a fixed default seed would put every
+        # replica back in lockstep, the exact failure mode jitter exists
+        # to prevent. Determinism is opt-in: tests (and any harness that
+        # needs a replayable run, e.g. one driven by a faults.FaultPlan)
+        # pass a seeded Random.
+        import random
+        self._rng = rng if rng is not None else random.Random()
         self._pending: List[str] = []      # terminate ids, insertion order
         self._pending_set: set = set()
         self._first_at = 0.0
         self._last_add = 0.0
         self._retry_after = 0.0            # throttle backoff gate
-        self._backoff = 0.0
+        self._backoff = 0.0                # current exponential ceiling
         # describe read-coalescing: filter-key -> result within one window
         from ..utils.cache import TTLCache
         self._describe_cache = TTLCache(idle, clock)
@@ -95,66 +106,106 @@ class BatchingCloud:
                 or now - self._first_at >= self.max_window):
             self._flush_terminations()
 
+    def _note_throttle(self, err: Optional[CloudError] = None) -> None:
+        """Raise the retry gate. The exponential CEILING doubles
+        deterministically (idle..30s); the actual delay is full-jitter —
+        uniform(0, ceiling) — so N batchers that throttled together don't
+        retry in lockstep and re-trigger the very throttling they hit.
+        The draw is floored at a tenth of the ceiling: a ~0 draw would
+        leave the gate at `now`, and _flush_per_id's requeue relies on a
+        genuinely-raised gate to stop terminate()'s max_items check from
+        re-flushing in the same pass. A server-provided Retry-After hint
+        (HTTP 429, cloud/remote.py) floors it higher still: the server
+        knows its own recovery time better than our local guess."""
+        now = self.clock.now()
+        self._backoff = min(max(self._backoff * 2, self.idle), 30.0)
+        delay = max(self._rng.uniform(0.0, self._backoff),
+                    0.1 * self._backoff)
+        hint = getattr(err, "retry_after", None)
+        if hint:
+            delay = max(delay, float(hint))
+        self._retry_after = max(self._retry_after, now + delay)
+        DEGRADED_MODE.set(1, component="cloud-api")
+
+    def _clear_backoff(self) -> None:
+        if self._backoff or self._retry_after:
+            DEGRADED_MODE.set(0, component="cloud-api")
+        self._backoff = 0.0
+        self._retry_after = 0.0
+
     def _flush_terminations(self) -> None:
         batch, self._pending = self._pending, []
         self._pending_set = set()
-        sp = (TRACER.span("cloud.terminate", batch=len(batch))
-              if TRACER.enabled else NOOP_SPAN)
+        touched = False  # anything reached the wire (reads must resync)
         try:
-            with sp:
-                self.inner.terminate(batch)  # ONE wire call, N controllers
-        except CloudError as e:
-            self.stats["terminate_errors"] += 1
-            if getattr(e, "retryable", False):
-                # keep the batch for a later window — the callers that
-                # fired these already moved on, the flusher owns the retry
-                self._pending = batch
-                self._pending_set = set(batch)
-                now = self.clock.now()
-                self._first_at = self._last_add = now
-                self._backoff = min(max(self._backoff * 2, self.idle), 30.0)
-                self._retry_after = now + self._backoff
-                return
-            # non-retryable batch error: one bad id must not poison (and
-            # silently drop) the rest — fall back to per-id calls, letting
-            # individually-bad ids fail alone; per-id RETRYABLE failures
-            # go back in the pending set for the next window (the GC sweep
-            # remains the final backstop for anything that still leaks)
-            requeued = False
-            for n, iid in enumerate(batch):
+            # a batch can exceed the wire cap when items accrued behind a
+            # closed backoff gate — ship it in max_items chunks so the cap
+            # is a real wire invariant and nothing enqueued during the
+            # backoff is starved past it once the gate opens
+            for lo in range(0, len(batch), self.max_items):
+                chunk = batch[lo:lo + self.max_items]
+                sp = (TRACER.span("cloud.terminate", batch=len(chunk))
+                      if TRACER.enabled else NOOP_SPAN)
                 try:
-                    self.inner.terminate([iid])
-                except CloudError as pe:
+                    with sp:
+                        self.inner.terminate(chunk)  # ONE wire call
+                except CloudError as e:
                     self.stats["terminate_errors"] += 1
-                    if getattr(pe, "retryable", False):
-                        # raise the gate BEFORE requeueing: a full-size
-                        # remainder would otherwise trip terminate()'s
-                        # max_items immediate-flush check against the
-                        # still-cleared gate and re-hit the throttling
-                        # cloud in the same tick; wiping the gate after
-                        # would re-flush every half-idle tick — both are
-                        # the amplification the backoff exists to prevent
+                    if getattr(e, "retryable", False):
+                        # keep the failed chunk AND the untouched remainder
+                        # for a later window — the callers that fired these
+                        # already moved on, the flusher owns the retry. A
+                        # partial-batch success resets nothing: chunks sent
+                        # before this failure stay sent, the backoff grows
+                        # from the failure, and only a fully-flushed batch
+                        # clears it.
+                        self._pending = batch[lo:]
+                        self._pending_set = set(self._pending)
                         now = self.clock.now()
-                        self._backoff = min(
-                            max(self._backoff * 2, self.idle), 30.0)
-                        self._retry_after = max(self._retry_after,
-                                                now + self._backoff)
-                        self.terminate(batch[n:])  # requeue the remainder
-                        requeued = True
-                        break
-            if not requeued:
-                self._backoff = 0.0
-                self._retry_after = 0.0
-            self._describe_cache.flush()
-            return
-        self._backoff = 0.0
-        self._retry_after = 0.0
-        BATCH_SIZE.observe(float(len(batch)), op="terminate")
-        self.stats["terminate_batches"] += 1
-        self.stats["terminate_items"] += len(batch)
-        self.stats["largest_batch"] = max(self.stats["largest_batch"],
-                                          len(batch))
-        self._describe_cache.flush()  # reads must see the writes
+                        self._first_at = self._last_add = now
+                        self._note_throttle(e)
+                        return
+                    touched = True
+                    if self._flush_per_id(chunk,
+                                          batch[lo + self.max_items:]):
+                        return  # per-id retry raised the gate and requeued
+                    continue  # chunk drained id-by-id; keep flushing
+                touched = True
+                BATCH_SIZE.observe(float(len(chunk)), op="terminate")
+                self.stats["terminate_batches"] += 1
+                self.stats["terminate_items"] += len(chunk)
+                self.stats["largest_batch"] = max(self.stats["largest_batch"],
+                                                  len(chunk))
+            self._clear_backoff()
+        finally:
+            if touched:
+                self._describe_cache.flush()  # reads must see the writes
+
+    def _flush_per_id(self, chunk: List[str], rest: List[str]) -> bool:
+        """Non-retryable chunk error: one bad id must not poison (and
+        silently drop) the rest — fall back to per-id calls, letting
+        individually-bad ids fail alone; a per-id RETRYABLE failure
+        requeues the chunk remainder plus every unsent later chunk behind
+        a raised gate (the GC sweep remains the final backstop for
+        anything that still leaks). Returns True when it requeued — the
+        caller must stop flushing."""
+        for n, iid in enumerate(chunk):
+            try:
+                self.inner.terminate([iid])
+            except CloudError as pe:
+                self.stats["terminate_errors"] += 1
+                if getattr(pe, "retryable", False):
+                    # raise the gate BEFORE requeueing: a full-size
+                    # remainder would otherwise trip terminate()'s
+                    # max_items immediate-flush check against the
+                    # still-cleared gate and re-hit the throttling
+                    # cloud in the same tick; wiping the gate after
+                    # would re-flush every half-idle tick — both are
+                    # the amplification the backoff exists to prevent
+                    self._note_throttle(pe)
+                    self.terminate(chunk[n:] + rest)  # requeue remainder
+                    return True
+        return False
 
     # --- describe: windowed read coalescing ---
     def describe(self, instance_ids: Optional[List[str]] = None) -> list:

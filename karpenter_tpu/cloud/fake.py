@@ -57,6 +57,11 @@ class TokenBucket:
             return True
         return False
 
+    def retry_after(self, n: int = 1) -> float:
+        """Seconds until `n` tokens will be available — the server-side
+        Retry-After hint a throttled call carries back to the client."""
+        return max(0.0, (n - self.tokens) / self.rate)
+
 
 @dataclass
 class FakeCloudConfig:
@@ -122,6 +127,9 @@ class FakeCloud:
         self.network_groups: Dict[str, NetworkGroup] = {
             g.id: g for g in default_network_groups()}
         self.profiles: Dict[str, NodeProfile] = {}
+        # armed fault-injection plan (faults/plan.FaultPlan) or None; the
+        # only hook on the launch path is one None-check per override row
+        self.fault_plan = None
 
     # --- capacity pool control (tests / chaos) ---
     def set_capacity(self, instance_type: str, zone: str, capacity_type: str,
@@ -144,7 +152,8 @@ class FakeCloud:
     def create_fleet(self, requests: List[LaunchRequest]) -> List["Instance | CloudError"]:
         self.api_calls["create_fleet"] += 1
         if not self._bucket.allow():
-            raise RateLimitedError("CreateFleet throttled")
+            raise RateLimitedError("CreateFleet throttled",
+                                   retry_after=self._bucket.retry_after())
         out: List["Instance | CloudError"] = []
         for req in requests:
             out.append(self._launch_one(req))
@@ -171,6 +180,13 @@ class FakeCloud:
         for ov in req.overrides:
             key = (ov.instance_type, ov.zone, ov.capacity_type)
             if ov.instance_type not in self.types:
+                continue
+            if (self.fault_plan is not None
+                    and self.fault_plan.ice_active(
+                        ov.instance_type, ov.zone, ov.capacity_type,
+                        self.clock.now())):
+                # injected ICE window: the pool behaves exhausted
+                exhausted.append(key)
                 continue
             if ov.capacity_type in self.captype_outages:
                 outage_types.add(ov.capacity_type)
@@ -217,7 +233,9 @@ class FakeCloud:
     def terminate(self, instance_ids: List[str]) -> None:
         self.api_calls["terminate"] += 1
         if not self._terminate_bucket.allow():
-            raise RateLimitedError("TerminateInstances throttled")
+            raise RateLimitedError(
+                "TerminateInstances throttled",
+                retry_after=self._terminate_bucket.retry_after())
         for iid in instance_ids:
             inst = self.instances.get(iid)
             if inst and inst.state != "terminated":
@@ -279,7 +297,9 @@ class FakeCloud:
     def describe(self, instance_ids: Optional[List[str]] = None) -> List[Instance]:
         self.api_calls["describe"] += 1
         if not self._describe_bucket.allow():
-            raise RateLimitedError("DescribeInstances throttled")
+            raise RateLimitedError(
+                "DescribeInstances throttled",
+                retry_after=self._describe_bucket.retry_after())
         if instance_ids is None:
             return [i for i in self.instances.values() if i.state != "terminated"]
         return [self.instances[i] for i in instance_ids if i in self.instances]

@@ -98,7 +98,16 @@ class AlreadyExistsError(CloudError):
 
 
 class RateLimitedError(CloudError):
+    """Throttled. `retry_after` is the server's own hint, in seconds (the
+    HTTP 429 Retry-After header; None when the server sent none) — the
+    batcher's gate honors it over the purely local exponential backoff."""
+
     retryable = True
+
+    def __init__(self, msg: str = "throttled",
+                 retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 class ServerError(CloudError):

@@ -129,8 +129,9 @@ def decode(obj):
 
 def encode_error(e: CloudError) -> dict:
     env: dict = {"type": type(e).__name__, "msg": str(e)}
-    for attr in ("offerings", "zones", "capacity_types", "reservation_id"):
-        if hasattr(e, attr):
+    for attr in ("offerings", "zones", "capacity_types", "reservation_id",
+                 "retry_after"):
+        if getattr(e, attr, None) is not None:
             env[attr] = encode(getattr(e, attr))
     return env
 
@@ -155,6 +156,10 @@ def decode_error(env: dict) -> CloudError:
             decode(env.get("capacity_types", [])))
     if cls is ReservationExceededError:
         return ReservationExceededError(env.get("reservation_id", ""))
+    if cls is RateLimitedError:
+        ra = env.get("retry_after")
+        return RateLimitedError(env.get("msg", "throttled"),
+                                retry_after=float(ra) if ra else None)
     return cls(env.get("msg", ""))
 
 
@@ -203,11 +208,14 @@ def make_server(cloud, host: str = "127.0.0.1", port: int = 0,
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, status: int, payload: dict) -> None:
+        def _send(self, status: int, payload: dict,
+                  headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -259,7 +267,21 @@ def make_server(cloud, host: str = "127.0.0.1", port: int = 0,
                     result = encode(getattr(cloud, method)(*args))
                 self._send(200, {"result": result})
             except CloudError as e:
-                self._send(_http_status(e), {"error": encode_error(e)})
+                # a throttled backend's recovery hint travels as the
+                # standard HTTP 429 Retry-After header (and in the error
+                # envelope) so ANY client — ours or a plain HTTP one —
+                # can pace its retries off the server's own estimate.
+                # RFC 7231 delta-seconds is an INTEGER: the header ships
+                # ceil(hint) for conformant third-party parsers, while
+                # the JSON envelope keeps the exact float for our client
+                headers = None
+                ra = getattr(e, "retry_after", None)
+                if ra is not None:
+                    import math
+                    headers = {"Retry-After":
+                               str(int(math.ceil(max(0.0, float(ra)))))}
+                self._send(_http_status(e), {"error": encode_error(e)},
+                           headers)
             except Exception as e:  # noqa: BLE001 — the boundary
                 self._send(500, {"error": {"type": "ServerError",
                                            "msg": f"{type(e).__name__}: {e}"}})
@@ -309,6 +331,7 @@ class RemoteCloud:
                 resp = conn.getresponse()
                 payload = resp.read()
                 status = resp.status
+                retry_hdr = resp.getheader("Retry-After")
             finally:
                 conn.close()
         except socket.timeout as e:
@@ -322,7 +345,18 @@ class RemoteCloud:
         except json.JSONDecodeError:
             obj = {}
         if status == 429:
-            raise RateLimitedError(obj.get("error", {}).get("msg", "throttled"))
+            # server-provided recovery hint: our error envelope carries
+            # the exact float, the (integer, RFC 7231) Retry-After header
+            # is the fallback for 429s minted by proxies — either way it
+            # rides the exception into the batcher's gate
+            ra = obj.get("error", {}).get("retry_after") or retry_hdr
+            try:
+                ra = float(ra) if ra is not None else None
+            except (TypeError, ValueError):
+                ra = None
+            raise RateLimitedError(
+                obj.get("error", {}).get("msg", "throttled"),
+                retry_after=ra)
         if "error" in obj:
             raise decode_error(obj["error"])
         if status != 200:

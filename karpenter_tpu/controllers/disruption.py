@@ -473,8 +473,16 @@ class DisruptionController:
                 screen, _slack = consolidation_screen(
                     cat, enc, views, counts,
                     mesh=self.solver.screen_mesh(len(views)))
-        except Exception:
-            return candidates  # screen is best-effort; fall back to cost order
+        except Exception as e:  # noqa: BLE001 — screen is best-effort:
+            # a device fault here degrades to plain cost order; meter it
+            # like the facade's solve fallback so the event is scrapeable
+            # (the span already carries outcome=error from its exit)
+            from ..metrics import SOLVER_FALLBACKS
+            SOLVER_FALLBACKS.inc(from_backend="screen",
+                                 to_backend="cost-order")
+            self.stats["screen_errors"] = (
+                self.stats.get("screen_errors", 0) + 1)
+            return candidates
         ok = {v.name for i, v in enumerate(views) if screen[i]}
         first = [v for v in candidates if v.name in ok]
         rest = [v for v in candidates if v.name not in ok]

@@ -1,0 +1,22 @@
+"""Deterministic fault injection + chaos scenarios.
+
+The failure-weather harness the reconcile loop is proven against: a seeded
+`FaultPlan` (declarative rules + one RNG) drives injection hooks threaded
+through the fake cloud (ICE windows), the CloudProvider seam (throttles /
+server errors), the sim clock (skew jumps), and the solver's device
+dispatch (TPU loss mid-solve); a `ScenarioRunner` executes named chaos
+scenarios on `sim.make_sim` and asserts end-of-run invariants — every pod
+scheduled, no leaked NodeClaims, store/cloud consistency, and an identical
+end-state hash for identical seeds. See docs/robustness.md.
+"""
+
+from .plan import (ApiFault, ClockJump, DeviceFault, FaultPlan, IceWindow,
+                   InjectedFault, InterruptionBurst)
+from .runner import ScenarioReport, ScenarioRunner, check_invariants, state_hash
+from .scenarios import SCENARIOS, Scenario, get_scenario
+
+__all__ = [
+    "FaultPlan", "IceWindow", "ApiFault", "ClockJump", "DeviceFault",
+    "InterruptionBurst", "InjectedFault", "ScenarioRunner", "ScenarioReport",
+    "check_invariants", "state_hash", "SCENARIOS", "Scenario", "get_scenario",
+]

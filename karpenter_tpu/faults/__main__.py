@@ -1,0 +1,66 @@
+"""Chaos CLI: run catalog scenarios and print their reports.
+
+    python -m karpenter_tpu.faults                  # list the catalog
+    python -m karpenter_tpu.faults smoke            # one scenario
+    python -m karpenter_tpu.faults all              # whole catalog
+    python -m karpenter_tpu.faults ice_storm --seed 7 --repeat 2
+
+--repeat N re-runs the same (scenario, seed) and fails unless every run
+produced the identical end-state hash and fault-timeline fingerprint —
+the from-a-seed reproduction check docs/robustness.md describes.
+Exit status is non-zero when any run fails its invariants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from .runner import ScenarioRunner
+    from .scenarios import SCENARIOS
+
+    ap = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu.faults",
+        description="run chaos scenarios from the catalog")
+    ap.add_argument("scenario", nargs="?", default="",
+                    help="scenario name, or 'all' (empty: list catalog)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--repeat", type=int, default=1,
+                    help="re-run and require identical hashes")
+    ap.add_argument("--skip-slow", action="store_true",
+                    help="with 'all': skip soak scenarios")
+    args = ap.parse_args(argv)
+
+    if not args.scenario:
+        for sc in SCENARIOS.values():
+            tag = " [slow]" if sc.slow else ""
+            print(f"{sc.name}{tag}: {sc.description}")
+        return 0
+
+    names = (sorted(SCENARIOS) if args.scenario == "all"
+             else [args.scenario])
+    if args.scenario == "all" and args.skip_slow:
+        names = [n for n in names if not SCENARIOS[n].slow]
+
+    failed = False
+    for name in names:
+        reports = [ScenarioRunner(name, seed=args.seed).run()
+                   for _ in range(max(1, args.repeat))]
+        for rep in reports:
+            print(rep.summary())
+            failed |= not rep.ok
+        if args.repeat > 1:
+            hashes = {(r.end_hash, r.fault_fingerprint) for r in reports}
+            if len(hashes) != 1:
+                print(f"[FAIL] {name}: {args.repeat} runs at seed "
+                      f"{args.seed} diverged: {sorted(hashes)}")
+                failed = True
+            else:
+                print(f"  reproducible: {args.repeat} runs identical")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""Injection adapters: thread an armed FaultPlan through the live seams.
+
+Three adapters, one per seam the plan cannot reach directly:
+
+- `FaultyCloud` wraps any CloudProvider (the same decorator position as
+  cloud/metering.MeteredCloud and cloud/batcher.BatchingCloud) and
+  consults the plan before forwarding each intercepted API method —
+  injected throttles/server errors surface as the exact taxonomy classes
+  the controllers, batcher, and engine already branch on, so the
+  degradation paths under test are the production ones.
+- `install_bursts` registers an engine hook that drains the plan's
+  InterruptionBursts into the fake cloud's event queue (spot warnings,
+  outright kills, rebalance recommendations), choosing victims with the
+  plan RNG over the creation-ordered instance list.
+- `device_fault_hook` arms/disarms ops.solver's module-level dispatch
+  hook (a context manager, so a crashed scenario can't leave the process
+  solver faulted).
+
+ICE windows and clock jumps need no adapter here: FakeCloud._launch_one
+and FakeClock.now() consult the plan/jump list directly (nil-guarded —
+see those modules).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from .plan import FaultPlan
+
+# CloudProvider methods the wrapper gates — the ONE list the interception
+# is generated from; everything else passes through untouched. Extend it
+# (profiles, images, network groups) and matching ApiFault rules start
+# firing with no further wiring.
+INTERCEPTED = ("create_fleet", "terminate", "describe", "describe_nodes",
+               "describe_types", "poll_interruptions")
+
+
+class FaultyCloud:
+    """CloudProvider decorator raising plan-driven API faults. Method
+    interception is generated from INTERCEPTED in __getattr__, so the
+    gated surface cannot drift from the advertised list."""
+
+    def __init__(self, inner, plan: FaultPlan, clock=None):
+        self.inner = inner
+        self.plan = plan
+        self.clock = clock if clock is not None else inner.clock
+
+    def _gate(self, method: str) -> None:
+        err = self.plan.api_fault(method, self.clock.now())
+        if err is not None:
+            raise err
+
+    def __getattr__(self, name: str):
+        attr = getattr(self.inner, name)
+        if name in INTERCEPTED:
+            def gated(*args, **kwargs):
+                self._gate(name)
+                return attr(*args, **kwargs)
+            return gated
+        return attr
+
+
+def install_bursts(engine, cloud, plan: FaultPlan, store=None) -> None:
+    """Engine hook delivering the plan's InterruptionBursts into `cloud`
+    (a FakeCloud). Victim selection is deterministic: running instances in
+    creation order (insertion order of the instance map), filtered by the
+    burst's target_pods pod-name prefixes (resolved via `store` when
+    given), sampled with the plan RNG."""
+    if not plan._bursts:
+        return
+
+    def victims(burst):
+        running = [i for i in cloud.instances.values()
+                   if i.state == "running"]
+        if burst.target_pods is not None and store is not None:
+            node_names = {f"node-{i.id}" for i in running}
+            wanted = set()
+            for p in store.pods.values():
+                if (p.node_name in node_names
+                        and any(p.name.startswith(pre)
+                                for pre in burst.target_pods)):
+                    wanted.add(p.node_name)
+            running = [i for i in running if f"node-{i.id}" in wanted]
+        n = min(burst.count, len(running))
+        return plan.rng.sample(running, n) if n else []
+
+    def hook(now: float) -> None:
+        for burst in plan.due_bursts(now):
+            for inst in victims(burst):
+                detail = f"{burst.kind}:{inst.instance_type}/{inst.zone}"
+                plan.record(now, "interruption", detail)
+                if burst.kind == "kill":
+                    cloud.kill_instance(inst.id, reason="fault-plan")
+                elif burst.kind == "rebalance":
+                    cloud.send_rebalance_recommendation(inst.id)
+                else:
+                    cloud.send_spot_interruption(inst.id)
+
+    engine.add_hook(hook)
+
+
+@contextlib.contextmanager
+def device_fault_hook(plan: Optional[FaultPlan]):
+    """Arm ops.solver's dispatch hook for the plan's DeviceFault rules;
+    always disarms on exit so the process-global seam can't leak between
+    scenarios."""
+    from ..ops import solver as solver_mod
+    if plan is None or not plan.has_device_faults:
+        yield
+        return
+    solver_mod.set_dispatch_fault_hook(plan.on_dispatch)
+    try:
+        yield
+    finally:
+        solver_mod.set_dispatch_fault_hook(None)

@@ -1,0 +1,229 @@
+"""FaultPlan: seeded, declarative, replayable fault injection.
+
+One plan = one seed + a list of rules; every probabilistic decision draws
+from the plan's single `random.Random(seed)`, and every injected fault is
+appended to `timeline` as a CANONICAL entry (no instance ids, no claim
+names — those carry process-global counters and would differ between two
+runs in one process). Same seed + same rules + same sim ⇒ byte-identical
+timeline and fingerprint; that is the reproducibility contract the chaos
+tests assert.
+
+The hooks the plan drives are all nil-guarded at their call sites
+(`FakeCloud.fault_plan`, `ops.solver._dispatch_fault_hook`,
+`FakeClock._jumps`), so an un-armed production process pays one attribute
+check per seam — the zero-overhead-when-disabled requirement.
+
+Every injection also lands on the observability layers: the
+`karpenter_tpu_faults_injected_total{kind=...}` counter, and — when the
+process tracer is on — a zero-width `fault.<kind>` child span inside
+whatever trace is active (an engine tick, a runtime reconcile), so
+/debug/traces attributes reconcile latency spikes to the faults that
+caused them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import random
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Raised by device-dispatch injection — models the TPU backend dying
+    mid-solve (tunnel drop, device reset). The solver facade's degraded
+    path catches it (like any backend exception) and re-runs the solve on
+    native/host."""
+
+
+@dataclass(frozen=True)
+class IceWindow:
+    """Offerings matching the selectors have no capacity during [t0, t1)
+    of SIM time. None selectors match everything, so
+    IceWindow(120, 300, zone="us-east1-b", capacity_type="spot") is the
+    'zone ICEs for spot at t=[120,300)' rule."""
+
+    t0: float
+    t1: float
+    instance_type: Optional[str] = None
+    zone: Optional[str] = None
+    capacity_type: Optional[str] = None
+
+    def matches(self, instance_type: str, zone: str, capacity_type: str,
+                now: float) -> bool:
+        return (self.t0 <= now < self.t1
+                and (self.instance_type is None
+                     or self.instance_type == instance_type)
+                and (self.zone is None or self.zone == zone)
+                and (self.capacity_type is None
+                     or self.capacity_type == capacity_type))
+
+
+@dataclass(frozen=True)
+class ApiFault:
+    """Cloud API calls to `methods` fail with probability `p` during
+    [t0, t1): error="rate_limited" raises a retryable 429 (carrying
+    `retry_after` when set — exercising the server-hint path through the
+    batcher), error="server" a retryable 5xx."""
+
+    methods: Tuple[str, ...]
+    t0: float = 0.0
+    t1: float = math.inf
+    p: float = 1.0
+    error: str = "rate_limited"  # rate_limited | server
+    retry_after: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class ClockJump:
+    """Sim time jumps by `delta` seconds when it first reaches `at`."""
+
+    at: float
+    delta: float
+
+
+@dataclass(frozen=True)
+class DeviceFault:
+    """Device/mesh solve dispatches number [dispatch, dispatch+count)
+    (1-based, counted per plan) raise InjectedFault — the TPU disappearing
+    mid-solve. The facade falls back to native/host and suspends the
+    device backend for a cooldown."""
+
+    dispatch: int = 1
+    count: int = 1
+
+
+@dataclass(frozen=True)
+class InterruptionBurst:
+    """At sim time `at`, `count` running instances receive an interruption:
+    kind="spot" queues a 2-minute spot reclaim warning, kind="kill"
+    terminates the instance outright (state-change event), kind="rebalance"
+    queues a rebalance recommendation. target_pods: only instances whose
+    node hosts a pod with one of these name prefixes qualify (how the
+    interruption-wave scenario aims at a colocated bundle); None = any
+    running instance. Targets are chosen with the plan RNG over the
+    creation-ordered instance list, so the same seed picks the same
+    victims."""
+
+    at: float
+    count: int = 1
+    kind: str = "spot"  # spot | kill | rebalance
+    target_pods: Optional[Tuple[str, ...]] = None
+
+
+class FaultPlan:
+    """Seeded rule engine + fault ledger. Thread a plan through
+    `sim.make_sim(fault_plan=...)` (or wire the hooks by hand) and every
+    seam consults it; `timeline` / `fingerprint()` afterwards describe
+    exactly what was injected and when."""
+
+    def __init__(self, seed: int = 0, rules: Sequence[object] = ()):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.rules = list(rules)
+        self.ice_windows = [r for r in self.rules if isinstance(r, IceWindow)]
+        self.api_faults = [r for r in self.rules if isinstance(r, ApiFault)]
+        self.clock_jumps = sorted(
+            (r for r in self.rules if isinstance(r, ClockJump)),
+            key=lambda r: r.at)
+        self.device_faults = [r for r in self.rules
+                              if isinstance(r, DeviceFault)]
+        self._bursts = sorted(
+            (r for r in self.rules if isinstance(r, InterruptionBurst)),
+            key=lambda r: r.at)
+        self._dispatches = 0
+        # set when the plan is installed (make_sim / injector) so hooks
+        # without a `now` argument (device dispatch) can stamp the ledger
+        self.clock = None
+        # rule times are RELATIVE to the run start; make_sim stamps the
+        # install-time clock reading here so "t=[120,300)" means 120-300
+        # sim-seconds into the run regardless of the clock's epoch
+        self.origin = 0.0
+        # canonical (sim_time, kind, detail) ledger — see module docstring
+        self.timeline: List[Tuple[float, str, str]] = []
+
+    # --- ledger -----------------------------------------------------------
+    def record(self, now: float, kind: str, detail: str) -> None:
+        """`now` is an absolute clock reading; the ledger stores run-
+        relative time so two runs' timelines compare byte-for-byte."""
+        self.timeline.append((round(float(now) - self.origin, 6), kind,
+                              detail))
+        from ..metrics import FAULTS_INJECTED
+        FAULTS_INJECTED.inc(kind=kind)
+        from ..obs.tracer import TRACER
+        if TRACER.enabled:
+            # zero-width child span in whatever trace is live: the fault-
+            # attribution mark /debug/traces shows next to the stage that
+            # absorbed it
+            with TRACER.span(f"fault.{kind}", detail=detail):
+                pass
+
+    def fingerprint(self) -> str:
+        """Digest of the injected-fault timeline — two runs with the same
+        seed must produce the same value (the reproducibility assert)."""
+        h = hashlib.sha256()
+        for t, kind, detail in self.timeline:
+            h.update(f"{t:.6f}|{kind}|{detail}\n".encode())
+        return h.hexdigest()
+
+    # --- hook surfaces ----------------------------------------------------
+    def ice_active(self, instance_type: str, zone: str, capacity_type: str,
+                   now: float) -> bool:
+        """Consulted by FakeCloud._launch_one per override row; a hit makes
+        the pool behave exhausted (ICE) for that row."""
+        rel = now - self.origin
+        for w in self.ice_windows:
+            if w.matches(instance_type, zone, capacity_type, rel):
+                self.record(now, "ice",
+                            f"{instance_type}/{zone}/{capacity_type}")
+                return True
+        return False
+
+    def api_fault(self, method: str, now: float):
+        """Consulted by injector.FaultyCloud before forwarding `method`;
+        returns a CloudError to raise, or None. Draws the RNG once per
+        matching probabilistic rule — call order is deterministic in the
+        sim, so the draw sequence is too."""
+        from ..cloud.provider import RateLimitedError, ServerError
+        rel = now - self.origin
+        for r in self.api_faults:
+            if method not in r.methods or not (r.t0 <= rel < r.t1):
+                continue
+            if r.p < 1.0 and self.rng.random() >= r.p:
+                continue
+            self.record(now, "api", f"{method}:{r.error}")
+            if r.error == "server":
+                return ServerError(f"injected server error on {method}")
+            return RateLimitedError(f"injected throttle on {method}",
+                                    retry_after=r.retry_after)
+        return None
+
+    def on_dispatch(self, backend: str) -> None:
+        """The ops.solver dispatch hook: raises InjectedFault when a
+        DeviceFault rule covers this (1-based) dispatch number."""
+        self._dispatches += 1
+        for r in self.device_faults:
+            if r.dispatch <= self._dispatches < r.dispatch + r.count:
+                now = self.clock.now() if self.clock is not None else 0.0
+                self.record(now, "device",
+                            f"{backend}:dispatch#{self._dispatches}")
+                raise InjectedFault(
+                    f"injected {backend} fault on dispatch "
+                    f"#{self._dispatches}")
+
+    def on_jump(self, new_now: float, delta: float) -> None:
+        """FakeClock.schedule_jump callback — records the applied skew."""
+        self.record(new_now, "clock_jump", f"{delta:+g}s")
+
+    def due_bursts(self, now: float) -> List[InterruptionBurst]:
+        """One-shot: bursts whose time has come, removed from the queue
+        (the injector's engine hook drains this each tick)."""
+        due = []
+        while self._bursts and self._bursts[0].at <= now - self.origin:
+            due.append(self._bursts.pop(0))
+        return due
+
+    @property
+    def has_device_faults(self) -> bool:
+        return bool(self.device_faults)

@@ -1,0 +1,213 @@
+"""ScenarioRunner: execute a chaos scenario and judge the wreckage.
+
+Builds a full SimEnvironment with the scenario's FaultPlan armed, injects
+the workload, drives the engine until the cluster converges (or the sim
+deadline passes), then:
+
+- checks the END-OF-RUN INVARIANTS a correct control plane must restore
+  no matter what weather it flew through: every pod bound, no leaked or
+  stuck NodeClaims, no orphaned cloud instances, store/cloud state
+  consistency;
+- computes a CANONICAL end-state hash (id-free — instance ids and claim
+  names carry process-global counters, so the hash is over types, zones,
+  phases, and pod→node groupings, which ARE stable) plus the plan's fault
+  timeline fingerprint. Two runs with the same seed must agree on both:
+  that pair of digests is the reproducibility contract
+  (`docs/robustness.md` — "reproduce a scenario from its seed").
+
+Convergence is judged on QUIET state: no pending pods, no claims still
+launching or draining, interruption queue drained. The runner keeps
+ticking past the last scheduled fault until that holds.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..models import labels as L
+from .injector import device_fault_hook
+from .plan import FaultPlan
+
+
+def state_hash(sim) -> str:
+    """Canonical digest of the end-of-run cluster state. Deliberately
+    id-free (see module docstring); covers node composition (type, zone,
+    capacity type, readiness, the exact pod set on each node), the claim
+    fleet summary, unbound pods, and live ICE marks."""
+    store = sim.store
+    node_entries = []
+    for node in store.nodes.values():
+        pods = tuple(sorted(p.name for p in store.pods_on_node(node.name)))
+        node_entries.append([
+            node.labels.get(L.INSTANCE_TYPE, ""),
+            node.labels.get(L.ZONE, ""),
+            node.labels.get(L.CAPACITY_TYPE, ""),
+            bool(node.ready), pods])
+    node_entries.sort()
+    claim_entries = sorted(
+        [c.nodepool, c.instance_type or "", c.zone or "",
+         c.capacity_type or "", str(c.phase)]
+        for c in store.nodeclaims.values())
+    unbound = sorted(k for k, p in store.pods.items()
+                     if p.node_name is None)
+    live_instances = sorted(
+        [i.instance_type, i.zone, i.capacity_type, i.state]
+        for i in sim.cloud.instances.values() if i.state != "terminated")
+    payload = json.dumps(
+        {"nodes": node_entries, "claims": claim_entries,
+         "unbound": unbound, "instances": live_instances,
+         "ice_marks": sim.catalog.unavailable.active()},
+        sort_keys=True, default=list)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def check_invariants(sim) -> List[str]:
+    """End-of-run invariants; returns human-readable violations (empty =
+    healthy). These are the properties EVERY catalog scenario must
+    restore after its faults expire."""
+    store, cloud = sim.store, sim.cloud
+    v: List[str] = []
+    unbound = [k for k, p in store.pods.items() if p.node_name is None]
+    if unbound:
+        v.append(f"{len(unbound)} pods never scheduled: "
+                 f"{sorted(unbound)[:5]}...")
+    for p in store.pods.values():
+        if p.node_name is not None and p.node_name not in store.nodes:
+            v.append(f"pod {p.namespace}/{p.name} bound to vanished node "
+                     f"{p.node_name}")
+    live = {iid: inst for iid, inst in cloud.instances.items()
+            if inst.state != "terminated"}
+    claim_iids = set()
+    from ..models.nodeclaim import Phase
+    for c in store.nodeclaims.values():
+        if c.is_deleting():
+            v.append(f"claim {c.name} still draining at end of run")
+        if not c.provider_id:
+            v.append(f"claim {c.name} leaked: never launched "
+                     f"(phase={c.phase})")
+            continue
+        iid = c.provider_id.rsplit("/", 1)[-1]
+        claim_iids.add(iid)
+        if iid not in live:
+            v.append(f"claim {c.name} leaked: instance {iid} gone")
+        elif c.phase != Phase.INITIALIZED:
+            v.append(f"claim {c.name} stuck in phase {c.phase}")
+    # orphaned instances: cloud capacity we pay for with no claim tracking
+    # it (the GC sweep's job to reap)
+    for iid, inst in live.items():
+        if inst.tags.get(L.TAG_NODECLAIM) and iid not in claim_iids:
+            v.append(f"instance {iid} orphaned: karpenter-tagged but no "
+                     f"claim tracks it")
+    # store nodes must mirror live cloud instances
+    for node in store.nodes.values():
+        iid = node.provider_id.rsplit("/", 1)[-1]
+        if iid not in live:
+            v.append(f"store node {node.name} backs a dead instance")
+    if len(cloud.interruptions):
+        v.append(f"{len(cloud.interruptions)} interruption messages never "
+                 f"consumed")
+    return v
+
+
+@dataclass
+class ScenarioReport:
+    scenario: str
+    seed: int
+    converged: bool
+    violations: List[str]
+    end_hash: str
+    fault_fingerprint: str
+    faults_injected: int
+    sim_seconds: float
+    stats: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.violations
+
+    def summary(self) -> str:
+        status = "PASS" if self.ok else "FAIL"
+        lines = [f"[{status}] scenario={self.scenario} seed={self.seed} "
+                 f"faults={self.faults_injected} "
+                 f"sim_seconds={self.sim_seconds:g}",
+                 f"  end_hash={self.end_hash}",
+                 f"  fault_fingerprint={self.fault_fingerprint}"]
+        if not self.converged:
+            lines.append("  DID NOT CONVERGE before the sim deadline")
+        lines += [f"  violation: {x}" for x in self.violations]
+        return "\n".join(lines)
+
+
+class ScenarioRunner:
+    """Run one named scenario (faults/scenarios.py) at a seed."""
+
+    def __init__(self, scenario, seed: int = 0):
+        from .scenarios import Scenario, get_scenario
+        self.scenario = (scenario if isinstance(scenario, Scenario)
+                         else get_scenario(scenario))
+        self.seed = seed
+
+    def build(self):
+        """(sim, plan) with the workload injected and every hook armed
+        except the process-global device seam (run() scopes that)."""
+        from ..sim import make_sim
+        sc = self.scenario
+        plan = FaultPlan(seed=self.seed, rules=sc.build_rules())
+        sim = make_sim(types=sc.types() if sc.types else None,
+                       backend=sc.backend, fault_plan=plan)
+        sc.workload(sim)
+        return sim, plan
+
+    @staticmethod
+    def _fault_horizon(plan: FaultPlan) -> float:
+        """Last run-relative instant a rule can still fire — the run must
+        stay open at least this long, or an early-converging workload
+        would 'pass' a scenario whose weather never arrived."""
+        import math
+        h = 0.0
+        for r in plan.rules:
+            for attr in ("t1", "at"):
+                t = getattr(r, attr, None)
+                if t is not None and not math.isinf(t):
+                    h = max(h, float(t))
+        return h
+
+    def run(self) -> ScenarioReport:
+        sim, plan = self.build()
+        sc = self.scenario
+        t0 = sim.clock.now()
+        horizon = self._fault_horizon(plan)
+
+        def quiet() -> bool:
+            if sim.clock.now() - plan.origin < horizon:
+                return False  # faults still scheduled: keep flying
+            if sim.store.pending_pods():
+                return False
+            from ..models.nodeclaim import Phase
+            for c in sim.store.nodeclaims.values():
+                if c.is_deleting() or c.phase != Phase.INITIALIZED:
+                    return False
+            return not len(sim.cloud.interruptions)
+
+        with device_fault_hook(plan):
+            converged = sim.engine.run_until(quiet, timeout=sc.timeout,
+                                             step=sc.step)
+        report = ScenarioReport(
+            scenario=sc.name, seed=self.seed, converged=converged,
+            violations=check_invariants(sim), end_hash=state_hash(sim),
+            fault_fingerprint=plan.fingerprint(),
+            faults_injected=len(plan.timeline),
+            sim_seconds=sim.clock.now() - t0,
+            stats={"solver_catalog_rebuilds":
+                   sim.solver.stats["catalog_rebuilds"],
+                   "solver_device_fallbacks":
+                   sim.solver.stats["device_fallbacks"],
+                   "ice_marks": sim.catalog.unavailable.stats["marks"],
+                   "provisioner_ice_errors":
+                   sim.provisioner.stats["ice_errors"]})
+        self.last_sim = sim
+        self.last_plan = plan
+        return report

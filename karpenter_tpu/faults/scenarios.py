@@ -1,0 +1,242 @@
+"""Named chaos scenarios — the catalog `make chaos` runs.
+
+Each scenario pairs a workload with a FaultPlan rule set and a sim
+deadline. All rule times are run-relative sim-seconds. Every scenario in
+the catalog must CONVERGE: after its faults expire, the runner's
+invariants (all pods bound, no leaked claims, store/cloud consistency)
+must hold — fault handling is a correctness property of the scheduler
+here (tightly-coupled bundles make a single interrupted node a whole-
+bundle replan), not ops hygiene.
+
+Reproduce any run from its seed:
+
+    python -m karpenter_tpu.faults ice_storm --seed 7
+
+Scenarios marked `slow=True` are long soaks (minutes of sim time) and are
+excluded from tier-1 by the `slow` pytest marker; the `smoke` scenario is
+the short deterministic member that rides in tier-1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from .plan import (ApiFault, ClockJump, DeviceFault, IceWindow,
+                   InterruptionBurst)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    description: str
+    build_rules: Callable[[], List[object]]
+    workload: Callable[[object], None]       # (SimEnvironment) -> None
+    timeout: float = 600.0                   # sim-seconds deadline
+    backend: str = "host"
+    step: float = 0.5
+    slow: bool = False
+    types: Optional[Callable[[], list]] = None  # catalog override
+
+
+# --- workloads -------------------------------------------------------------
+
+
+def _add_pods(sim, n: int, cpu: str = "500m", mem: str = "1Gi",
+              prefix: str = "p", **kw) -> list:
+    from ..models.pod import Pod
+    from ..models.resources import Resources
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def _plain(n: int, **kw):
+    def workload(sim):
+        _add_pods(sim, n, **kw)
+    return workload
+
+
+def _waves(*waves, **podkw):
+    """Staged arrivals: waves of (t, n, prefix) pods, later ones admitted
+    by an engine hook — the weather must hit a cluster that is still
+    PROVISIONING, not one that settled before the first rule fired."""
+    def workload(sim):
+        origin = (sim.fault_plan.origin if sim.fault_plan is not None
+                  else sim.clock.now())
+        fired = set()
+        for t, n, prefix in waves:
+            if t <= 0:
+                fired.add(prefix)
+                _add_pods(sim, n, prefix=prefix, **podkw)
+
+        def arrivals(now: float) -> None:
+            for t, n, prefix in waves:
+                if prefix not in fired and now - origin >= t:
+                    fired.add(prefix)
+                    _add_pods(sim, n, prefix=prefix, **podkw)
+        sim.engine.add_hook(arrivals)
+    return workload
+
+
+def _spot_only_pool(inner):
+    """Wrap a workload: the default pool may only launch spot — the shape
+    that turns an ICE storm into real InsufficientCapacity errors (an
+    unconstrained pool just slides to the on-demand override rows)."""
+    def workload(sim):
+        from ..models import labels as L
+        from ..models.requirements import Operator, Requirement
+        sim.store.nodepools["default"].requirements.add(
+            Requirement(L.CAPACITY_TYPE, Operator.IN, (L.CAPACITY_SPOT,)))
+        inner(sim)
+    return workload
+
+
+def _bundle_workload(plain: int = 20, workers: int = 3):
+    """A tightly-coupled colocated bundle (workers require hostname
+    colocation with their cache — the planner opens ONE bundle node for
+    them) plus background pods. Interrupting the bundle's node must
+    replan the WHOLE bundle atomically."""
+    def workload(sim):
+        from ..models import labels as L
+        from ..models.pod import Pod, PodAffinityTerm
+        from ..models.resources import Resources
+        sim.store.add_pod(Pod(
+            name="bundle-cache-0", labels={"app": "bundle-cache"},
+            requests=Resources.parse({"cpu": "1", "memory": "2Gi"})))
+        for i in range(workers):
+            sim.store.add_pod(Pod(
+                name=f"bundle-w-{i}", labels={"app": "bundle-w"},
+                requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                affinity_terms=[PodAffinityTerm(
+                    topology_key=L.HOSTNAME,
+                    label_selector={"app": "bundle-cache"})]))
+        _add_pods(sim, plain, prefix="bg")
+    return workload
+
+
+# --- catalog ---------------------------------------------------------------
+
+
+SCENARIOS = {}
+
+
+def _register(sc: Scenario) -> Scenario:
+    SCENARIOS[sc.name] = sc
+    return sc
+
+
+_register(Scenario(
+    name="smoke",
+    description="Short deterministic tier-1 member: a spot ICE window, a "
+                "hard CreateFleet throttle burst carrying a Retry-After "
+                "hint against a mid-window pod wave, and a +20s clock "
+                "jump.",
+    build_rules=lambda: [
+        IceWindow(0.0, 40.0, capacity_type="spot"),
+        ApiFault(("create_fleet",), 9.0, 16.0, p=1.0,
+                 error="rate_limited", retry_after=3.0),
+        ClockJump(30.0, 20.0),
+    ],
+    workload=_waves((0.0, 12, "p0"), (10.0, 12, "p1")),
+    timeout=240.0))
+
+_register(Scenario(
+    name="ice_storm",
+    description="Every spot offering ICEs for 140 sim-seconds against a "
+                "spot-only pool (real InsufficientCapacity errors, not "
+                "silent on-demand slide) while describes brown out at "
+                "p=0.1 — launches must mark offerings, re-solve off them, "
+                "and recover as the 3-minute marks expire.",
+    build_rules=lambda: [
+        IceWindow(10.0, 150.0, capacity_type="spot"),
+        ApiFault(("describe",), 20.0, 120.0, p=0.1, error="rate_limited"),
+    ],
+    workload=_spot_only_pool(
+        _waves((0.0, 40, "p0"), (30.0, 40, "p1"))),
+    timeout=900.0))
+
+_register(Scenario(
+    name="api_brownout",
+    description="Cloud API returns retryable 429 with p=0.3 (Retry-After "
+                "2s) across create/terminate/describe for two sim-"
+                "minutes; backoff + batching must absorb it without "
+                "leaking claims.",
+    build_rules=lambda: [
+        ApiFault(("create_fleet", "terminate", "describe"), 5.0, 120.0,
+                 p=0.3, error="rate_limited", retry_after=2.0),
+        # a guaranteed throttle burst on the second wave's launch window,
+        # so the scenario exercises the retry path at every seed
+        ApiFault(("create_fleet",), 40.0, 48.0, p=1.0,
+                 error="rate_limited", retry_after=2.0),
+        ApiFault(("describe_nodes",), 30.0, 90.0, p=0.2, error="server"),
+    ],
+    workload=_waves((0.0, 30, "p0"), (40.0, 30, "p1")),
+    timeout=600.0))
+
+_register(Scenario(
+    name="interruption_wave",
+    description="A spot interruption hits the node of a colocated bundle "
+                "(plus a kill burst in the background fleet): the whole "
+                "bundle must be replanned atomically onto a fresh node.",
+    build_rules=lambda: [
+        InterruptionBurst(at=40.0, count=1, kind="spot",
+                          target_pods=("bundle-",)),
+        InterruptionBurst(at=70.0, count=2, kind="kill"),
+    ],
+    workload=_bundle_workload(plain=20),
+    timeout=600.0))
+
+_register(Scenario(
+    name="device_loss",
+    description="The TPU backend raises on the first kernel dispatch "
+                "mid-solve: the facade must re-run the solve on native/"
+                "host, meter the fallback, and keep provisioning.",
+    build_rules=lambda: [DeviceFault(dispatch=1, count=1)],
+    workload=_plain(12),
+    backend="device",
+    timeout=300.0))
+
+_register(Scenario(
+    name="clock_skew",
+    description="Sim time jumps +90s and later +300s mid-run (NTP step / "
+                "VM migration): TTL caches, boot delays, and liveness "
+                "windows all see the discontinuity and must not strand "
+                "claims.",
+    # the second jump is scheduled past the first one's landing point
+    # (20+90=110), so the run sees two DISTINCT discontinuities rather
+    # than one cascaded +390s drain; the p1 wave lands just before the
+    # second jump so pods are pending across it
+    build_rules=lambda: [ClockJump(20.0, 90.0), ClockJump(150.0, 300.0)],
+    workload=_waves((0.0, 25, "p0"), (145.0, 15, "p1")),
+    timeout=600.0))
+
+_register(Scenario(
+    name="soak",
+    description="The long combined storm: spot ICE, API brownout, spot + "
+                "kill interruption bursts, and a clock jump, against a "
+                "cluster growing in waves. Minutes of sim time — slow "
+                "marker, runs under `make chaos`.",
+    build_rules=lambda: [
+        IceWindow(60.0, 240.0, capacity_type="spot"),
+        ApiFault(("create_fleet", "terminate", "describe"), 100.0, 400.0,
+                 p=0.25, error="rate_limited", retry_after=2.0),
+        InterruptionBurst(at=150.0, count=3, kind="spot"),
+        InterruptionBurst(at=350.0, count=2, kind="kill"),
+        ClockJump(200.0, 90.0),
+    ],
+    workload=_waves((0.0, 120, "w0"), (120.0, 60, "w1"),
+                    (300.0, 60, "w2")),
+    timeout=1500.0,
+    slow=True))
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(f"unknown scenario {name!r}; catalog: "
+                       f"{sorted(SCENARIOS)}") from None

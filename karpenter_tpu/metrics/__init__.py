@@ -132,5 +132,24 @@ COMPILE_CACHE = REGISTRY.counter(
     "bucketed executable — _bucket()'s quantum=64 padding exists "
     "precisely to keep this at ~1 miss per shape bucket in production",
     ("event",))
+DEGRADED_MODE = REGISTRY.gauge(
+    "karpenter_tpu_degraded_mode",
+    "1 (or the active-condition count) while a component serves in a "
+    "degraded mode: solver = solves rerouted off the faulted TPU backend "
+    "onto native/host, cloud-api = the terminate batcher is inside a "
+    "throttle backoff window, capacity = live ICE marks in the "
+    "UnavailableOfferings cache", ("component",))
+SOLVER_FALLBACKS = REGISTRY.counter(
+    "karpenter_tpu_solver_backend_fallback_total",
+    "Solves whose device/mesh dispatch faulted mid-solve and were re-run "
+    "on the fallback backend (the degraded path — each increment is a "
+    "solve that still returned a full placement)",
+    ("from_backend", "to_backend"))
+FAULTS_INJECTED = REGISTRY.counter(
+    "karpenter_tpu_faults_injected_total",
+    "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "
+    "clock_jump, device, interruption — burst flavor, incl. kills, is in "
+    "the timeline detail) — zero in production: the hooks are no-ops "
+    "unless a plan is installed", ("kind",))
 
 __all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram"]

@@ -251,8 +251,14 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         return np.zeros(0, bool), np.zeros((0, enc.G), np.float32)
     Np = N if mesh is None else -(-N // int(mesh.size)) * int(mesh.size)
     args = _screen_args(cat, enc, views, group_counts, Np=Np)
+    from . import solver as _solver_mod
     from .solver import (_auto_dcat, _put, _put_sharded, _read,
                          _request_cols)
+    # same fault seam as the solve kernels: a chaos plan can take the
+    # device out at screen dispatch too (the disruption controller's
+    # best-effort wrapper degrades to cost order and meters it)
+    if _solver_mod._dispatch_fault_hook is not None:
+        _solver_mod._dispatch_fault_hook("screen")
     R = enc.requests.shape[1]
     cols = _request_cols(enc, cat)
     (_, _, node_type, node_cum, node_zmask, node_cmask, active,

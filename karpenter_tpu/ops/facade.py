@@ -206,6 +206,13 @@ class Solver:
         self._dcat_cache: Dict[tuple, object] = {}  # device-resident tensors
         self._last_cat_key: tuple = ()
         self._mesh_obj = _MESH_UNSET
+        # degraded mode: >0 while device/mesh dispatches are rerouted to
+        # the fallback backend after a mid-solve device fault; decremented
+        # per rerouted solve, so the device path is re-probed after
+        # FALLBACK_COOLDOWN solves (count-based, hence sim-deterministic)
+        self._device_suspended = 0
+        self.stats: Dict[str, int] = {"catalog_rebuilds": 0,
+                                      "device_fallbacks": 0}
 
     @staticmethod
     def _accel_attached() -> bool:
@@ -255,7 +262,55 @@ class Solver:
             return self.mesh()
         return None
 
+    # solves routed to the fallback backend after a device fault before
+    # the device path is probed again (count-based: deterministic in sim)
+    FALLBACK_COOLDOWN = 8
+
+    def _fallback_backend(self, cat: Optional[CatalogTensors] = None) -> str:
+        """The degraded-mode target: the compiled C++ FFD when it can
+        serve this solve, else the numpy host oracle."""
+        if cat is not None and cat.zone_overhead is not None:
+            return "host"  # native takes a flat [T, R] allocatable only
+        from . import native
+        return "native" if native.available() else "host"
+
     def _resolve_backend(self, total_pods: int) -> str:
+        backend = self._resolve_backend_healthy(total_pods)
+        if backend in ("device", "mesh") and self._device_suspended > 0:
+            # degraded mode after a mid-solve device fault: reroute and
+            # burn down the cooldown; the gauge clears when it reaches
+            # zero (the NEXT device-sized solve re-probes the device)
+            self._device_suspended -= 1
+            if self._device_suspended == 0:
+                from ..metrics import DEGRADED_MODE
+                DEGRADED_MODE.set(0, component="solver")
+            return self._fallback_backend()
+        return backend
+
+    def _degrade(self, from_backend: str, cat: CatalogTensors,
+                 err: Exception, run_sp) -> str:
+        """A device/mesh dispatch faulted mid-solve: pick the fallback
+        backend, meter the event (fallback counter + degraded-mode gauge +
+        trace attribution), and suspend the device path for a cooldown so
+        every subsequent solve doesn't re-pay the fault latency while the
+        backend is down. Returns the backend to re-run this solve on."""
+        to = self._fallback_backend(cat)
+        self._device_suspended = self.FALLBACK_COOLDOWN
+        from ..metrics import DEGRADED_MODE, SOLVER_FALLBACKS
+        DEGRADED_MODE.set(1, component="solver")
+        SOLVER_FALLBACKS.inc(from_backend=from_backend, to_backend=to)
+        self.stats["device_fallbacks"] += 1
+        run_sp.set(backend=to, fallback_from=from_backend,
+                   outcome="degraded", fault=type(err).__name__)
+        import logging
+        logging.getLogger("karpenter_tpu.solver").warning(
+            "%s backend faulted mid-solve (%s: %s); re-running on %s and "
+            "suspending the device path for %d solves",
+            from_backend, type(err).__name__, err, to,
+            self.FALLBACK_COOLDOWN)
+        return to
+
+    def _resolve_backend_healthy(self, total_pods: int) -> str:
         if self.backend == "mesh":
             return "mesh"
         if self.backend != "hybrid":
@@ -276,6 +331,10 @@ class Solver:
             hit = encode_catalog(types)
             self._cat_cache.clear()  # one epoch's views at a time
             self._cat_cache[key] = hit
+            # availability-tensor rebuild counter: chaos tests assert an
+            # ICE mark re-keys this (and the device upload cache) exactly
+            # once per epoch change, not once per solve
+            self.stats["catalog_rebuilds"] += 1
         self._last_cat_key = key
         return hit
 
@@ -476,28 +535,40 @@ class Solver:
                 from .native import solve_native
                 result = solve_native(cat, enc, existing)
             else:
-                from .solver import device_catalog, solve_device
-                R = enc.requests.shape[1]
-                mesh = self.mesh() if backend == "mesh" else None
-                # keyed on (nodeclass hash, catalog epoch, R, placement,
-                # block gating) — NOT id(cat): a freed CatalogTensors'
-                # address can be reused by its successor
-                dkey = self._last_cat_key + (R, backend == "mesh",
-                                             blocks_gated, ds_fp)
-                dcat = self._dcat_cache.get(dkey)
-                if dcat is None:
-                    # one EPOCH resident at a time — but every variant of
-                    # the current epoch (both block-gating states, mesh vs
-                    # single) may stay, or mixed pools would thrash a full
-                    # host→device transfer on every alternate solve
-                    prefix = self._last_cat_key
-                    for k in [k for k in self._dcat_cache
-                              if k[:len(prefix)] != prefix]:
-                        del self._dcat_cache[k]
-                    dcat = device_catalog(cat, R, mesh=mesh)
-                    self._dcat_cache[dkey] = dcat
-                result = solve_device(cat, enc, existing, dcat=dcat,
-                                      mesh=mesh)
+                try:
+                    from .solver import device_catalog, solve_device
+                    R = enc.requests.shape[1]
+                    mesh = self.mesh() if backend == "mesh" else None
+                    # keyed on (nodeclass hash, catalog epoch, R, placement,
+                    # block gating) — NOT id(cat): a freed CatalogTensors'
+                    # address can be reused by its successor
+                    dkey = self._last_cat_key + (R, backend == "mesh",
+                                                 blocks_gated, ds_fp)
+                    dcat = self._dcat_cache.get(dkey)
+                    if dcat is None:
+                        # one EPOCH resident at a time — but every variant
+                        # of the current epoch (both block-gating states,
+                        # mesh vs single) may stay, or mixed pools would
+                        # thrash a full host→device transfer on every
+                        # alternate solve
+                        prefix = self._last_cat_key
+                        for k in [k for k in self._dcat_cache
+                                  if k[:len(prefix)] != prefix]:
+                            del self._dcat_cache[k]
+                        dcat = device_catalog(cat, R, mesh=mesh)
+                        self._dcat_cache[dkey] = dcat
+                    result = solve_device(cat, enc, existing, dcat=dcat,
+                                          mesh=mesh)
+                except Exception as e:  # noqa: BLE001 — graceful degradation:
+                    # the TPU backend faulting mid-solve (tunnel drop,
+                    # device reset, injected fault) must cost ONE rerouted
+                    # solve, not a crashed reconcile
+                    backend = self._degrade(backend, cat, e, run_sp)
+                    if backend == "native":
+                        from .native import solve_native
+                        result = solve_native(cat, enc, existing)
+                    else:
+                        result = solve_host(cat, enc, existing)
         # exemplar: a fat solve-duration bucket points at the captured
         # trace in the flight recorder (None when tracing is off)
         SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=backend,

@@ -99,6 +99,18 @@ def _read(arr) -> np.ndarray:
 # instead of a test-only assertion.
 _compile_seen: set = set()
 
+# fault-injection seam (faults/injector.device_fault_hook): when armed,
+# called with the backend name immediately before every kernel dispatch;
+# raising aborts the dispatch and the facade's degraded-mode fallback
+# re-runs the solve on native/host. None (the default) costs one
+# identity check per solve — the zero-overhead-when-disabled contract.
+_dispatch_fault_hook = None
+
+
+def set_dispatch_fault_hook(fn) -> None:
+    global _dispatch_fault_hook
+    _dispatch_fault_hook = fn
+
 
 def _dispatch_cache_event(key: tuple) -> str:
     """Classify a packed-kernel dispatch as 'hit'/'miss' and count it."""
@@ -847,6 +859,8 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
                               backend="mesh",
                               note="includes replicated input puts")
                   if TRACER.enabled else NOOP_SPAN)
+            if _dispatch_fault_hook is not None:
+                _dispatch_fault_hook("mesh")
             with sp:
                 packed = _mesh_packed_fn(mesh, n_max, k_max, track,
                                          zone_ovh)(
@@ -890,6 +904,8 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
                               else "solve.dispatch", cache=event,
                               backend="device", n_max=n_max, k_max=k_max)
                   if TRACER.enabled else NOOP_SPAN)
+            if _dispatch_fault_hook is not None:
+                _dispatch_fault_hook("device")
             with sp:
                 packed = _solve_onebuf(
                     dcat.alloc, dcat.price, dcat.avail, gbuf_dev,

@@ -43,6 +43,9 @@ class SimEnvironment:
     disruption: DisruptionController
     interruption: InterruptionController
     gc: GarbageCollectionController
+    # armed faults.FaultPlan when the stack was built with fault injection
+    # (make_sim(fault_plan=...)); None in a healthy sim
+    fault_plan: Optional[object] = None
 
     def start_chaos(self, interval: float = 60.0, seed: int = 0) -> None:
         """kwok kill-node-thread analog (kwok/ec2/ec2.go:253-282): kill a
@@ -75,10 +78,19 @@ def make_sim(types: Optional[List[InstanceType]] = None,
              cloud_config: Optional[FakeCloudConfig] = None,
              nodepool: Optional[NodePool] = None,
              cloud: Optional[FakeCloud] = None,
-             clock: Optional[FakeClock] = None) -> SimEnvironment:
+             clock: Optional[FakeClock] = None,
+             fault_plan: Optional[object] = None) -> SimEnvironment:
     """Passing an existing `cloud` (+ its clock) simulates an operator
     restart: the new stack rehydrates its fresh Store from the cloud's
-    durable state instead of starting empty-world."""
+    durable state instead of starting empty-world.
+
+    fault_plan: an armed faults.FaultPlan — every controller then speaks
+    to the cloud through a faults.injector.FaultyCloud decorator (injected
+    throttles/server errors), the fake cloud honors the plan's ICE
+    windows, the clock carries its skew jumps, and its interruption bursts
+    are delivered by an engine hook. The raw FakeCloud stays on
+    `sim.cloud` (the environment-model seam — node materialization and
+    test introspection are not subject to API faults)."""
     if cloud is not None and (types is not None or cloud_config is not None):
         raise ValueError("types/cloud_config are ignored when an existing "
                          "cloud is passed — configure the cloud directly")
@@ -89,21 +101,38 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     store = Store()
     types = types if types is not None else small_catalog()
     cloud = cloud or FakeCloud(types, clock=clock, config=cloud_config)
-    catalog = CatalogProvider(lambda: cloud.describe_types(), clock=clock)
+    # api_cloud is what controllers hold; identical to `cloud` unless a
+    # fault plan interposes the injection decorator
+    api_cloud = cloud
+    if fault_plan is not None:
+        from .faults.injector import FaultyCloud
+        fault_plan.clock = clock
+        fault_plan.origin = clock.now()        # rule times are run-relative
+        cloud.fault_plan = fault_plan          # ICE windows
+        for j in fault_plan.clock_jumps:       # skew
+            clock.schedule_jump(fault_plan.origin + j.at, j.delta,
+                                fault_plan.on_jump)
+        api_cloud = FaultyCloud(cloud, fault_plan, clock)
+    # the catalog's backend listing goes through the gated view too, so
+    # an ApiFault on "describe_types" really browns out catalog refresh
+    # (rules targeting it should start at t0 > 0 — make_sim's sync
+    # hydrate below runs at t=0 and does not absorb cloud errors)
+    catalog = CatalogProvider(lambda: api_cloud.describe_types(),
+                              clock=clock)
     solver = Solver(catalog, backend=backend)
-    provisioner = Provisioner(store=store, solver=solver, cloud=cloud,
+    provisioner = Provisioner(store=store, solver=solver, cloud=api_cloud,
                               catalog=catalog)
-    lifecycle = LifecycleController(store=store, cloud=cloud)
+    lifecycle = LifecycleController(store=store, cloud=api_cloud)
     binding = BindingController(store=store)
-    termination = TerminationController(store=store, cloud=cloud,
+    termination = TerminationController(store=store, cloud=api_cloud,
                                         catalog=catalog)
     disruption = DisruptionController(store=store, solver=solver,
                                       catalog=catalog, provisioner=provisioner,
                                       termination=termination)
-    interruption = InterruptionController(store=store, cloud=cloud,
+    interruption = InterruptionController(store=store, cloud=api_cloud,
                                           catalog=catalog,
                                           termination=termination)
-    gc = GarbageCollectionController(store=store, cloud=cloud)
+    gc = GarbageCollectionController(store=store, cloud=api_cloud)
     from .cloud.image import ImageProvider
     from .controllers.auxiliary import (CatalogRefreshController,
                                         DiscoveredCapacityController,
@@ -115,17 +144,17 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     from .controllers.repair import NodeRepairController
     metrics_c = CloudProviderMetricsController(catalog=catalog, store=store)
     images = ImageProvider(lister=cloud.describe_images, clock=clock)
-    nodeclass_c = NodeClassController(store=store, cloud=cloud,
+    nodeclass_c = NodeClassController(store=store, cloud=api_cloud,
                                       images=images)
     repair = NodeRepairController(store=store, termination=termination)
-    tagging = TaggingController(store=store, cloud=cloud)
+    tagging = TaggingController(store=store, cloud=api_cloud)
     discovered = DiscoveredCapacityController(store=store, catalog=catalog)
     refresh = CatalogRefreshController(catalog=catalog, store=store,
                                        images=images)
-    res_exp = ReservationExpirationController(store=store, cloud=cloud,
+    res_exp = ReservationExpirationController(store=store, cloud=api_cloud,
                                               catalog=catalog,
                                               termination=termination)
-    spot_pricing = SpotPricingController(catalog=catalog, cloud=cloud)
+    spot_pricing = SpotPricingController(catalog=catalog, cloud=api_cloud)
     engine = Engine(clock=clock).add(nodeclass_c, provisioner, lifecycle,
                                      binding, termination, disruption,
                                      interruption, gc, metrics_c, repair,
@@ -175,6 +204,9 @@ def make_sim(types: Optional[List[InstanceType]] = None,
             elif inst.state == "terminated":
                 store.delete_node(node.name)
     engine.add_hook(_tick)
+    if fault_plan is not None:
+        from .faults.injector import install_bursts
+        install_bursts(engine, cloud, fault_plan, store)
 
     store.add_nodeclass(NodeClassSpec(name="default"))
     store.add_nodepool(nodepool or NodePool(name="default"))
@@ -186,4 +218,4 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                           provisioner=provisioner, lifecycle=lifecycle,
                           binding=binding, termination=termination,
                           disruption=disruption, interruption=interruption,
-                          gc=gc)
+                          gc=gc, fault_plan=fault_plan)

@@ -1,0 +1,234 @@
+"""Chaos scenario catalog: convergence, reproducibility, degraded-mode
+observability, and the colocated-bundle interruption wave."""
+
+import pytest
+
+from karpenter_tpu.faults import (FaultPlan, InterruptionBurst,
+                                  ScenarioRunner, SCENARIOS)
+from karpenter_tpu.obs.tracer import TRACER
+
+
+@pytest.fixture
+def tracer():
+    """Enable the process tracer for a test, restoring it after (same
+    idiom as tests/test_obs.py)."""
+    from karpenter_tpu.obs import FlightRecorder
+    saved = (TRACER.enabled, TRACER.clock, TRACER.recorder,
+             TRACER.trace_dir, TRACER.drop_empty)
+    TRACER.configure(enabled=True, ring_size=64)
+    TRACER.trace_dir = ""
+    yield TRACER
+    (TRACER.enabled, TRACER.clock, TRACER.recorder,
+     TRACER.trace_dir, TRACER.drop_empty) = saved
+
+
+FAST = sorted(n for n, sc in SCENARIOS.items() if not sc.slow)
+SLOW = sorted(n for n, sc in SCENARIOS.items() if sc.slow)
+
+
+class TestScenarioCatalog:
+    @pytest.mark.parametrize("name", FAST)
+    def test_every_fast_scenario_converges(self, name):
+        """Acceptance: every catalog scenario converges — all pods bound,
+        no leaked NodeClaims, store/cloud consistent — and actually
+        injected faults."""
+        rep = ScenarioRunner(name, seed=0).run()
+        assert rep.converged, rep.summary()
+        assert not rep.violations, rep.summary()
+        assert rep.faults_injected > 0, (
+            f"{name} converged without a single injected fault — the "
+            f"scenario's weather never arrived")
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name", SLOW)
+    def test_soak_scenarios_converge(self, name):
+        rep = ScenarioRunner(name, seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.faults_injected > 10
+
+    def test_same_seed_reproduces_timeline_and_end_state(self):
+        """Acceptance: same FaultPlan seed ⇒ identical fault timeline and
+        identical end-of-run cluster-state hash across two runs."""
+        a = ScenarioRunner("smoke", seed=3).run()
+        b = ScenarioRunner("smoke", seed=3).run()
+        assert a.ok and b.ok
+        assert a.fault_fingerprint == b.fault_fingerprint
+        assert a.end_hash == b.end_hash
+        assert a.faults_injected == b.faults_injected
+
+    def test_brownout_reproduces_probabilistic_draws(self):
+        """p<1 rules draw from the plan RNG — the draw sequence (hence the
+        timeline) must still replay from the seed."""
+        a = ScenarioRunner("api_brownout", seed=11).run()
+        b = ScenarioRunner("api_brownout", seed=11).run()
+        assert a.ok and b.ok
+        assert a.fault_fingerprint == b.fault_fingerprint
+        assert a.end_hash == b.end_hash
+
+
+class TestIceStormObservability:
+    def test_degraded_mode_and_fault_spans_surface(self, tracer):
+        """Acceptance: during an ICE-storm run the degraded-mode gauge,
+        the fault counter, and at least one fault-attributed trace span
+        are all visible through /metrics and /debug/traces."""
+        from karpenter_tpu.metrics import DEGRADED_MODE
+        from karpenter_tpu.obs.exposition import render
+        rep = ScenarioRunner("ice_storm", seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["ice_marks"] > 0
+        assert rep.stats["provisioner_ice_errors"] > 0
+
+        status, _, body = render("/metrics")
+        text = body.decode()
+        assert status == 200
+        assert 'karpenter_tpu_degraded_mode{component="capacity"}' in text
+        assert "karpenter_tpu_faults_injected_total" in text
+        assert 'kind="ice"' in text
+
+        status, _, body = render("/debug/traces")
+        assert status == 200
+        assert b'"fault.' in body  # fault-attributed span in the recorder
+
+    def test_capacity_degraded_gauge_tracks_live_marks_and_clears(self):
+        """The gauge mirrors the live ICE-mark count — non-zero while the
+        storm's marks last, back to 0 once they expire."""
+        from karpenter_tpu.metrics import DEGRADED_MODE
+        runner = ScenarioRunner("ice_storm", seed=0)
+        rep = runner.run()
+        assert rep.ok
+        sim = runner.last_sim
+        # the gauge publishes on mark/prune; a prune-read syncs it with
+        # the live mark count
+        sim.catalog.unavailable.seqnum
+        assert DEGRADED_MODE.value(component="capacity") == float(
+            sim.catalog.unavailable.active())
+        # marks were placed during the storm…
+        assert rep.stats["ice_marks"] > 0
+        # …and expiring the remainder clears the gauge
+        sim.clock.step(181.0)  # past UNAVAILABLE_OFFERINGS_TTL
+        sim.catalog.unavailable.seqnum  # prune-on-read publishes
+        assert sim.catalog.unavailable.active() == 0
+        assert DEGRADED_MODE.value(component="capacity") == 0.0
+
+
+class TestDeviceLossScenario:
+    def test_fallback_metered_and_converges(self):
+        from karpenter_tpu.metrics import SOLVER_FALLBACKS
+        before = SOLVER_FALLBACKS.value(from_backend="device",
+                                        to_backend="host") + \
+            SOLVER_FALLBACKS.value(from_backend="device",
+                                   to_backend="native")
+        rep = ScenarioRunner("device_loss", seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["solver_device_fallbacks"] == 1
+        after = SOLVER_FALLBACKS.value(from_backend="device",
+                                       to_backend="host") + \
+            SOLVER_FALLBACKS.value(from_backend="device",
+                                   to_backend="native")
+        assert after == before + 1
+
+
+class TestInterruptionWaveBundle:
+    def _sim_with_bundle(self, burst_at=30.0):
+        """Colocated bundle + background pods on a pool restricted to
+        market capacity (no reservations — keeps the catalog epoch free
+        of reservation-version noise so the re-upload count is exact)."""
+        from karpenter_tpu.models import labels as L
+        from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+        from karpenter_tpu.models.requirements import Operator, Requirement
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.sim import make_sim
+        plan = FaultPlan(seed=0, rules=[
+            InterruptionBurst(at=burst_at, count=1, kind="spot",
+                              target_pods=("bundle-",))])
+        sim = make_sim(fault_plan=plan)
+        pool = sim.store.nodepools["default"]
+        pool.requirements.add(Requirement(
+            L.CAPACITY_TYPE, Operator.IN,
+            (L.CAPACITY_SPOT, L.CAPACITY_ON_DEMAND)))
+        sim.store.add_pod(Pod(
+            name="bundle-cache-0", labels={"app": "bundle-cache"},
+            requests=Resources.parse({"cpu": "1", "memory": "2Gi"})))
+        for i in range(3):
+            sim.store.add_pod(Pod(
+                name=f"bundle-w-{i}", labels={"app": "bundle-w"},
+                requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+                affinity_terms=[PodAffinityTerm(
+                    topology_key=L.HOSTNAME,
+                    label_selector={"app": "bundle-cache"})]))
+        for i in range(10):
+            sim.store.add_pod(Pod(
+                name=f"bg-{i}",
+                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        return sim, plan
+
+    @staticmethod
+    def _bundle_nodes(sim):
+        return {p.node_name for p in sim.store.pods.values()
+                if p.name.startswith("bundle-")}
+
+    def test_bundle_replanned_atomically_and_tensor_reuploaded_once(self):
+        """Satellite: an interruption hitting ONE node of a colocated
+        bundle forces replanning of the WHOLE bundle (all four pods land
+        together on a fresh node), and the resulting UnavailableOfferings
+        mark re-keys the availability tensor exactly once."""
+        sim, plan = self._sim_with_bundle(burst_at=30.0)
+
+        def all_bound():
+            return all(p.node_name is not None
+                       for p in sim.store.pods.values())
+        assert sim.engine.run_until(all_bound, timeout=25.0), \
+            "initial placement did not settle before the wave"
+        (node0,) = self._bundle_nodes(sim)  # colocated on ONE node
+        marks0 = sim.catalog.unavailable.stats["marks"]
+        rebuilds0 = sim.solver.stats["catalog_rebuilds"]
+        epoch0 = sim.catalog.epoch
+        assert not plan.timeline  # wave not fired yet
+
+        def replanned():
+            nodes = self._bundle_nodes(sim)
+            return (all_bound() and len(nodes) == 1
+                    and node0 not in nodes)
+        assert sim.engine.run_until(replanned, timeout=120.0), \
+            f"bundle never replanned off {node0}: {self._bundle_nodes(sim)}"
+        # the wave hit the bundle's node, and only it
+        assert [k for _, k, _ in plan.timeline] == ["interruption"]
+        # whole-bundle atomicity: all four pods share ONE fresh node
+        (node1,) = self._bundle_nodes(sim)
+        assert node1 != node0
+        # the spot interruption marked the reclaimed offering once, and
+        # that ONE ICE-cache bump is the only availability-epoch change —
+        # the epoch keys the (device-)tensor caches, so the availability
+        # tensor re-uploads exactly once for the wave
+        assert sim.catalog.unavailable.stats["marks"] == marks0 + 1
+        epoch1 = sim.catalog.epoch
+        assert epoch1[1] == epoch0[1] + 1  # ICE seqnum: exactly one bump
+        assert (epoch1[0],) + epoch1[2:] == (epoch0[0],) + epoch0[2:], (
+            "a non-ICE component also rolled the epoch — the re-upload "
+            "count would over-state the ICE cache's effect")
+        assert sim.solver.stats["catalog_rebuilds"] > rebuilds0
+        # and the next solve avoided the reclaimed offering: the new
+        # bundle node is not on the marked (type, zone, captype)
+        claim = next(c for c in sim.store.nodeclaims.values()
+                     if sim.store.node_for_nodeclaim(c) is not None
+                     and sim.store.node_for_nodeclaim(c).name == node1)
+        assert not sim.catalog.unavailable.is_unavailable(
+            claim.instance_type, claim.zone, claim.capacity_type)
+
+    def test_full_scenario_converges(self):
+        rep = ScenarioRunner("interruption_wave", seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["ice_marks"] >= 1  # the spot reclaim marked
+
+
+class TestZeroOverheadWhenDisabled:
+    def test_plain_sim_has_no_armed_hooks(self):
+        from karpenter_tpu.ops import solver as solver_mod
+        from karpenter_tpu.sim import make_sim
+        sim = make_sim()
+        assert sim.fault_plan is None
+        assert sim.cloud.fault_plan is None
+        assert sim.clock._jumps == []
+        assert solver_mod._dispatch_fault_hook is None
+        # controllers hold the raw cloud — no decorator in the path
+        assert sim.provisioner.cloud is sim.cloud

@@ -1,0 +1,458 @@
+"""Fault-injection subsystem: plan determinism, injection seams, the
+solver's degraded-mode fallback, and the batcher's jittered backoff /
+admission-gating / Retry-After satellites."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.catalog import small_catalog
+from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+from karpenter_tpu.cloud.batcher import BatchingCloud
+from karpenter_tpu.cloud.fake import FakeCloud, FakeCloudConfig
+from karpenter_tpu.cloud.provider import (Instance, NotFoundError,
+                                          RateLimitedError, ServerError)
+from karpenter_tpu.faults import (ApiFault, ClockJump, DeviceFault,
+                                  FaultPlan, IceWindow, InjectedFault)
+from karpenter_tpu.faults.injector import FaultyCloud, device_fault_hook
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _mk_cloud(clock=None, **cfg):
+    clock = clock or FakeClock()
+    config = FakeCloudConfig(**cfg) if cfg else None
+    return FakeCloud(small_catalog(), clock=clock, config=config), clock
+
+
+class TestFaultPlan:
+    def test_ice_window_selectors_and_timeline(self):
+        plan = FaultPlan(seed=1, rules=[
+            IceWindow(10.0, 20.0, zone="zone-a", capacity_type="spot")])
+        assert not plan.ice_active("m5.large", "zone-a", "spot", 5.0)
+        assert plan.ice_active("m5.large", "zone-a", "spot", 10.0)
+        assert not plan.ice_active("m5.large", "zone-b", "spot", 10.0)
+        assert not plan.ice_active("m5.large", "zone-a", "on-demand", 10.0)
+        assert not plan.ice_active("m5.large", "zone-a", "spot", 20.0)
+        assert plan.timeline == [(10.0, "ice", "m5.large/zone-a/spot")]
+
+    def test_api_fault_taxonomy_and_probability_determinism(self):
+        rules = [ApiFault(("create_fleet",), 0.0, 100.0, p=0.5,
+                          error="rate_limited", retry_after=7.0),
+                 ApiFault(("describe",), 0.0, 100.0, p=1.0, error="server")]
+        a, b = FaultPlan(seed=3, rules=rules), FaultPlan(seed=3, rules=rules)
+        seq_a = [type(a.api_fault("create_fleet", t)).__name__
+                 for t in range(40)]
+        seq_b = [type(b.api_fault("create_fleet", t)).__name__
+                 for t in range(40)]
+        assert seq_a == seq_b  # same seed, same draw sequence
+        assert "RateLimitedError" in seq_a and "NoneType" in seq_a
+        err = a.api_fault("create_fleet", 50.0)
+        if err is None:  # p=0.5: draw until one fires
+            while err is None:
+                err = a.api_fault("create_fleet", 50.0)
+        assert isinstance(err, RateLimitedError) and err.retry_after == 7.0
+        assert isinstance(a.api_fault("describe", 0.0), ServerError)
+        assert a.api_fault("describe", 100.0) is None  # window closed
+        assert a.fingerprint()  # non-empty digest
+
+    def test_device_fault_counts_dispatches(self):
+        plan = FaultPlan(rules=[DeviceFault(dispatch=2, count=1)])
+        plan.on_dispatch("device")          # dispatch 1: healthy
+        with pytest.raises(InjectedFault):
+            plan.on_dispatch("device")      # dispatch 2: fault
+        plan.on_dispatch("device")          # dispatch 3: healthy again
+
+    def test_origin_makes_rule_times_run_relative(self):
+        plan = FaultPlan(rules=[IceWindow(10.0, 20.0)])
+        plan.origin = 1_000_000.0
+        assert plan.ice_active("t", "z", "c", 1_000_015.0)
+        assert not plan.ice_active("t", "z", "c", 1_000_025.0)
+        # ledger stores run-relative time
+        assert plan.timeline[0][0] == 15.0
+
+
+class TestInjectionSeams:
+    def test_hooks_are_noop_by_default(self):
+        """Zero overhead with injection disabled: every seam is a single
+        None/empty check."""
+        from karpenter_tpu.ops import solver as solver_mod
+        cloud, clock = _mk_cloud()
+        assert cloud.fault_plan is None
+        assert solver_mod._dispatch_fault_hook is None
+        assert clock._jumps == []
+
+    def test_faulty_cloud_raises_and_passes_through(self):
+        cloud, clock = _mk_cloud()
+        plan = FaultPlan(rules=[
+            ApiFault(("terminate",), 0.0, 100.0, p=1.0)])
+        plan.origin = clock.now()  # rule times are run-relative
+        fc = FaultyCloud(cloud, plan, clock)
+        with pytest.raises(RateLimitedError):
+            fc.terminate(["i-x"])
+        assert fc.describe() == []            # uninjected method forwards
+        assert fc.describe_types()            # passthrough via name
+        assert fc.snapshot()["instances"] == {}  # __getattr__ passthrough
+
+    def test_fake_cloud_ice_window_forces_failover(self):
+        """During the window the launch must slide past the ICE'd rows to
+        a surviving override, exactly like a real ICE."""
+        from karpenter_tpu.cloud.provider import LaunchOverride, LaunchRequest
+        cloud, clock = _mk_cloud()
+        cloud.fault_plan = FaultPlan(rules=[
+            IceWindow(0.0, 1e9, capacity_type="spot")])
+        cloud.fault_plan.origin = clock.now()
+        t = next(iter(cloud.types))
+        req = LaunchRequest(nodeclaim_name="nc", overrides=[
+            LaunchOverride(t, "zone-a", "spot", 1.0),
+            LaunchOverride(t, "zone-a", "on-demand", 3.0)])
+        (res,) = cloud.create_fleet([req])
+        assert isinstance(res, Instance)
+        assert res.capacity_type == "on-demand"
+        assert cloud.fault_plan.timeline  # the skipped row was recorded
+
+    def test_clock_jump_applies_once_with_callback(self):
+        clock = FakeClock(start=0.0)
+        seen = []
+        clock.schedule_jump(10.0, 90.0, lambda now, d: seen.append((now, d)))
+        clock.step(9.0)
+        assert clock.now() == 9.0 and not seen
+        clock.step(1.0)
+        assert clock.now() == 100.0
+        assert clock.now() == 100.0  # one-shot, not reapplied
+        assert seen == [(100.0, 90.0)]
+
+    def test_chained_clock_jumps_drain(self):
+        clock = FakeClock(start=0.0)
+        clock.schedule_jump(10.0, 20.0)
+        clock.schedule_jump(25.0, 5.0)  # the first jump carries time past it
+        clock.step(10.0)
+        assert clock.now() == 35.0
+
+    def test_unavailable_on_mark_hook_and_active_count(self):
+        clock = FakeClock()
+        u = UnavailableOfferings(clock=clock, ttl=60.0)
+        marks = []
+        u.on_mark.append(lambda kind, key, reason: marks.append((kind, key)))
+        u.mark_unavailable("m5.large", "zone-a", "spot", reason="ICE")
+        u.mark_zone_unavailable("zone-b")
+        assert marks == [("offering", ("m5.large", "zone-a", "spot")),
+                         ("zone", ("zone-b",))]
+        assert u.active() == 2 and u.stats["marks"] == 2
+        from karpenter_tpu.metrics import DEGRADED_MODE
+        assert DEGRADED_MODE.value(component="capacity") == 2.0
+        clock.step(61.0)
+        u.seqnum  # prune on read
+        assert u.active() == 0
+        assert DEGRADED_MODE.value(component="capacity") == 0.0
+
+
+class TestSolverDeviceFallback:
+    def _solver(self):
+        from karpenter_tpu.catalog import CatalogProvider
+        from karpenter_tpu.ops.facade import Solver
+        types = small_catalog()
+        return Solver(CatalogProvider(lambda: types), backend="device")
+
+    def _pods(self, n=4):
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        return [Pod(name=f"p{i}",
+                    requests=Resources.parse({"cpu": "1", "memory": "1Gi"}))
+                for i in range(n)]
+
+    def test_fault_mid_solve_falls_back_and_suspends(self):
+        from karpenter_tpu.metrics import SOLVER_FALLBACKS
+        from karpenter_tpu.models.nodepool import NodePool
+        s = self._solver()
+        plan = FaultPlan(rules=[DeviceFault(dispatch=1, count=1)])
+        pods = self._pods()
+        before = SOLVER_FALLBACKS.value(from_backend="device",
+                                        to_backend="host") + \
+            SOLVER_FALLBACKS.value(from_backend="device",
+                                   to_backend="native")
+        with device_fault_hook(plan):
+            out = s.solve(pods, NodePool(name="np"))
+            # the degraded solve still returned a full placement
+            assert not out.unschedulable and out.launches
+            assert s.stats["device_fallbacks"] == 1
+            after = SOLVER_FALLBACKS.value(from_backend="device",
+                                           to_backend="host") + \
+                SOLVER_FALLBACKS.value(from_backend="device",
+                                       to_backend="native")
+            assert after == before + 1
+            from karpenter_tpu.metrics import DEGRADED_MODE
+            assert DEGRADED_MODE.value(component="solver") == 1.0
+            # cooldown: the next solves are rerouted WITHOUT touching the
+            # device (the hook would raise again on dispatch #2 only if
+            # the device path ran — rule says count=1, so a dispatch
+            # would succeed; assert no dispatch happens at all)
+            d0 = plan._dispatches
+            out2 = s.solve(self._pods(3), NodePool(name="np"))
+            assert not out2.unschedulable
+            assert plan._dispatches == d0  # no device dispatch: suspended
+        assert s._device_suspended > 0
+
+    def test_cooldown_expires_and_reprobes_device(self):
+        from karpenter_tpu.models.nodepool import NodePool
+        s = self._solver()
+        plan = FaultPlan(rules=[DeviceFault(dispatch=1, count=1)])
+        with device_fault_hook(plan):
+            s.solve(self._pods(), NodePool(name="np"))  # fault + fallback
+            for _ in range(s.FALLBACK_COOLDOWN):
+                s.solve(self._pods(2), NodePool(name="np"))
+            assert s._device_suspended == 0
+            d0 = plan._dispatches
+            out = s.solve(self._pods(2), NodePool(name="np"))
+            assert plan._dispatches == d0 + 1  # device re-probed
+            assert not out.unschedulable
+        from karpenter_tpu.metrics import DEGRADED_MODE
+        assert DEGRADED_MODE.value(component="solver") == 0.0
+
+
+class TestBatcherJitterAndGating:
+    def _throttling(self, clock, fail_times):
+        """A terminate backend failing with RateLimitedError while
+        fail_times says so."""
+        calls = []
+
+        class Inner:
+            def __init__(self):
+                self.clock = clock
+
+            def terminate(self, ids):
+                calls.append((clock.now(), list(ids)))
+                if fail_times(clock.now()):
+                    raise RateLimitedError("throttle")
+
+            def describe(self, ids=None):
+                return []
+        return Inner(), calls
+
+    def test_full_jitter_is_seed_deterministic(self):
+        clock = FakeClock(start=0.0)
+        inner, _ = self._throttling(clock, lambda t: True)
+
+        def gates(seed):
+            b = BatchingCloud(inner, clock, idle=0.1,
+                              rng=random.Random(seed))
+            out = []
+            for _ in range(6):
+                b.terminate(["x"])
+                clock.step(0.2)
+                b._retry_after = 0.0  # force the attempt; capture the gate
+                b.flush()
+                out.append(round(b._retry_after - clock.now(), 6))
+            return out
+        g1, g2, g3 = gates(7), gates(7), gates(8)
+        assert g1 == g2              # same seed → same jitter sequence
+        assert g1 != g3              # different seed → desynchronized
+        # full jitter: delays live in [0, ceiling], ceiling doubles to 30
+        assert all(0.0 <= d <= 30.0 for d in g1)
+
+    def test_backlog_during_backoff_flushes_chunked_not_starved(self):
+        """Items enqueued while the gate is closed must all ship once it
+        opens — in wire calls capped at max_items."""
+        clock = FakeClock(start=0.0)
+        state = {"fail": True}
+        inner, calls = self._throttling(clock, lambda t: state["fail"])
+        b = BatchingCloud(inner, clock, idle=0.1, max_items=5,
+                          rng=random.Random(0))
+        b.terminate([f"i-{k}" for k in range(5)])  # max_items: attempt 1
+        assert len(calls) == 1
+        # 12 more ids arrive during the backoff window
+        for k in range(5, 17):
+            b.terminate([f"i-{k}"])
+        assert len(calls) == 1  # gate holds
+        state["fail"] = False
+        clock.step(35.0)  # past any jittered gate (ceiling 30)
+        b.flush()
+        sent = [ids for _, ids in calls[1:]]
+        assert all(len(ids) <= 5 for ids in sent)  # cap is a wire invariant
+        assert sorted(sum(sent, [])) == sorted(f"i-{k}" for k in range(17))
+        assert not b._pending  # nothing starved
+        assert b._retry_after == 0.0 and b._backoff == 0.0
+
+    def test_partial_batch_success_keeps_backoff_for_failed_window(self):
+        """Chunk 1 succeeds, chunk 2 throttles: the succeeded window must
+        not re-send, the failed window stays queued, and the backoff grows
+        instead of resetting on the partial success."""
+        clock = FakeClock(start=0.0)
+        state = {"poison": True}
+        calls = []
+
+        class Inner:
+            def __init__(self):
+                self.clock = clock
+
+            def terminate(self, ids):
+                calls.append(list(ids))
+                if state["poison"] and "i-5" in ids:
+                    raise RateLimitedError("throttle")
+
+            def describe(self, ids=None):
+                return []
+        b = BatchingCloud(Inner(), clock, idle=0.1, max_items=3,
+                          rng=random.Random(0))
+        # first three hit max_items and throttle-free flush immediately?
+        # no: i-5 isn't among them — they flush clean as their own call
+        b.terminate(["i-0", "i-1", "i-2"])
+        assert calls == [["i-0", "i-1", "i-2"]]
+        # next three contain the poison id; they flush as one chunk and
+        # throttle, raising the gate
+        b.terminate(["i-3", "i-4", "i-5"])
+        assert calls[-1] == ["i-3", "i-4", "i-5"]
+        assert sorted(b._pending) == ["i-3", "i-4", "i-5"]  # failed window
+        assert b._backoff > 0 and b._retry_after > clock.now()
+        items_after_success = b.stats["terminate_items"]
+        assert items_after_success == 3  # only the clean window counted
+        # gate open + backend healthy: ONLY the failed window retries —
+        # the earlier success didn't clear the backoff for it
+        state["poison"] = False
+        n_calls = len(calls)
+        clock.step(35.0)
+        b.flush()
+        assert calls[n_calls:] == [["i-3", "i-4", "i-5"]]
+        assert not b._pending
+        assert b.stats["terminate_items"] == 6  # each id shipped once
+
+    def test_retry_after_hint_floors_the_gate(self):
+        clock = FakeClock(start=0.0)
+        calls = []
+
+        class Inner:
+            def __init__(self):
+                self.clock = clock
+
+            def terminate(self, ids):
+                calls.append(clock.now())
+                raise RateLimitedError("throttle", retry_after=12.0)
+
+            def describe(self, ids=None):
+                return []
+        b = BatchingCloud(Inner(), clock, idle=0.1, rng=random.Random(0))
+        b.terminate(["i-a"])
+        clock.step(0.2)
+        b.flush()
+        # local jitter would allow < 1s; the server hint floors it at 12
+        assert b._retry_after >= clock.now() + 12.0
+        for _ in range(300):
+            clock.step(0.1)
+            b.flush()
+            if len(calls) > 1:
+                break
+        assert len(calls) > 1
+        assert calls[1] - calls[0] >= 12.0
+
+    def test_nonretryable_per_id_path_still_chunks_and_recovers(self):
+        """Poisoned batch falls back per-id inside its chunk; later chunks
+        still flush whole."""
+        clock = FakeClock(start=0.0)
+        cloud, _ = _mk_cloud(clock=clock)
+        for i in range(6):
+            cloud.instances[f"i-{i}"] = Instance(
+                id=f"i-{i}", instance_type="m5.large", zone="zone-a",
+                capacity_type="on-demand", image_id="img", state="running")
+        real = cloud.terminate
+        calls = []
+
+        def poisoned(ids):
+            calls.append(list(ids))
+            if "i-poison" in ids and len(ids) > 1:
+                raise NotFoundError("i-poison")
+            if ids == ["i-poison"]:
+                raise NotFoundError("i-poison")
+            real(ids)
+        cloud.terminate = poisoned
+        b = BatchingCloud(cloud, clock, idle=0.1, max_items=4,
+                          rng=random.Random(0))
+        b.terminate(["i-0", "i-poison", "i-1", "i-2", "i-3", "i-4", "i-5"])
+        clock.step(0.2)
+        b.flush()
+        assert all(cloud.instances[f"i-{k}"].state == "terminated"
+                   for k in range(6))
+        assert not b._pending
+
+
+class TestRetryAfterOverTheWire:
+    def test_429_carries_retry_after_header_and_envelope(self):
+        """Server-side throttle hint survives HTTP into the client's
+        RateLimitedError (the batcher gate consumes it from there)."""
+        from karpenter_tpu.cloud.remote import RemoteCloud, serve_in_thread
+        from karpenter_tpu.utils.clock import RealClock
+        cloud = FakeCloud(small_catalog(), clock=RealClock(),
+                          config=FakeCloudConfig(terminate_rate=0.25,
+                                                 terminate_burst=1))
+        srv, port = serve_in_thread(cloud)
+        try:
+            rc = RemoteCloud("127.0.0.1", port)
+            rc.terminate([])  # drains the single-token bucket
+            with pytest.raises(RateLimitedError) as ei:
+                rc.terminate(["i-x"])
+            assert ei.value.retry_after is not None
+            assert ei.value.retry_after > 0
+        finally:
+            srv.shutdown()
+
+    def test_error_envelope_roundtrip(self):
+        from karpenter_tpu.cloud.remote import decode_error, encode_error
+        e = RateLimitedError("slow down", retry_after=4.5)
+        out = decode_error(encode_error(e))
+        assert isinstance(out, RateLimitedError)
+        assert out.retry_after == 4.5
+        out2 = decode_error(encode_error(RateLimitedError("no hint")))
+        assert out2.retry_after is None
+
+
+class TestScreenFaultSeam:
+    def test_screen_fault_degrades_to_cost_order_metered(self):
+        """The consolidation screen shares the solver's dispatch fault
+        seam; a device fault at screen dispatch degrades the disruption
+        pass to plain cost order (best-effort contract) and meters it."""
+        import numpy as np
+
+        from karpenter_tpu.metrics import SOLVER_FALLBACKS
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.ops.consolidate import consolidation_screen
+        from karpenter_tpu.ops.encode import encode_pods
+        from karpenter_tpu.sim import make_sim
+        from karpenter_tpu.state.cluster import build_node_views
+
+        sim = make_sim()
+        for i in range(20):
+            sim.store.add_pod(Pod(
+                name=f"p{i}",
+                requests=Resources.parse({"cpu": "500m", "memory": "1Gi"})))
+        assert sim.engine.run_until(
+            lambda: all(p.node_name for p in sim.store.pods.values()),
+            timeout=60)
+        cat = sim.solver.tensors(sim.store.nodeclasses["default"])
+        views = build_node_views(sim.store, cat, sim.clock.now())
+        all_pods = [p for v in views for p in v.pods]
+        enc = encode_pods(all_pods, cat)
+        sig_to_g = {g.representative.constraint_signature(): i
+                    for i, g in enumerate(enc.groups)}
+        counts = np.zeros((len(views), max(enc.G, 1)), np.int32)
+        for i, v in enumerate(views):
+            for p in v.pods:
+                counts[i, sig_to_g[p.constraint_signature()]] += 1
+
+        # the seam fires inside consolidation_screen itself…
+        plan = FaultPlan(rules=[DeviceFault(dispatch=1, count=1)])
+        with device_fault_hook(plan):
+            with pytest.raises(InjectedFault):
+                consolidation_screen(cat, enc, views, counts)
+        assert plan.timeline and plan.timeline[0][1] == "device"
+
+        # …and the controller's best-effort wrapper absorbs + meters it
+        before = SOLVER_FALLBACKS.value(from_backend="screen",
+                                        to_backend="cost-order")
+        plan2 = FaultPlan(rules=[DeviceFault(dispatch=1, count=1)])
+        pool = sim.store.nodepools["default"]
+        with device_fault_hook(plan2):
+            ordered = sim.disruption._screen_order(pool, list(views),
+                                                   cat, views)
+        assert len(ordered) == len(views)  # cost-order fallback, no crash
+        assert SOLVER_FALLBACKS.value(
+            from_backend="screen", to_backend="cost-order") == before + 1
+        assert sim.disruption.stats.get("screen_errors") == 1

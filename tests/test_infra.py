@@ -139,7 +139,11 @@ class TestBatchingCloud:
             calls.append(clock.now())
             raise RateLimitedError("throttle")
         cloud.terminate = throttled
-        b = BatchingCloud(cloud, clock, idle=0.1, max_items=2)
+        # seeded rng: the backoff delay is full-jitter uniform(0, ceiling)
+        # now — the test pins the draw sequence so the gap bound is exact
+        import random
+        b = BatchingCloud(cloud, clock, idle=0.1, max_items=2,
+                          rng=random.Random(0))
         b.terminate(["a", "b"])  # max_items: immediate attempt #1
         assert len(calls) == 1
         # further adds while backing off must NOT fire despite >= max_items
@@ -169,7 +173,8 @@ class TestBatchingCloud:
             # per-id path: throttled → remainder requeued
             raise RateLimitedError("throttle")
         cloud.terminate = misbehaving
-        b = BatchingCloud(cloud, clock, idle=0.1)
+        import random
+        b = BatchingCloud(cloud, clock, idle=0.1, rng=random.Random(0))
         b.terminate(["a", "b", "c"])
         clock.step(0.2)
         b.flush()  # batch fails non-retryably, id "a" throttles, requeue
