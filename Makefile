@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
-        deflake run native trace-report chaos clean
+        deflake run native trace-report chaos warmpath-audit clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -27,6 +27,10 @@ trace-report:  ## slowest spans from $$KARPENTER_TPU_TRACE_DIR/traces.jsonl (or 
 chaos:  ## chaos scenario catalog (incl. slow soaks) + seed-reproducibility check
 	$(PY) -m pytest tests/test_faults.py tests/test_chaos.py -q
 	$(PY) -m karpenter_tpu.faults all --repeat 2
+
+warmpath-audit:  ## warm-path auditor in always-on mode over the chaos smoke + storm scenarios
+	$(PY) -m karpenter_tpu.faults warmpath_smoke --repeat 2
+	$(PY) -m karpenter_tpu.faults warmpath_storm --repeat 2
 
 docgen:  ## regenerate docs/reference/* from the live registry + catalog
 	$(PY) tools/gen_docs.py

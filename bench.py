@@ -399,6 +399,80 @@ def main() -> None:
         "karpenter_tpu_solver_transfer_host_to_device_bytes" in exposed
         and "karpenter_tpu_solver_compile_cache_total" in exposed)
 
+    progress("c8: steady-state warm path (2k standing nodes, 32-pod bursts)")
+    # --- config 8: the arrival-rate control plane. Production steady
+    # state is the opposite shape of the 100k headline: a trickle of
+    # pods per engine tick against a standing fleet. The warm path
+    # (karpenter_tpu/warmpath/) admits those against the standing
+    # headroom ledger; this config measures the p50 of a 32-pod burst
+    # admitted warm vs the full-solve cold path on the same cluster.
+    # Host-side work — runs identically with or without an accelerator.
+    from karpenter_tpu.cloud.fake import FakeCloudConfig
+    from karpenter_tpu.models.pod import PodAffinityTerm
+    from karpenter_tpu.sim import make_sim
+    sim8 = make_sim(warmpath=True, warm_audit_every=64,
+                    cloud_config=FakeCloudConfig(
+                        node_ready_delay=1.0, register_delay=0.5,
+                        create_fleet_rate=1e6, create_fleet_burst=10**6))
+    N8 = 2000
+    for i in range(N8):
+        # self-anti-affinity pins one standing pod per node → exactly 2k
+        # nodes, each with spare headroom for the bursts
+        sim8.store.add_pod(Pod(
+            name=f"standing-{i}", labels={"app": "standing"},
+            requests=Resources.parse({"cpu": "500m", "memory": "512Mi"}),
+            affinity_terms=[PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": "standing"}, anti=True)]))
+    ok8 = sim8.engine.run_until(
+        lambda: all(p.node_name for p in sim8.store.pods.values()),
+        timeout=900.0, step=1.0)
+    detail["c8_standing_nodes"] = len(sim8.store.nodeclaims)
+    detail["c8_fleet_settled"] = bool(ok8)
+
+    def _burst(tag, n=32):
+        pods = [Pod(name=f"burst-{tag}-{i}",
+                    requests=Resources.parse({"cpu": "100m",
+                                              "memory": "128Mi"}))
+                for i in range(n)]
+        for p in pods:
+            sim8.store.add_pod(p)
+        return pods
+
+    # prime: one cold pass commits the ledger the warm bursts ride
+    _burst("prime")
+    sim8.provisioner.reconcile(sim8.clock.now())
+    warm_ms, cold_ms = [], []
+    for rep in range(5):
+        _burst(f"warm{rep}")
+        t0 = time.perf_counter()
+        sim8.provisioner.reconcile(sim8.clock.now())
+        warm_ms.append((time.perf_counter() - t0) * 1e3)
+    assert sim8.warmpath.stats["warm_reconciles"] >= 5, sim8.warmpath.stats
+    for rep in range(3):
+        _burst(f"cold{rep}")
+        sim8.warmpath.force_cold("bench-forced")
+        t0 = time.perf_counter()
+        sim8.provisioner.reconcile(sim8.clock.now())
+        cold_ms.append((time.perf_counter() - t0) * 1e3)
+    # drain the audit window: divergence must be zero (the acceptance
+    # bar; tests/test_warmpath.py carries the hard assert)
+    divergences = sim8.warmpath.auditor.audit()
+    warm_p50 = statistics.median(warm_ms)
+    cold_p50 = statistics.median(cold_ms)
+    detail["c8_warm_admit_p50_ms"] = round(warm_p50, 3)
+    detail["c8_cold_solve_p50_ms"] = round(cold_p50, 1)
+    detail["c8_warm_vs_cold_speedup"] = round(cold_p50 / warm_p50, 1)
+    detail["c8_warm_audit_divergence"] = len(divergences)
+    # the two headline steady-state keys (ISSUE 3 acceptance):
+    detail["warm_admit_p50_ms"] = detail["c8_warm_admit_p50_ms"]
+    detail["warm_hit_rate"] = round(sim8.warmpath.hit_rate, 3)
+    if cold_p50 < 10 * warm_p50:
+        progress(f"WARM PATH BELOW 10x: warm p50 {warm_p50:.2f}ms vs "
+                 f"cold p50 {cold_p50:.1f}ms")
+    if divergences:
+        progress(f"WARM AUDIT DIVERGENCE: {divergences}")
+
     progress("done")
     if server is not None:
         server.stop()

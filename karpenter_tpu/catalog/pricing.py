@@ -86,6 +86,20 @@ class PricingProvider:
         self._spot.update(prices)
         self._mark_fresh("spot")
 
+    def touch(self, feed: str = "spot") -> None:
+        """A successful poll whose prices matched the retained book: the
+        feed is ALIVE, so freshness advances (last-update timestamp +
+        gauge) — otherwise age-based staleness alerting fires on a
+        healthy feed that simply had nothing new to say. Deliberately
+        does NOT bump `updates`: prices didn't change, and rolling the
+        availability version would invalidate every downstream resolved/
+        tensor cache (and the warm path) for nothing."""
+        self.last_update = self.clock.now()
+        self._stale_feeds.discard(feed)
+        from ..metrics import PRICING_LAST_UPDATE, PRICING_STALE
+        PRICING_LAST_UPDATE.set(self.last_update)
+        PRICING_STALE.set(1.0 if self._stale_feeds else 0.0)
+
     def feed_failed(self, feed: str = "catalog") -> None:
         """The live feed errored or returned nothing: keep serving what we
         have (loading the snapshot if we have nothing), raise the gauge.

@@ -32,7 +32,11 @@ class CatalogProvider:
                  clock: Optional[Clock] = None):
         self.clock = clock or RealClock()
         self._list_types = list_types
-        self.pricing = pricing or PricingProvider()
+        # the pricing provider shares the catalog's clock for the same
+        # reason the ICE cache below does: freshness timestamps (the
+        # age-based staleness alert input) must follow sim time under a
+        # FakeClock, not the wall
+        self.pricing = pricing or PricingProvider(clock=self.clock)
         # the ICE cache must share the provider's clock: under a sim's
         # FakeClock a wall-clock default would make 3-minute marks expire
         # on real time — never inside the sim, or mid-test at random

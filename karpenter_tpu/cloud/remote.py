@@ -80,7 +80,9 @@ def encode(obj):
     if isinstance(obj, (list,)):
         return [encode(x) for x in obj]
     if isinstance(obj, (set, frozenset)):
-        return {"__tu__": [encode(x) for x in sorted(obj)]}
+        # distinct tag: a set must come back as a set — round-tripping as
+        # a tuple silently broke membership/equality semantics downstream
+        return {"__set__": [encode(x) for x in sorted(obj)]}
     if isinstance(obj, dict):
         return {str(k): encode(v) for k, v in obj.items()}
     raise TypeError(f"unencodable wire value: {type(obj)}")
@@ -117,6 +119,10 @@ def decode(obj):
             return r
         if "__tu__" in obj:
             return tuple(decode(x) for x in obj["__tu__"])
+        if "__set__" in obj:
+            # frozenset fields decode to set too — set/frozenset compare
+            # equal in Python, and no wire consumer mutates them
+            return {decode(x) for x in obj["__set__"]}
         if "__dc__" in obj:
             cls = _classes()[obj["__dc__"]]
             return cls(**{k: decode(v) for k, v in obj["f"].items()})
@@ -203,6 +209,12 @@ def make_server(cloud, host: str = "127.0.0.1", port: int = 0,
 
     from ..utils.leaderelection import InMemoryLeaseBackend, Lease
     lease_backend = lease_backend or InMemoryLeaseBackend()
+    # ThreadingHTTPServer runs one thread per connection, but FakeCloud
+    # (and its TokenBuckets/instance maps) is plain mutable Python with
+    # no internal locking: concurrent batcher/controller RPCs could
+    # interleave mid-mutation. One dispatch lock serializes the cloud
+    # calls — the wire I/O itself stays parallel.
+    rpc_lock = threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -258,13 +270,17 @@ def make_server(cloud, host: str = "127.0.0.1", port: int = 0,
                 n = int(self.headers.get("Content-Length", 0))
                 args = json.loads(self.rfile.read(n) or b"{}").get("args", [])
                 args = [decode(a) for a in args]
+                # encode inside the lock too: result objects are live
+                # fake-cloud state another request could mutate mid-walk
                 if method == "create_fleet":
-                    out = cloud.create_fleet(*args)
-                    result = [{"error": encode_error(r)}
-                              if isinstance(r, CloudError)
-                              else {"instance": encode(r)} for r in out]
+                    with rpc_lock:
+                        out = cloud.create_fleet(*args)
+                        result = [{"error": encode_error(r)}
+                                  if isinstance(r, CloudError)
+                                  else {"instance": encode(r)} for r in out]
                 else:
-                    result = encode(getattr(cloud, method)(*args))
+                    with rpc_lock:
+                        result = encode(getattr(cloud, method)(*args))
                 self._send(200, {"result": result})
             except CloudError as e:
                 # a throttled backend's recovery hint travels as the

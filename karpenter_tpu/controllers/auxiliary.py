@@ -175,6 +175,12 @@ class SpotPricingController:
             self.catalog.pricing.update_spot(book)
             if changed:
                 self.stats["updates"] += 1
+        else:
+            # unchanged prices from a live feed still REFRESH freshness:
+            # advance last-update (timestamp + gauge) without bumping the
+            # availability version, so age-based staleness alerting can't
+            # fire falsely on a quiet-but-healthy spot market
+            self.catalog.pricing.touch("spot")
         return self.requeue
 
 

@@ -173,6 +173,9 @@ class DisruptionController:
                     t.key == L.DISRUPTED_TAINT_KEY for t in v.node.taints):
                 v.node.taints.append(
                     Taint(key=L.DISRUPTED_TAINT_KEY, effect="NoSchedule"))
+                # in-place taint: broadcast, or the warm-path ledger
+                # keeps filling a node the cold pass would now exclude
+                self.store.touch_node(v.node, "cordon")
 
     def _uncordon(self, claim_names: List[str]) -> None:
         for name in claim_names:
@@ -180,9 +183,12 @@ class DisruptionController:
             if claim is None or claim.is_deleting():
                 continue  # draining nodes keep their taint
             node = self.store.node_for_nodeclaim(claim)
-            if node is not None:
+            if node is not None and any(t.key == L.DISRUPTED_TAINT_KEY
+                                        for t in node.taints):
                 node.taints = [t for t in node.taints
                                if t.key != L.DISRUPTED_TAINT_KEY]
+                # capacity returned in place: broadcast (warm delta feed)
+                self.store.touch_node(node, "uncordon")
 
     # --- per-pool pass ---
     def _reconcile_pool(self, pool: NodePool, now: float) -> None:

@@ -72,6 +72,13 @@ class Engine:
                     try:
                         with sp:
                             requeue = c.reconcile(now)
+                            # controllers may publish per-pass attributes
+                            # (e.g. the provisioner's warm/cold path
+                            # decision) onto their reconcile span
+                            if TRACER.enabled:
+                                attrs = getattr(c, "span_attrs", None)
+                                if attrs is not None:
+                                    sp.set(**attrs())
                     except CloudError as e:
                         # retryable cloud errors (rate limits, server
                         # errors) model transient throttling: back off

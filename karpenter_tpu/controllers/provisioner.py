@@ -46,12 +46,24 @@ class Provisioner:
     name: str = "provisioner"
     batch_idle: float = 1.0
     requeue: float = 1.0
+    # optional warmpath.WarmPathEngine: classifies each reconcile warm
+    # (only pod arrivals since the last committed solve — admit against
+    # the standing headroom ledger, no full solve) or cold (anything else
+    # changed — full solve, then recommit the ledger). None = always cold.
+    warmpath: Optional[object] = None
     stats: Dict[str, int] = field(default_factory=lambda: {
         "solves": 0, "launches": 0, "ice_errors": 0, "unschedulable": 0})
     _throttled: bool = False  # set by a throttled _launch within a pass
+    _last_path: str = "idle"  # warm | mixed | cold | idle (span attribute)
+
+    def span_attrs(self) -> Dict[str, str]:
+        """Attributes the engine attaches to this controller's reconcile
+        span (engine.py) — the warm/cold decision, trace-visible."""
+        return {"path": self._last_path}
 
     def reconcile(self, now: float) -> float:
         self._throttled = False
+        self._last_path = "idle"
         # the store's admission-time index IS the pending-unnominated set,
         # already bucketed by constraint signature — the first pool's
         # encode skips its per-pod grouping pass entirely
@@ -63,6 +75,19 @@ class Provisioner:
                          pods=sum(len(g) for g in groups))
         if not groups:
             return self.requeue
+        if self.warmpath is not None:
+            admitted_some, groups = self.warmpath.try_admit(groups, now)
+            if not groups:
+                # the whole arrival burst fit the standing headroom —
+                # no solve, no launches, nothing to recommit. Every
+                # pending pod was admitted, so the gauge reads zero.
+                self._last_path = "warm"
+                self.stats["unschedulable"] = 0
+                PODS_UNSCHEDULABLE.set(0)
+                return self.requeue
+            self._last_path = "mixed" if admitted_some else "cold"
+        else:
+            self._last_path = "cold"
         pending = [p for g in groups for p in g]
         remaining: List[Pod] = pending
         pregrouped: Optional[List[List[Pod]]] = groups
@@ -87,39 +112,20 @@ class Provisioner:
         for p in remaining:
             self.store.record_event("pod", f"{p.namespace}/{p.name}",
                                     "FailedScheduling", "no nodepool could schedule")
+        if self.warmpath is not None:
+            # a cold solve ran: rebuild the standing headroom ledger from
+            # the post-solve cluster state so the next arrival-only tick
+            # can be admitted warm against it
+            self.warmpath.commit(now)
         # a throttled CreateFleet left pods pending on purpose: retry at
         # the retryable backoff, not the normal cadence
         return max(self.requeue, 2.0) if self._throttled else self.requeue
 
     def _cluster_occupancy(self, now: float):
-        """Cluster-wide (zone, pods) per node — every pool's claims plus
-        unmanaged nodes — for topology-spread domain counting (k8s counts
-        matching pods wherever they run, not per NodePool)."""
-        out = []
-        claim_node_names = set()
-        # one pass over all pods: nominated-but-unbound pods per claim
-        nominated: Dict[str, List[Pod]] = {}
-        for p in self.store.pods.values():
-            c = p.annotations.get(NOMINATED)
-            if c is not None and p.node_name is None:
-                nominated.setdefault(c, []).append(p)
-        for claim in self.store.nodeclaims.values():
-            if claim.node_name:
-                # claim its node even when deleting, so the drained node's
-                # pods aren't double-counted through the unmanaged loop
-                claim_node_names.add(claim.node_name)
-            if claim.is_deleting():
-                continue
-            pods = list(nominated.get(claim.name, []))
-            if claim.node_name:
-                pods.extend(self.store.pods_on_node(claim.node_name))
-            out.append((claim.zone, pods))
-        for node in self.store.nodes.values():
-            if node.name in claim_node_names:
-                continue
-            out.append((node.labels.get(L.ZONE),
-                        self.store.pods_on_node(node.name)))
-        return out
+        """Cluster-wide (zone, pods) per node — canonical implementation
+        in state/cluster.py, shared with the warm-path commit snapshot."""
+        from ..state.cluster import cluster_occupancy
+        return cluster_occupancy(self.store)
 
     # --- per-pool pass ---
     def _provision_pool(self, pool: NodePool, pods: List[Pod],
@@ -136,18 +142,11 @@ class Provisioner:
         # live + in-flight claims of this pool absorb pods first (real-node
         # headroom reuse; reference simulates against cluster state the same
         # way); their current pods ride along so anti-affinity caps hold
-        # across reconciles
-        from ..state.cluster import build_node_views
+        # across reconciles. pool_node_views applies the cordon filter —
+        # the same view the warm-path ledger is built from.
+        from ..state.cluster import pool_node_views
         existing, existing_pods = [], {}
-        for view in build_node_views(self.store, cat, now):
-            if view.claim.nodepool != pool.name:
-                continue
-            # a node cordoned at disruption-decision time (or draining)
-            # must not absorb new pods — reusing its headroom would rot
-            # the validated disruption while its replacement boots
-            if view.node is not None and any(
-                    t.key == L.DISRUPTED_TAINT_KEY for t in view.node.taints):
-                continue
+        for view in pool_node_views(self.store, cat, now, pool.name):
             existing.append(view.virtual)
             existing_pods[view.claim.name] = view.pods
         daemonsets = list(self.store.daemonsets.values())

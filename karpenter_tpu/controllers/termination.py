@@ -42,6 +42,9 @@ class TerminationController:
             NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool,
                                       reason=reason or "unknown")
             self.store.record_event("nodeclaim", claim.name, "Terminating", reason)
+            # in-place mutation: broadcast it, or the warm-path delta
+            # feed keeps admitting arrivals onto the draining node
+            self.store.touch_nodeclaim(claim, "deleting")
 
     def reconcile(self, now: float) -> float:
         for claim in list(self.store.nodeclaims.values()):

@@ -157,8 +157,9 @@ class ScenarioRunner:
         sc = self.scenario
         plan = FaultPlan(seed=self.seed, rules=sc.build_rules())
         sim = make_sim(types=sc.types() if sc.types else None,
-                       backend=sc.backend, fault_plan=plan)
-        sc.workload(sim)
+                       backend=sc.backend, fault_plan=plan,
+                       warmpath=sc.warmpath)  # audit_every defaults to 1:
+        sc.workload(sim)                      # always-on auditor in chaos
         return sim, plan
 
     @staticmethod
@@ -195,19 +196,35 @@ class ScenarioRunner:
         with device_fault_hook(plan):
             converged = sim.engine.run_until(quiet, timeout=sc.timeout,
                                              step=sc.step)
+        violations = check_invariants(sim)
+        stats = {"solver_catalog_rebuilds":
+                 sim.solver.stats["catalog_rebuilds"],
+                 "solver_device_fallbacks":
+                 sim.solver.stats["device_fallbacks"],
+                 "ice_marks": sim.catalog.unavailable.stats["marks"],
+                 "provisioner_ice_errors":
+                 sim.provisioner.stats["ice_errors"]}
+        if sim.warmpath is not None:
+            wp = sim.warmpath
+            stats.update({
+                "warm_pods": wp.stats["warm_pods"],
+                "warm_reconciles": wp.stats["warm_reconciles"],
+                "cold_reconciles": wp.stats["cold_reconciles"],
+                "warm_audits": wp.auditor.stats["audits"],
+                "warm_divergences": wp.stats["divergences"]})
+            if wp.stats["divergences"]:
+                # the warm path may fall cold under weather — it may
+                # NEVER place a pod the full solver wouldn't have
+                violations.append(
+                    f"warm-path auditor diverged "
+                    f"{wp.stats['divergences']} time(s)")
         report = ScenarioReport(
             scenario=sc.name, seed=self.seed, converged=converged,
-            violations=check_invariants(sim), end_hash=state_hash(sim),
+            violations=violations, end_hash=state_hash(sim),
             fault_fingerprint=plan.fingerprint(),
             faults_injected=len(plan.timeline),
             sim_seconds=sim.clock.now() - t0,
-            stats={"solver_catalog_rebuilds":
-                   sim.solver.stats["catalog_rebuilds"],
-                   "solver_device_fallbacks":
-                   sim.solver.stats["device_fallbacks"],
-                   "ice_marks": sim.catalog.unavailable.stats["marks"],
-                   "provisioner_ice_errors":
-                   sim.provisioner.stats["ice_errors"]})
+            stats=stats)
         self.last_sim = sim
         self.last_plan = plan
         return report

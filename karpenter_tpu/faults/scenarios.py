@@ -37,6 +37,11 @@ class Scenario:
     step: float = 0.5
     slow: bool = False
     types: Optional[Callable[[], list]] = None  # catalog override
+    # run with the warm-path incremental admitter armed (auditor in
+    # always-on mode, audit_every=1): the runner then also asserts
+    # auditor divergence == 0 — the warm path may only ever fall COLD
+    # under weather, never place wrong
+    warmpath: bool = False
 
 
 # --- workloads -------------------------------------------------------------
@@ -232,6 +237,57 @@ _register(Scenario(
                     (300.0, 60, "w2")),
     timeout=1500.0,
     slow=True))
+
+
+import dataclasses as _dc
+
+_register(_dc.replace(
+    SCENARIOS["smoke"],
+    name="warmpath_smoke",
+    warmpath=True,
+    description="The tier-1 smoke scenario with the warm-path admitter "
+                "armed and its auditor in always-on mode (every warm "
+                "admission replayed through a full solve): `make "
+                "warmpath-audit` runs this — divergence must be zero."))
+
+def _warm_trickle_workload(sim):
+    """A standing fleet (24 big pods open nodes with spare slots) plus
+    8-pod small trickles that FIT that spare — the steady-state shape
+    the warm path exists for. Trickle waves between storms must be
+    admitted warm; waves landing on fresh wreckage go cold."""
+    from ..models.pod import Pod
+    from ..models.resources import Resources
+    origin = (sim.fault_plan.origin if sim.fault_plan is not None
+              else sim.clock.now())
+    _add_pods(sim, 24, cpu="2", mem="2Gi", prefix="w0")
+    fired = set()
+    trickles = [(20.0, "w1"), (35.0, "w2"), (45.0, "w3"), (70.0, "w4"),
+                (85.0, "w5"), (110.0, "w6"), (140.0, "w7"), (155.0, "w8")]
+
+    def arrivals(now: float) -> None:
+        for t, prefix in trickles:
+            if prefix not in fired and now - origin >= t:
+                fired.add(prefix)
+                _add_pods(sim, 8, cpu="200m", mem="256Mi", prefix=prefix)
+    sim.engine.add_hook(arrivals)
+
+
+_register(Scenario(
+    name="warmpath_storm",
+    description="Steady 8-pod arrival trickles against a standing fleet "
+                "with the warm path armed (auditor always-on), hit by a "
+                "spot ICE window and an interruption burst mid-stream: "
+                "the warm path must keep admitting between storms, fall "
+                "COLD (never wrong) when marks/claims/nodes change, and "
+                "end with zero audit divergence.",
+    build_rules=lambda: [
+        IceWindow(55.0, 150.0, capacity_type="spot"),
+        InterruptionBurst(at=90.0, count=2, kind="spot"),
+        InterruptionBurst(at=160.0, count=1, kind="kill"),
+    ],
+    workload=_warm_trickle_workload,
+    timeout=900.0,
+    warmpath=True))
 
 
 def get_scenario(name: str) -> Scenario:

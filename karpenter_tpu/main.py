@@ -69,9 +69,15 @@ def build_operator(options: Optional[Options] = None,
     catalog.raw_types()  # sync hydrate before controllers start
     solver = Solver(catalog, backend=opts.solver_backend,
                     profile_dir=opts.profile_dir)
+    warm_engine = None
+    if opts.gate("WarmPathAdmission"):
+        from .warmpath import WarmPathEngine
+        warm_engine = WarmPathEngine(store, solver, catalog,
+                                     audit_every=opts.warmpath_audit_every)
     provisioner = Provisioner(store=store, solver=solver, cloud=bcloud,
                               catalog=catalog,
-                              batch_idle=opts.batch_idle_seconds)
+                              batch_idle=opts.batch_idle_seconds,
+                              warmpath=warm_engine)
     lifecycle = LifecycleController(store=store, cloud=bcloud)
     binding = BindingController(store=store)
     termination = TerminationController(store=store, cloud=bcloud,

@@ -145,6 +145,37 @@ SOLVER_FALLBACKS = REGISTRY.counter(
     "on the fallback backend (the degraded path — each increment is a "
     "solve that still returned a full placement)",
     ("from_backend", "to_backend"))
+WARMPATH_DECISIONS = REGISTRY.counter(
+    "karpenter_tpu_warmpath_decisions_total",
+    "Provisioner reconciles with pending pods, by outcome: warm (whole "
+    "burst served from standing headroom), mixed (partially), escalated "
+    "(classified warm but nothing fit — the full solver served it all), "
+    "cold (classification failed; the reason dimension names why — the "
+    "delta tracker's first dirty event, a catalog-epoch move, a "
+    "config-hash change, or an audit divergence)", ("path", "reason"))
+WARMPATH_ADMIT_DURATION = REGISTRY.histogram(
+    "karpenter_tpu_warmpath_admit_duration_seconds",
+    "Warm-path admission latency per reconcile (classify + encode + "
+    "first-fit + nomination — the arrival-path cost a full solve would "
+    "otherwise be)",
+    buckets=(.0001, .00025, .0005, .001, .0025, .005, .01, .025, .05,
+             .1, .5, 1))
+WARMPATH_HIT_RATE = REGISTRY.gauge(
+    "karpenter_tpu_warmpath_warm_hit_rate",
+    "Fraction of arrival pods admitted on the warm path (vs escalated "
+    "or classified cold) since process start — the steady-state "
+    "effectiveness of the incremental admitter")
+WARMPATH_DIVERGENCE = REGISTRY.counter(
+    "karpenter_tpu_warmpath_divergence_total",
+    "Warm-path audit divergences: accumulated warm admissions replayed "
+    "through a fresh full Solver.solve() disagreed with the warm "
+    "placements. Each increment forces the path cold and flight-records "
+    "a warmpath.divergence trace — nonzero means the incremental "
+    "admitter drifted from solve semantics and repaired itself")
+WARMPATH_AUDITS = REGISTRY.counter(
+    "karpenter_tpu_warmpath_audits_total",
+    "Warm-path auditor replays, by outcome (clean / divergent)",
+    ("outcome",))
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

@@ -210,6 +210,16 @@ class Requirements:
             r.add(Requirement(t["key"], Operator(t["operator"]), tuple(t.get("values", ()))))
         return r
 
+    def __eq__(self, other) -> bool:
+        """Value equality over the constraint sets and minValues floors —
+        what the wire codec's round-trip (cloud/remote.py) verifies."""
+        if not isinstance(other, Requirements):
+            return NotImplemented
+        return (self._sets == other._sets
+                and self._min_values == other._min_values)
+
+    __hash__ = None  # mutable container semantics, like dict/list
+
     def add(self, req: Requirement) -> "Requirements":
         vs = req.to_set()
         if req.key in self._sets:

@@ -295,6 +295,74 @@ def _fit_count(alloc_t: np.ndarray, cum: np.ndarray, req: np.ndarray) -> int:
     return int(max(k, 0.0))
 
 
+def clone_nodes(existing: Optional[List[VirtualNode]],
+                R: int) -> List[VirtualNode]:
+    """Solve-input copies of existing nodes: pods_by_group starts empty
+    (result nodes report only THIS solve's placements); prior occupancy
+    enters via cum and prior_by_group. Shared by solve_host and the
+    warm-path admitter so the two build bit-identical node state."""
+    for n in (existing or []):
+        assert len(n.cum) <= R, (
+            f"existing node cum has {len(n.cum)} resources but the current "
+            f"axis is {R} — the resource axis only grows within a process")
+    return [
+        VirtualNode(type_idx=n.type_idx, zone_mask=n.zone_mask.copy(),
+                    cap_mask=n.cap_mask.copy(),
+                    cum=np.pad(n.cum, (0, max(0, R - len(n.cum)))).astype(np.float32),
+                    pods_by_group={},
+                    prior_by_group=dict(n.prior_by_group),
+                    banned_groups=n.banned_groups,
+                    existing_name=n.existing_name)
+        for n in (existing or [])]
+
+
+def first_fit_group(nodes: List[VirtualNode], g: int, enc: EncodedPods,
+                    cat: CatalogTensors, alloc: np.ndarray,
+                    zovh: Optional[np.ndarray], rem: int) -> int:
+    """Fill open `nodes` in index order with group g's pods (step 1 of the
+    solve policy — first-fit into existing/open nodes). Mutates the nodes
+    it places on; returns the count it could NOT place. This is the ONE
+    implementation of existing-node filling: solve_host runs it before
+    opening new nodes, and the warm-path admitter runs it alone (its
+    remainder escalates to the full solver instead of opening nodes), so
+    warm and cold placement onto standing capacity cannot diverge."""
+    avail = cat.available
+    conflict = enc.conflict
+    req = enc.requests[g].astype(np.float32)
+    cap_per_node = int(enc.max_per_node[g]) or BIG
+    for n in nodes:
+        if rem == 0:
+            break
+        t = n.type_idx
+        if not enc.compat[g, t]:
+            continue
+        if n.banned_groups is not None and n.banned_groups[g]:
+            continue
+        if conflict is not None and any(
+                conflict[g, h] for h in n.pods_by_group):
+            continue
+        zmask = n.zone_mask & enc.allow_zone[g]
+        cmask = n.cap_mask & enc.allow_cap[g]
+        if not (avail[t] & zmask[:, None] & cmask[None, :]).any():
+            continue
+        alloc_t = alloc[t]
+        if zovh is not None:
+            # post-take zone mask (zmask): taking the pod commits the
+            # node to it, so the reservation maxes over exactly those
+            alloc_t = alloc_t - zovh[t][zmask].max(axis=0)
+        take = min(_fit_count(alloc_t, n.cum, req),
+                   cap_per_node - n.prior_by_group.get(g, 0)
+                   - n.pods_by_group.get(g, 0), rem)
+        if take < 1:
+            continue
+        n.cum = n.cum + np.float32(take) * req
+        n.zone_mask = zmask
+        n.cap_mask = cmask
+        n.pods_by_group[g] = n.pods_by_group.get(g, 0) + take
+        rem -= take
+    return rem
+
+
 def solve_host(cat: CatalogTensors, enc: EncodedPods,
                existing: Optional[List[VirtualNode]] = None) -> SolveResult:
     """Group-level first-fit-decreasing with the policy above — equivalent
@@ -315,60 +383,15 @@ def solve_host(cat: CatalogTensors, enc: EncodedPods,
     # max over its remaining zone mask (narrowing zones restores headroom)
     zovh = align_zone_overhead(cat, R)
 
-    for n in (existing or []):
-        assert len(n.cum) <= R, (
-            f"existing node cum has {len(n.cum)} resources but the current "
-            f"axis is {R} — the resource axis only grows within a process")
-    # result nodes report only THIS solve's placements (pods_by_group starts
-    # empty even for existing nodes); prior occupancy enters via cum and
-    # prior_by_group
-    nodes: List[VirtualNode] = [
-        VirtualNode(type_idx=n.type_idx, zone_mask=n.zone_mask.copy(),
-                    cap_mask=n.cap_mask.copy(),
-                    cum=np.pad(n.cum, (0, max(0, R - len(n.cum)))).astype(np.float32),
-                    pods_by_group={},
-                    prior_by_group=dict(n.prior_by_group),
-                    banned_groups=n.banned_groups,
-                    existing_name=n.existing_name)
-        for n in (existing or [])]
+    nodes: List[VirtualNode] = clone_nodes(existing, R)
     unschedulable: Dict[int, int] = {}
-    conflict = enc.conflict
 
     for g in range(enc.G):
         req = enc.requests[g].astype(np.float32)
         cap_per_node = int(enc.max_per_node[g]) or BIG
-        rem = int(enc.counts[g])
         # 1. fill open nodes in index order (first-fit)
-        for n in nodes:
-            if rem == 0:
-                break
-            t = n.type_idx
-            if not enc.compat[g, t]:
-                continue
-            if n.banned_groups is not None and n.banned_groups[g]:
-                continue
-            if conflict is not None and any(
-                    conflict[g, h] for h in n.pods_by_group):
-                continue
-            zmask = n.zone_mask & enc.allow_zone[g]
-            cmask = n.cap_mask & enc.allow_cap[g]
-            if not (avail[t] & zmask[:, None] & cmask[None, :]).any():
-                continue
-            alloc_t = alloc[t]
-            if zovh is not None:
-                # post-take zone mask (zmask): taking the pod commits the
-                # node to it, so the reservation maxes over exactly those
-                alloc_t = alloc_t - zovh[t][zmask].max(axis=0)
-            take = min(_fit_count(alloc_t, n.cum, req),
-                       cap_per_node - n.prior_by_group.get(g, 0)
-                       - n.pods_by_group.get(g, 0), rem)
-            if take < 1:
-                continue
-            n.cum = n.cum + np.float32(take) * req
-            n.zone_mask = zmask
-            n.cap_mask = cmask
-            n.pods_by_group[g] = n.pods_by_group.get(g, 0) + take
-            rem -= take
+        rem = first_fit_group(nodes, g, enc, cat, alloc, zovh,
+                              int(enc.counts[g]))
         if rem == 0:
             continue
         # 2. open new nodes at the cost-per-slot argmin offering, identical

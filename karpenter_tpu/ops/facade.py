@@ -419,13 +419,8 @@ class Solver:
             # masks so the main solve sees consumed capacity — work on
             # copies: callers (disruption) reuse their VirtualNodes across
             # many solves in one reconcile
-            existing = [VirtualNode(
-                type_idx=vn.type_idx, zone_mask=vn.zone_mask.copy(),
-                cap_mask=vn.cap_mask.copy(), cum=vn.cum.copy(),
-                pods_by_group=dict(vn.pods_by_group),
-                prior_by_group=dict(vn.prior_by_group),
-                banned_groups=vn.banned_groups,
-                existing_name=vn.existing_name) for vn in (existing or [])]
+            from ..state.cluster import copy_virtual_node
+            existing = [copy_virtual_node(vn) for vn in (existing or [])]
             existing_pods = dict(existing_pods or {})
             cat_plan = cat
             if cat.zone_overhead is not None:
@@ -502,18 +497,7 @@ class Solver:
                 spread_occupancy, daemonsets)
         self._relax_infeasible_preferences(enc, cat)
 
-        if existing and existing_pods:
-            sig_to_groups: Dict[tuple, List[int]] = {}
-            for gi, grp in enumerate(enc.groups):
-                sig_to_groups.setdefault(
-                    grp.representative.constraint_signature(), []).append(gi)
-            for vn in existing:
-                counts: Dict[int, int] = {}
-                for p in existing_pods.get(vn.existing_name or "", []):
-                    for gi in sig_to_groups.get(p.constraint_signature(), []):
-                        counts[gi] = counts.get(gi, 0) + 1
-                vn.prior_by_group = counts
-            self._apply_resident_bans(enc, existing, existing_pods)
+        self.attach_existing_context(enc, existing, existing_pods)
 
         import time as _time
 
@@ -613,6 +597,86 @@ class Solver:
         out.unschedulable = [k for k in out.unschedulable
                              if k not in retried] + second.unschedulable
         return out
+
+    # --- warm-path seam ---------------------------------------------------
+    # The warm-path subsystem (karpenter_tpu/warmpath/) admits arrival-only
+    # reconciles against a standing headroom ledger instead of paying a
+    # full solve. These two methods are the facade's contract with it: the
+    # ledger snapshots warm_catalog() at commit time, and each warm batch
+    # is encoded by prepare_warm() — the exact encode pipeline solve()
+    # runs for the plain (no colocation, no capacity-cap) case, in the
+    # same order. The Auditor replays accumulated warm admissions through
+    # solve() itself, so any drift between this pipeline and solve()'s
+    # surfaces as metered divergence, not silent misplacement.
+
+    def warm_catalog(self, nodepool: NodePool,
+                     node_class: Optional[NodeClassSpec],
+                     daemonsets: Optional[list] = None) -> CatalogTensors:
+        """The availability/headroom view solve() would compute for this
+        (pool, class): capacity-block gate applied unless the pool targets
+        reserved capacity, then daemonset overhead baked into allocatable
+        (zone-varying part on zone_overhead)."""
+        cat = self.tensors(node_class)
+        if (cat.is_block is not None and cat.is_block.any()
+                and not targets_reserved(nodepool.requirements)):
+            from dataclasses import replace as _dc_replace
+            cat = _dc_replace(cat, available=cat.available & ~cat.is_block)
+        if daemonsets:
+            cat = apply_daemonset_overhead(cat, daemonsets, nodepool,
+                                           nodepool.template_labels())
+        return cat
+
+    def prepare_warm(self, pregrouped: List[List[Pod]], nodepool: NodePool,
+                     cat: CatalogTensors,
+                     occupancy: List[Tuple[Optional[str], List[Pod]]],
+                     existing: Optional[List[VirtualNode]] = None,
+                     existing_pods: Optional[Dict[str, List[Pod]]] = None,
+                     ) -> EncodedPods:
+        """Encode an arrival batch exactly the way solve() would: group →
+        minValues caps → zone-affinity pre-pass → topology-spread split →
+        infeasible-preference relaxation → resident priors/bans. `cat`
+        must be this pool's warm_catalog(). Taint-dropped pods surface on
+        EncodedPods.dropped_keys (they fall through to the next pool, as
+        in the cold path)."""
+        template = nodepool.template_labels()
+        enc = encode_pods([p for g in pregrouped for p in g], cat,
+                          extra_requirements=nodepool.requirements,
+                          taints=nodepool.taints + nodepool.startup_taints,
+                          pregrouped=pregrouped,
+                          template_labels=template)
+        self._apply_min_values_caps(enc, cat, nodepool.requirements)
+        dropped = enc.dropped_keys  # split_spread_groups rebuilds the enc
+        enc = apply_zone_affinity(enc, cat, occupancy)
+        enc = split_spread_groups(
+            enc, cat, self._spread_constraints(enc, cat, occupancy))
+        enc.dropped_keys = dropped
+        if enc.G:
+            self._relax_infeasible_preferences(enc, cat)
+            self.attach_existing_context(enc, existing, existing_pods)
+        return enc
+
+    @staticmethod
+    def attach_existing_context(enc: EncodedPods,
+                                existing: Optional[List[VirtualNode]],
+                                existing_pods: Optional[Dict[str, List[Pod]]],
+                                ) -> None:
+        """Map each existing node's resident pods onto the CURRENT enc's
+        group indices (prior_by_group — per-node caps hold across
+        reconciles) and compute resident anti-affinity bans. Shared by
+        solve() and the warm-path admitter."""
+        if not (existing and existing_pods):
+            return
+        sig_to_groups: Dict[tuple, List[int]] = {}
+        for gi, grp in enumerate(enc.groups):
+            sig_to_groups.setdefault(
+                grp.representative.constraint_signature(), []).append(gi)
+        for vn in existing:
+            counts: Dict[int, int] = {}
+            for p in existing_pods.get(vn.existing_name or "", []):
+                for gi in sig_to_groups.get(p.constraint_signature(), []):
+                    counts[gi] = counts.get(gi, 0) + 1
+            vn.prior_by_group = counts
+        Solver._apply_resident_bans(enc, existing, existing_pods)
 
     def _merge_plan(self, out: SolveOutput, plan: Optional[ColocationPlan],
                     cat: CatalogTensors, nodepool: NodePool) -> SolveOutput:

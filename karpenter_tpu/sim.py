@@ -46,6 +46,10 @@ class SimEnvironment:
     # armed faults.FaultPlan when the stack was built with fault injection
     # (make_sim(fault_plan=...)); None in a healthy sim
     fault_plan: Optional[object] = None
+    # warmpath.WarmPathEngine when built with make_sim(warmpath=True):
+    # arrival-only reconciles admit against the standing headroom ledger
+    # instead of paying a full solve; None = every reconcile is cold
+    warmpath: Optional[object] = None
 
     def start_chaos(self, interval: float = 60.0, seed: int = 0) -> None:
         """kwok kill-node-thread analog (kwok/ec2/ec2.go:253-282): kill a
@@ -79,7 +83,9 @@ def make_sim(types: Optional[List[InstanceType]] = None,
              nodepool: Optional[NodePool] = None,
              cloud: Optional[FakeCloud] = None,
              clock: Optional[FakeClock] = None,
-             fault_plan: Optional[object] = None) -> SimEnvironment:
+             fault_plan: Optional[object] = None,
+             warmpath: bool = False,
+             warm_audit_every: int = 1) -> SimEnvironment:
     """Passing an existing `cloud` (+ its clock) simulates an operator
     restart: the new stack rehydrates its fresh Store from the cloud's
     durable state instead of starting empty-world.
@@ -120,8 +126,16 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     catalog = CatalogProvider(lambda: api_cloud.describe_types(),
                               clock=clock)
     solver = Solver(catalog, backend=backend)
+    # warm-path incremental admission (warmpath/): audit_every=1 means the
+    # auditor replays EVERY warm admission through a full solve — the
+    # always-on mode tier-1 tests and chaos scenarios run with
+    warm_engine = None
+    if warmpath:
+        from .warmpath import WarmPathEngine
+        warm_engine = WarmPathEngine(store, solver, catalog,
+                                     audit_every=warm_audit_every)
     provisioner = Provisioner(store=store, solver=solver, cloud=api_cloud,
-                              catalog=catalog)
+                              catalog=catalog, warmpath=warm_engine)
     lifecycle = LifecycleController(store=store, cloud=api_cloud)
     binding = BindingController(store=store)
     termination = TerminationController(store=store, cloud=api_cloud,
@@ -218,4 +232,5 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                           provisioner=provisioner, lifecycle=lifecycle,
                           binding=binding, termination=termination,
                           disruption=disruption, interruption=interruption,
-                          gc=gc, fault_plan=fault_plan)
+                          gc=gc, fault_plan=fault_plan,
+                          warmpath=warm_engine)

@@ -58,6 +58,80 @@ class NodeView:
         return any(p.do_not_disrupt() for p in self.pods)
 
 
+def copy_virtual_node(vn: VirtualNode) -> VirtualNode:
+    """Independent copy of a VirtualNode (masks/cum/placement maps are
+    fresh objects): the one copy both the facade's colocation branch and
+    the warm-path ledger/audit snapshots use, so a new VirtualNode field
+    has a single place to be added to."""
+    return VirtualNode(
+        type_idx=vn.type_idx, zone_mask=vn.zone_mask.copy(),
+        cap_mask=vn.cap_mask.copy(), cum=vn.cum.copy(),
+        pods_by_group=dict(vn.pods_by_group),
+        prior_by_group=dict(vn.prior_by_group),
+        banned_groups=vn.banned_groups,
+        existing_name=vn.existing_name)
+
+
+def pool_node_views(store: Store, cat: CatalogTensors, clock_now: float,
+                    pool_name: str) -> List[NodeView]:
+    """The node views ONE NodePool's solve may fill: live + in-flight
+    claims of the pool, minus nodes cordoned for disruption (reusing a
+    disrupted node's headroom would rot the validated disruption while
+    its replacement boots). The single filter the provisioner's cold
+    path and the warm-path ledger share — the two headroom views must
+    be identical or the warm auditor meters false divergence."""
+    out = []
+    for view in build_node_views(store, cat, clock_now):
+        if view.claim.nodepool != pool_name:
+            continue
+        if view.node is not None and any(
+                t.key == L.DISRUPTED_TAINT_KEY for t in view.node.taints):
+            continue
+        out.append(view)
+    return out
+
+
+def cluster_occupancy(store: Store,
+                      by_claim: Optional[Dict[str, List[Pod]]] = None,
+                      ) -> List[Tuple[Optional[str], List[Pod]]]:
+    """Cluster-wide (zone, pods) per node — every pool's claims plus
+    unmanaged nodes — for topology-spread domain counting (k8s counts
+    matching pods wherever they run, not per NodePool). Moved here from
+    the provisioner so the warm-path commit snapshots the same view the
+    cold solve seeds spread constraints with.
+
+    by_claim: optional out-param mapping claim name → its (shared) pods
+    list in the returned view, so the warm path can append placements to
+    a claim's entry in place instead of rebuilding the whole view."""
+    out: List[Tuple[Optional[str], List[Pod]]] = []
+    claim_node_names = set()
+    # one pass over all pods: nominated-but-unbound pods per claim
+    nominated: Dict[str, List[Pod]] = {}
+    for p in store.pods.values():
+        c = p.annotations.get(L.NOMINATED)
+        if c is not None and p.node_name is None:
+            nominated.setdefault(c, []).append(p)
+    for claim in store.nodeclaims.values():
+        if claim.node_name:
+            # claim its node even when deleting, so the drained node's
+            # pods aren't double-counted through the unmanaged loop
+            claim_node_names.add(claim.node_name)
+        if claim.is_deleting():
+            continue
+        pods = list(nominated.get(claim.name, []))
+        if claim.node_name:
+            pods.extend(store.pods_on_node(claim.node_name))
+        if by_claim is not None:
+            by_claim[claim.name] = pods
+        out.append((claim.zone, pods))
+    for node in store.nodes.values():
+        if node.name in claim_node_names:
+            continue
+        out.append((node.labels.get(L.ZONE),
+                    store.pods_on_node(node.name)))
+    return out
+
+
 def build_node_views(store: Store, cat: CatalogTensors,
                      clock_now: float) -> List[NodeView]:
     views: List[NodeView] = []

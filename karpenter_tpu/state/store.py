@@ -84,6 +84,11 @@ class Store:
                     if not refs:
                         del self._pods_by_pvc[f"{old.namespace}/{pname}"]
         self.pods[key] = pod
+        if old is not None and old is not pod:
+            # a same-key replacement is a MUTATION of cluster state, not a
+            # plain arrival — the warm-path delta tracker (and any other
+            # watcher) must be able to tell the two apart
+            self._notify("pod", "replace", pod)
         for name in set(pod.pvc_names):
             self._pods_by_pvc.setdefault(
                 f"{pod.namespace}/{name}", set()).add(key)
@@ -107,6 +112,7 @@ class Store:
         claim no longer satisfies the new pin is un-nominated so the
         provisioner re-solves with the constraint."""
         self.pvcs[pvc.key] = pvc
+        self._notify("pvc", "add", pvc)
         for key in list(self._pods_by_pvc.get(pvc.key, ())):
             pod = self.pods.get(key)
             if pod is None or pod.node_name is not None:
@@ -123,10 +129,16 @@ class Store:
                 claim = self.nodeclaims.get(nominated)
                 want = pod.scheduling_requirements().get(L.ZONE)
                 if (claim is None
-                        or (want is not None and claim.zone
-                            and not want.contains(claim.zone))):
+                        or (want is not None
+                            and (not claim.zone
+                                 or not want.contains(claim.zone)))):
                     # the pre-binding nomination no longer satisfies the
-                    # volume's zone — return the pod to pending
+                    # volume's zone — return the pod to pending. A claim
+                    # whose zone is still UNKNOWN (launch in flight, the
+                    # override list may span zones) is treated as not
+                    # satisfying: keeping the nomination would gamble that
+                    # the launch lands in the volume's zone, and a miss
+                    # permanently separates the pod from its volume.
                     self.unnominate_pod(pod)
 
     def _apply_volume_constraints(self, pod: Pod) -> None:
@@ -228,10 +240,12 @@ class Store:
     def nominate_pod(self, pod: Pod, claim_name: str) -> None:
         pod.annotations[L.NOMINATED] = claim_name
         self._index_update(pod, f"{pod.namespace}/{pod.name}")
+        self._notify("pod", "nominate", pod)
 
     def unnominate_pod(self, pod: Pod) -> None:
         pod.annotations.pop(L.NOMINATED, None)
         self._index_update(pod, f"{pod.namespace}/{pod.name}")
+        self._notify("pod", "unnominate", pod)
 
     # --- daemonsets (namespaced, like the pod index — name-only keys
     # would let team-b's "agent" silently replace team-a's) ---
@@ -309,6 +323,19 @@ class Store:
                 if self._claims_by_iid.get(iid) == name:
                     del self._claims_by_iid[iid]
             self._notify("nodeclaim", "delete", nc)
+
+    def touch_nodeclaim(self, nc: NodeClaim, action: str = "update") -> None:
+        """Broadcast an IN-PLACE NodeClaim mutation to watchers. Claim
+        state largely mutates on the object (phase, deletion timestamp),
+        which no watcher can see — controllers making a mutation that
+        changes what a solve may do (marking for deletion, cordoning)
+        must call this so the warm-path delta feed observes it."""
+        self._notify("nodeclaim", action, nc)
+
+    def touch_node(self, node: Node, action: str = "update") -> None:
+        """Broadcast an in-place Node mutation (e.g. a cordon taint) —
+        same rationale as touch_nodeclaim."""
+        self._notify("node", action, node)
 
     def index_nodeclaim_instance(self, nc: NodeClaim) -> None:
         """Register the claim's instance id in the lookup index — called

@@ -51,12 +51,20 @@ class Options:
     # backend and removes the shared-RWX-volume requirement
     leader_elect_endpoint: str = ""
     leader_elect_identity: str = ""       # default: hostname-pid
+    # warm-path audit cadence: every K-th warm admission window is
+    # replayed through a full solve (docs/warmpath.md; tier-1 tests and
+    # chaos scenarios run at 1 = always-on). Only read when the
+    # WarmPathAdmission gate is on.
+    warmpath_audit_every: int = 16
     # feature gates (reference Makefile:21-24 + settings.md)
     feature_gates: Dict[str, bool] = field(default_factory=lambda: {
         "SpotToSpotConsolidation": True,
         "ReservedCapacity": True,
         "NodeRepair": True,
         "NodeOverlay": False,
+        # arrival-only reconciles admit against the standing headroom
+        # ledger instead of paying a full solve (karpenter_tpu/warmpath/)
+        "WarmPathAdmission": False,
     })
 
     def gate(self, name: str) -> bool:

@@ -112,6 +112,33 @@ class TestFeedDiesMidRun:
         assert sim.catalog.pricing.stale
         assert not sim.catalog.pricing.spot_stale
 
+    def test_unchanged_spot_poll_refreshes_freshness(self):
+        """A successful poll whose prices match the retained book must
+        still advance last-update (timestamp + gauge): the feed is
+        ALIVE, and age-based staleness alerting must not fire on a
+        quiet-but-healthy spot market. It must NOT roll the catalog's
+        availability version — nothing changed, and invalidating every
+        downstream cache (and the warm path) for a no-op poll would be
+        pure churn."""
+        from karpenter_tpu.controllers.auxiliary import SpotPricingController
+        from karpenter_tpu.metrics import PRICING_LAST_UPDATE
+        sim = make_sim()
+        spc = next(c for c in sim.engine.controllers
+                   if isinstance(c, SpotPricingController))
+        sim.catalog.raw_types()  # hydrate the book
+        book = {(t, z): p for (t, z), p
+                in sim.catalog.pricing._spot.items()}
+        assert book
+        sim.cloud.describe_spot_prices = lambda: book
+        spc.reconcile(sim.clock.now())
+        epoch = sim.catalog.epoch
+        t0 = sim.catalog.pricing.last_update
+        sim.clock.step(600)
+        spc.reconcile(sim.clock.now())  # same book, 10 minutes later
+        assert sim.catalog.pricing.last_update == sim.clock.now() > t0
+        assert _gauge_value(PRICING_LAST_UPDATE) == sim.clock.now()
+        assert sim.catalog.epoch == epoch  # no availability churn
+
 
 def _raise_server_error():
     raise ServerError("pricing API unreachable")

@@ -40,6 +40,81 @@ def served():
     srv.shutdown()
 
 
+class TestCodec:
+    """The wire codec in isolation: every registered wire class's field
+    types must survive encode → JSON → decode unchanged."""
+
+    def _roundtrip(self, obj):
+        import json
+        return remote.decode(json.loads(json.dumps(remote.encode(obj))))
+
+    def test_sets_round_trip_as_sets(self):
+        # regression: set/frozenset used to ship under the tuple tag and
+        # come back as tuples — membership/equality semantics silently
+        # changed across the wire
+        out = self._roundtrip({"zones": {"zone-b", "zone-a"}})
+        assert out["zones"] == {"zone-a", "zone-b"}
+        assert isinstance(out["zones"], set)
+        out = self._roundtrip(frozenset({"x"}))
+        assert out == {"x"} and isinstance(out, set)
+        # tuples keep their own tag
+        assert self._roundtrip((1, "a")) == (1, "a")
+        assert isinstance(self._roundtrip((1, "a")), tuple)
+
+    def test_every_wire_class_round_trips(self):
+        """One populated instance per registered wire class, exercising
+        every field type the classes declare (str/float/bool/None/dict/
+        list/tuple/Resources/Requirements/nested dataclasses)."""
+        from karpenter_tpu.cloud.provider import NetworkGroup, NodeProfile
+        from karpenter_tpu.models.nodeclaim import Node
+        from karpenter_tpu.models.pod import Taint
+        ov = LaunchOverride("c5.large", "zone-a", "spot", 0.05,
+                            reservation_id="r-1",
+                            reservation_type="capacity-block")
+        samples = [
+            ov,
+            LaunchRequest(nodeclaim_name="nc-1", overrides=[ov],
+                          image_id="img-1", user_data="#!/bin/sh",
+                          tags={"k": "v"}, network_groups=["ng-1"],
+                          profile="prof"),
+            Instance(id="i-1", instance_type="c5.large", zone="zone-a",
+                     capacity_type="spot", image_id="img-1",
+                     state="running", launch_time=1.5, tags={"a": "b"},
+                     price=0.05, nodeclaim="nc-1", reservation_id=None,
+                     network_groups=["ng-1"], profile="prof"),
+            NetworkGroup(id="ng-1", name="net", tags={"team": "a"}),
+            NodeProfile(name="prof", role="role-a", created_at=2.0,
+                        tags={}),
+            Node(name="n-1", provider_id="tpu:///zone-a/i-1",
+                 labels={"l": "v"}, annotations={"an": "v"},
+                 taints=[Taint(key="t", effect="NoSchedule", value="x")],
+                 capacity=Resources.parse({"cpu": "4"}),
+                 allocatable=Resources.parse({"cpu": "3"}),
+                 ready=True, conditions={"Ready": True},
+                 nodeclaim="nc-1", created_at=1.0,
+                 deletion_timestamp=None),
+            Taint(key="t", effect="NoExecute", value=""),
+        ]
+        # real catalog objects cover InstanceType/Offering/Overhead with
+        # live Requirements (frozenset-valued sets) and Resources
+        samples.extend(small_catalog()[:3])
+        from karpenter_tpu.cloud.image import Image
+        samples.append(Image(id="ami-1", name="std-1", family="standard",
+                             arch="amd64", created_at=3.0, deprecated=False,
+                             tags={"v": "1"}))
+        registered = set(remote._wire_classes())
+        covered = {type(s).__name__ for s in samples}
+        for s in samples:
+            if type(s).__name__ == "InstanceType":
+                covered.update(("Offering", "Overhead"))
+        assert registered <= covered, (
+            f"wire classes without a round-trip sample: "
+            f"{registered - covered}")
+        for s in samples:
+            got = self._roundtrip(s)
+            assert got == s, f"{type(s).__name__} did not round-trip"
+
+
 class TestWire:
     def test_catalog_roundtrip(self, served):
         cloud, rc = served

@@ -81,6 +81,36 @@ class TestVolumeTopology:
         claim = next(iter(sim.store.nodeclaims.values()))
         assert claim.zone == "zone-c"
 
+    def test_pvc_zone_binding_unnominates_zone_unknown_claim(self):
+        """ADVICE round 5 regression: a PVC that binds a zone while the
+        pod's nominated claim is still mid-launch (zone UNKNOWN — the
+        override list may span zones) must un-nominate conservatively.
+        Keeping the nomination gambles that the launch lands in the
+        volume's zone; a miss permanently separates pod from volume."""
+        from karpenter_tpu.models.nodeclaim import NodeClaim
+        sim = make_sim()
+        sim.store.add_pvc(PersistentVolumeClaim(name="wait"))  # unbound
+        p = sim.store.add_pod(Pod(
+            name="early", pvc_names=["wait"],
+            requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        claim = sim.store.add_nodeclaim(
+            NodeClaim(name="nc-inflight", nodepool="default"))
+        assert claim.zone is None  # launch still in flight
+        sim.store.nominate_pod(p, claim.name)
+        # the PV binds a zone mid-launch
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="wait", volume_name="pv-w", zone="zone-b"))
+        assert L.NOMINATED not in p.annotations, (
+            "pod stayed nominated to a zone-unknown claim after its "
+            "volume pinned a zone")
+        # control: a claim whose KNOWN zone satisfies the pin keeps its
+        # nomination — the conservative path only fires on unknown/wrong
+        claim.zone = "zone-b"
+        sim.store.nominate_pod(p, claim.name)
+        sim.store.add_pvc(PersistentVolumeClaim(
+            name="wait", volume_name="pv-w", zone="zone-b"))
+        assert p.annotations.get(L.NOMINATED) == claim.name
+
     def test_conflicting_zonal_claims_unschedulable(self):
         """Two PVCs bound to DIFFERENT zones cannot be satisfied: the
         zone affinities intersect to the empty set and the pod stays

@@ -1,0 +1,337 @@
+"""Warm-path incremental admission engine (karpenter_tpu/warmpath/).
+
+The contract under test: arrival-only reconciles are admitted against
+the standing headroom ledger with EXACTLY the full solver's placement
+semantics (the always-on auditor replays every warm admission through
+`Solver.solve()` and divergence must be zero), and anything else — ICE
+marks, interruptions, config changes, non-fitting bursts, colocation
+bundles — falls COLD, never wrong.
+"""
+
+import numpy as np
+
+from karpenter_tpu.metrics import (WARMPATH_AUDITS, WARMPATH_DECISIONS,
+                                   WARMPATH_DIVERGENCE)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodeclaim import NodeClaim
+from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+
+
+def mk_pods(n, prefix, cpu="250m", mem="256Mi", **kw):
+    return [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+            for i in range(n)]
+
+
+def add(sim, n, prefix, **kw):
+    pods = mk_pods(n, prefix, **kw)
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def settle(sim, timeout=300):
+    ok = sim.engine.run_until(
+        lambda: all(p.node_name for p in sim.store.pods.values()),
+        timeout=timeout)
+    assert ok, [p.name for p in sim.store.pods.values() if not p.node_name]
+
+
+def steady_sim(**kw):
+    """A sim at warm steady state: a standing claim with headroom, all
+    pods bound, ledger committed AFTER the fleet settled (the third
+    wave's cold pass recommits post-materialization)."""
+    sim = make_sim(warmpath=True, **kw)
+    add(sim, 8, "w1")
+    settle(sim)
+    add(sim, 2, "w2")  # cold again (node-add events), recommits clean
+    settle(sim)
+    return sim
+
+
+class TestDeltaTracker:
+    def test_starts_dirty_until_first_commit(self):
+        sim = make_sim(warmpath=True)
+        assert sim.warmpath.tracker.dirty == "uncommitted"
+
+    def test_plain_arrival_keeps_warm_window_open(self):
+        sim = steady_sim()
+        assert sim.warmpath.tracker.dirty is None
+        add(sim, 1, "arrival")
+        assert sim.warmpath.tracker.dirty is None
+
+    def test_claim_delete_dirties(self):
+        sim = steady_sim()
+        name = next(iter(sim.store.nodeclaims))
+        sim.store.delete_nodeclaim(name)
+        assert sim.warmpath.tracker.dirty == "nodeclaim-delete"
+
+    def test_daemonset_add_dirties(self):
+        from karpenter_tpu.models.pod import DaemonSet
+        sim = steady_sim()
+        sim.store.add_daemonset(DaemonSet(
+            name="agent", requests=Resources.parse({"cpu": "100m"})))
+        assert sim.warmpath.tracker.dirty == "daemonset-add"
+
+    def test_bind_of_nominated_pod_is_warm_safe(self):
+        sim = steady_sim()
+        assert sim.warmpath.tracker.dirty is None
+        pods = add(sim, 2, "warm-bind")
+        sim.provisioner.reconcile(sim.clock.now())   # warm-admits them
+        assert all(p.annotations.get(L.NOMINATED) for p in pods)
+        settle(sim)  # BindingController binds the nominated pods
+        assert sim.warmpath.tracker.dirty is None
+
+    def test_unbind_dirties(self):
+        sim = steady_sim()
+        bound = next(p for p in sim.store.pods.values() if p.node_name)
+        sim.store.unbind_pod(bound)
+        assert sim.warmpath.tracker.dirty is not None
+
+    def test_pending_pod_withdrawn_is_warm_safe(self):
+        sim = steady_sim()
+        add(sim, 1, "withdrawn")
+        sim.store.delete_pod("default", "withdrawn-0")
+        assert sim.warmpath.tracker.dirty is None
+
+
+class TestWarmAdmission:
+    def test_trickles_admitted_warm_with_zero_divergence(self):
+        sim = steady_sim()
+        claims_before = set(sim.store.nodeclaims)
+        div0 = WARMPATH_DIVERGENCE.value()
+        for wave in range(3):
+            add(sim, 3, f"trickle-{wave}")
+            settle(sim)
+        wp = sim.warmpath
+        assert wp.stats["warm_reconciles"] >= 3, wp.stats
+        assert wp.stats["warm_pods"] >= 9
+        assert wp.auditor.stats["audits"] >= 3       # always-on auditor
+        assert wp.stats["divergences"] == 0
+        assert WARMPATH_DIVERGENCE.value() == div0
+        # warm admissions ride standing capacity: no new claims
+        assert set(sim.store.nodeclaims) == claims_before
+
+    def test_warm_placement_lands_on_standing_claim(self):
+        sim = steady_sim()
+        claim = next(iter(sim.store.nodeclaims.values()))
+        before = Resources(claim.resource_requests)
+        pods = add(sim, 2, "landing")
+        sim.provisioner.reconcile(sim.clock.now())
+        for p in pods:
+            assert p.annotations.get(L.NOMINATED) == claim.name
+        # the claim's accounted requests grew by the admitted pods
+        grown = claim.resource_requests.get("cpu") - before.get("cpu")
+        assert abs(grown - 0.5) < 1e-6
+
+    def test_overflow_escalates_to_full_solver(self):
+        sim = steady_sim()
+        claims_before = len(sim.store.nodeclaims)
+        # far more than the standing claim's headroom: the fitting slice
+        # is admitted warm, the remainder escalates and opens nodes
+        add(sim, 60, "burst", cpu="1", mem="1Gi")
+        settle(sim)
+        assert len(sim.store.nodeclaims) > claims_before
+        assert sim.warmpath.stats["escalated_pods"] > 0
+        assert sim.warmpath.stats["divergences"] == 0
+
+    def test_colocation_bundle_escalates_whole(self):
+        sim = steady_sim()
+        sim.store.add_pod(Pod(
+            name="cache", labels={"app": "cache"},
+            requests=Resources.parse({"cpu": "250m", "memory": "256Mi"})))
+        for i in range(2):
+            sim.store.add_pod(Pod(
+                name=f"worker-{i}", labels={"app": "worker"},
+                requests=Resources.parse({"cpu": "250m",
+                                          "memory": "256Mi"}),
+                affinity_terms=[PodAffinityTerm(
+                    topology_key=L.HOSTNAME,
+                    label_selector={"app": "cache"})]))
+        settle(sim)
+        # the bundle went through the full solver (colocation planner),
+        # warm or not — and the audit stayed clean throughout
+        assert sim.warmpath.stats["divergences"] == 0
+        cache = sim.store.pods["default/cache"]
+        workers = [sim.store.pods[f"default/worker-{i}"] for i in range(2)]
+        assert all(w.node_name == cache.node_name for w in workers)
+
+    def test_ice_mark_forces_cold(self):
+        sim = steady_sim()
+        warm_before = sim.warmpath.stats["warm_reconciles"]
+        sim.catalog.unavailable.mark_unavailable(
+            "c5.large", "zone-a", "spot", reason="test")
+        add(sim, 2, "post-ice")
+        settle(sim)
+        assert sim.warmpath.stats["warm_reconciles"] == warm_before
+        assert sim.warmpath.stats["cold_reconciles"] >= 3
+        key = ("cold", "catalog-epoch")
+        assert WARMPATH_DECISIONS._values.get(key, 0) >= 1
+
+    def test_interruption_kill_forces_cold_and_recovers(self):
+        sim = steady_sim()
+        iid = next(i.id for i in sim.cloud.instances.values()
+                   if i.state == "running")
+        sim.cloud.kill_instance(iid, reason="test")
+        add(sim, 2, "post-kill")
+        settle(sim, timeout=600)
+        assert sim.warmpath.tracker.dirty is None  # recommitted since
+        assert sim.warmpath.stats["divergences"] == 0
+        assert all(p.node_name for p in sim.store.pods.values())
+
+    def test_claim_marked_deleting_forces_cold(self):
+        """Review finding: delete_nodeclaim mutates the claim IN PLACE
+        (deletion timestamp, phase) — with no broadcast the tracker
+        stayed clean and arrivals kept landing on the draining node,
+        where the BindingController refuses to bind them."""
+        sim = steady_sim()
+        assert sim.warmpath.tracker.dirty is None
+        claim = next(iter(sim.store.nodeclaims.values()))
+        sim.termination.delete_nodeclaim(claim, sim.clock.now(), "test")
+        assert sim.warmpath.tracker.dirty == "nodeclaim-deleting"
+        add(sim, 2, "post-drain")
+        settle(sim, timeout=600)
+        # cold path replaced the fleet; nobody is nominated to the
+        # drained claim
+        assert all(p.annotations.get(L.NOMINATED) != claim.name
+                   for p in sim.store.pods.values())
+
+    def test_cordon_forces_cold(self):
+        """A decision-time cordon is an in-place Node taint — it must
+        dirty the warm window so arrivals stop filling the victim."""
+        sim = steady_sim()
+        from karpenter_tpu.state.cluster import build_node_views
+        views = build_node_views(sim.store, sim.solver.tensors(None),
+                                 sim.clock.now())
+        sim.disruption._cordon(views[:1])
+        assert sim.warmpath.tracker.dirty == "node-cordon"
+
+    def test_nodepool_mutation_forces_cold(self):
+        from karpenter_tpu.models.requirements import Operator, Requirement
+        sim = steady_sim()
+        sim.store.nodepools["default"].requirements.add(
+            Requirement(L.CAPACITY_TYPE, Operator.IN, (L.CAPACITY_SPOT,)))
+        assert sim.warmpath.classify() == "pool-config"
+
+
+class TestAuditor:
+    def test_clean_audit_metered(self):
+        sim = steady_sim()
+        clean0 = WARMPATH_AUDITS.value(outcome="clean")
+        add(sim, 2, "audited")
+        sim.provisioner.reconcile(sim.clock.now())
+        assert WARMPATH_AUDITS.value(outcome="clean") == clean0 + 1
+
+    def test_divergence_forces_cold_flight_records_and_recovers(self):
+        sim = steady_sim()
+        div0 = WARMPATH_DIVERGENCE.value()
+        # sabotage the audit BASELINE (not the ledger): phantom residents
+        # consume every baseline node's headroom, so the replayed full
+        # solve must open a node where the warm path placed on existing
+        for base in sim.warmpath.auditor._baselines.values():
+            for vn in base.nodes:
+                vn.cum = vn.cum + np.float32(1e6)
+        pods = add(sim, 2, "diverging")
+        sim.provisioner.reconcile(sim.clock.now())
+        assert WARMPATH_DIVERGENCE.value() > div0
+        assert sim.warmpath.tracker.dirty == "audit-divergence"
+        assert any(e[2] == "WarmPathDivergence" for e in sim.store.events)
+        # the pods were still nominated (warm placement stands — the
+        # audit is a meter, the FORCED COLD is the repair) and the
+        # cluster converges
+        assert all(p.annotations.get(L.NOMINATED) for p in pods)
+        settle(sim)
+        # next arrival goes cold and recommits a clean window
+        add(sim, 1, "after-divergence")
+        settle(sim)
+        assert sim.warmpath.stats["divergences"] >= 1
+
+    def test_commit_audits_pending_batches_instead_of_dropping(self):
+        """Review finding: with audit_every > 1, a mixed reconcile's
+        commit used to reset the auditor and silently drop recorded
+        warm batches from audit coverage."""
+        sim = steady_sim(warm_audit_every=50)
+        add(sim, 2, "recorded")
+        sim.provisioner.reconcile(sim.clock.now())   # warm, unaudited
+        assert sim.warmpath.auditor.has_pending()
+        audits0 = sim.warmpath.auditor.stats["audits"]
+        # force the next reconcile cold: its commit must audit first
+        sim.warmpath.force_cold("test")
+        add(sim, 1, "cold-trigger")
+        sim.provisioner.reconcile(sim.clock.now())
+        assert sim.warmpath.auditor.stats["audits"] == audits0 + 1
+        assert not sim.warmpath.auditor.has_pending()
+        assert sim.warmpath.stats["divergences"] == 0
+
+    def test_audit_cadence_counts_windows_not_pool_batches(self):
+        sim = steady_sim(warm_audit_every=3)
+        for i in range(2):
+            add(sim, 1, f"window-{i}")
+            sim.provisioner.reconcile(sim.clock.now())
+        assert sim.warmpath.auditor.stats["audits"] == 0
+        add(sim, 1, "window-2")
+        sim.provisioner.reconcile(sim.clock.now())   # third window: due
+        assert sim.warmpath.auditor.stats["audits"] == 1
+
+    def test_audit_is_rebased_after_clean_window(self):
+        sim = steady_sim()
+        add(sim, 2, "w-a", cpu="100m")
+        sim.provisioner.reconcile(sim.clock.now())
+        # second, differently-sized batch: without the rebase the joint
+        # replay could legitimately reorder across batches — with it,
+        # each window is exact parity
+        add(sim, 2, "w-b", cpu="750m")
+        sim.provisioner.reconcile(sim.clock.now())
+        assert sim.warmpath.auditor.stats["audits"] >= 2
+        assert sim.warmpath.stats["divergences"] == 0
+
+
+class TestScenarios:
+    def test_warmpath_storm_chaos_scenario(self):
+        from karpenter_tpu.faults.runner import ScenarioRunner
+        rep = ScenarioRunner("warmpath_storm", seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["warm_pods"] > 0, rep.stats
+        assert rep.stats["warm_divergences"] == 0
+        assert rep.stats["warm_audits"] >= 1
+
+    def test_warmpath_smoke_scenario(self):
+        from karpenter_tpu.faults.runner import ScenarioRunner
+        rep = ScenarioRunner("warmpath_smoke", seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["warm_divergences"] == 0
+
+
+class TestObservability:
+    def test_metrics_exposed(self):
+        from karpenter_tpu.metrics import REGISTRY
+        sim = steady_sim()
+        add(sim, 1, "metered")
+        sim.provisioner.reconcile(sim.clock.now())
+        exposed = REGISTRY.expose()
+        for name in ("karpenter_tpu_warmpath_decisions_total",
+                     "karpenter_tpu_warmpath_admit_duration_seconds",
+                     "karpenter_tpu_warmpath_warm_hit_rate",
+                     "karpenter_tpu_warmpath_divergence_total",
+                     "karpenter_tpu_warmpath_audits_total"):
+            assert name in exposed, name
+
+    def test_admit_span_and_path_attr(self):
+        from karpenter_tpu.obs.tracer import TRACER
+        sim = steady_sim()
+        TRACER.configure(enabled=True, clock=sim.clock.now)
+        try:
+            add(sim, 1, "traced")
+            sim.clock.step(2.0)  # make the provisioner due again
+            sim.engine.tick()
+            spans = {s.name: s for t in TRACER.recorder.slowest()
+                     for s in t.spans}
+            assert "warmpath.admit" in spans
+            rec = next(s for n, s in spans.items()
+                       if n == "reconcile:provisioner")
+            assert rec.attrs.get("path") == "warm"
+        finally:
+            TRACER.configure(enabled=False)
+            TRACER.recorder.clear()
