@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
-        deflake run native trace-report chaos warmpath-audit clean
+        deflake run native trace-report chaos warmpath-audit \
+        encode-report clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -31,6 +32,9 @@ chaos:  ## chaos scenario catalog (incl. slow soaks) + seed-reproducibility chec
 warmpath-audit:  ## warm-path auditor in always-on mode over the chaos smoke + storm scenarios
 	$(PY) -m karpenter_tpu.faults warmpath_smoke --repeat 2
 	$(PY) -m karpenter_tpu.faults warmpath_storm --repeat 2
+
+encode-report:  ## columnar encode pipeline: cold vs cached cost + hit rate (PODS=n TICKS=n)
+	$(PY) tools/encode_report.py --pods $(or $(PODS),10000) --ticks $(or $(TICKS),5)
 
 docgen:  ## regenerate docs/reference/* from the live registry + catalog
 	$(PY) tools/gen_docs.py

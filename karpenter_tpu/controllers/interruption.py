@@ -58,6 +58,8 @@ class InterruptionController:
                 batch = self.cloud.poll_interruptions(self.batch_size)
                 if not batch:
                     return self.requeue
+                parsed = []
+                want: list = []
                 for raw in list(batch):
                     try:
                         msg = wire.parse(raw)
@@ -69,6 +71,17 @@ class InterruptionController:
                         parse_failures += 1
                         self.cloud.delete_message(raw)
                         continue
+                    parsed.append((raw, msg))
+                    if (msg.kind in ACTIONABLE
+                            and not (msg.metadata.id
+                                     and msg.metadata.id in self._seen_set)):
+                        want.extend(msg.instance_ids)
+                # ONE store-index pass resolves the whole batch's claims
+                # (instead of a per-message lookup — and, for unknown
+                # instances, a per-message full-claims scan)
+                claims = (self.store.nodeclaims_by_instance_ids(want)
+                          if want else {})
+                for raw, msg in parsed:
                     if msg.metadata.id and msg.metadata.id in self._seen_set:
                         self.stats["duplicate"] = (
                             self.stats.get("duplicate", 0) + 1)
@@ -77,7 +90,7 @@ class InterruptionController:
                         # on success: a raising _handle leaves the message
                         # undeleted for redelivery, and that redelivery
                         # must not be swallowed as a "duplicate"
-                        self._handle(msg, now)
+                        self._handle(msg, now, claims)
                         if msg.metadata.id:
                             self._register(msg.metadata.id)
                         self.stats[msg.kind] = self.stats.get(msg.kind, 0) + 1
@@ -99,11 +112,18 @@ class InterruptionController:
         self._seen_ids.append(msg_id)
         self._seen_set.add(msg_id)
 
-    def _handle(self, msg: wire.ParsedMessage, now: float) -> None:
+    def _handle(self, msg: wire.ParsedMessage, now: float,
+                claims: Dict[str, object]) -> None:
+        """`claims` is the drain batch's pre-resolved instance-id →
+        NodeClaim map (store.nodeclaims_by_instance_ids). Resolution by
+        instance id is equivalent to the old per-message envelope-pid
+        walk: provider ids end in the instance id, and the pid path only
+        added a full-pid verification before falling back to the same
+        id index."""
         if msg.kind not in ACTIONABLE:
             return
         for iid in msg.instance_ids:
-            claim = self._resolve(iid, msg)
+            claim = claims.get(iid)
             if claim is None:
                 continue
             if msg.kind == wire.SPOT_INTERRUPTION and claim.instance_type:
@@ -117,11 +137,3 @@ class InterruptionController:
                                     msg.kind)
             self.termination.delete_nodeclaim(claim, now, msg.kind)
 
-    def _resolve(self, instance_id: str, msg: wire.ParsedMessage):
-        """Instance id → NodeClaim: the envelope's resources carry provider
-        ids (fast path); fall back to the instance-id suffix index."""
-        for pid in msg.metadata.resources:
-            claim = self.store.nodeclaim_by_provider_id(pid)
-            if claim is not None and pid.rsplit("/", 1)[-1] == instance_id:
-                return claim
-        return self.store.nodeclaim_by_instance_id(instance_id)

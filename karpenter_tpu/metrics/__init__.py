@@ -176,6 +176,19 @@ WARMPATH_AUDITS = REGISTRY.counter(
     "karpenter_tpu_warmpath_audits_total",
     "Warm-path auditor replays, by outcome (clean / divergent)",
     ("outcome",))
+ENCODE_CACHE = REGISTRY.counter(
+    "karpenter_tpu_encode_cache_total",
+    "Pod signature-groups by encode-cache outcome: a 'hit' gathered the "
+    "group's tensor rows (compat/allow_zone/allow_cap/max_per_node/"
+    "request vector) from the signature-keyed EncodeContext, a 'miss' "
+    "paid the full lowering and persisted the row — on a steady cluster "
+    "re-encode cost tracks this miss rate, not the pod population",
+    ("event",))
+ENCODE_CACHE_ROWS = REGISTRY.gauge(
+    "karpenter_tpu_encode_cache_rows",
+    "Signature rows resident across the solver's encode-cache contexts "
+    "(bounded: a small context LRU × a per-context row cap with "
+    "intern-style rotation)")
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

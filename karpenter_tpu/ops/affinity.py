@@ -49,8 +49,8 @@ import numpy as np
 from ..models import labels as L
 from ..models.pod import Pod, PodAffinityTerm
 from ..models.pod import term_selects as _selects
-from .encode import (CatalogTensors, EncodedPods, build_conflicts,
-                     feasible_zones)
+from .encode import (CatalogTensors, EncodedPods, TermMatcher,
+                     build_conflicts, feasible_zones)
 
 Occupancy = Sequence[Tuple[Optional[str], Sequence[Pod]]]
 
@@ -60,6 +60,49 @@ def _zone_terms(rep: Pod, anti: bool) -> List[PodAffinityTerm]:
             if t.anti == anti and t.required and t.topology_key == L.ZONE]
 
 
+class _OccupancyIndex:
+    """Zone-scattering wrapper over the shared columnar TermMatcher
+    (ops/encode.py — THE vectorized term_selects): the cluster's
+    resident pods flatten once into matcher columns + a zone index, and
+    each (namespace, selector) term resolves to the zones holding ≥1
+    match, memoized per distinct term. At c8 scale (thousands of
+    residents × a handful of terms) this replaces the
+    O(pods × groups × terms) Python quadruple loop that dominated the
+    affinity pre-pass."""
+
+    def __init__(self, occupancy: Occupancy, zidx: Dict[str, int], Z: int):
+        pods: List[Pod] = []
+        zones: List[int] = []
+        for zone, pods_on in occupancy:
+            zi = zidx.get(zone or "")
+            if zi is None or not pods_on:
+                continue
+            pods.extend(pods_on)
+            zones.extend([zi] * len(pods_on))
+        self.pods = pods
+        self.Z = Z
+        self.zone = np.asarray(zones, np.int32) if pods else \
+            np.zeros(0, np.int32)
+        self._matcher = TermMatcher(pods)
+        self._zmemo: Dict[tuple, np.ndarray] = {}
+
+    def zones_matching(self, term: PodAffinityTerm,
+                       namespace: str) -> Optional[np.ndarray]:
+        """bool [Z] zones holding ≥1 resident the term selects from
+        `namespace` (term_selects semantics), or None when no resident
+        matches anywhere."""
+        if not self.pods:
+            return None
+        key = (namespace, tuple(sorted(term.label_selector.items())))
+        hit = self._zmemo.get(key)
+        if hit is not None:
+            return hit if hit.any() else None
+        m = self._matcher.matches(namespace, term.label_selector)
+        out = np.zeros(self.Z, bool)
+        if m.any():
+            out[np.unique(self.zone[m])] = True
+        self._zmemo[key] = out
+        return out if out.any() else None
 
 
 def apply_zone_affinity(enc: EncodedPods, cat: CatalogTensors,
@@ -72,12 +115,16 @@ def apply_zone_affinity(enc: EncodedPods, cat: CatalogTensors,
     neg = [_zone_terms(g.representative, anti=True) for g in enc.groups]
     # residents' own zone-anti terms repel groups even when the group has
     # no terms of its own, so the fast path must also scan occupancy
-    # (once per pod — this runs every solve)
+    # (once per pod — this runs every solve; the truthiness guard keeps
+    # the common no-affinity resident at one attribute read, no list
+    # allocation)
     resident_anti = []
     for zone, pods_on in (occupancy or []):
         if zone not in cat.zones:
             continue
         for p in pods_on:
+            if not p.affinity_terms:
+                continue
             ts = _zone_terms(p, anti=True)
             if ts:
                 resident_anti.append((zone, p, ts))
@@ -103,26 +150,23 @@ def apply_zone_affinity(enc: EncodedPods, cat: CatalogTensors,
 
     # --- resident matches per group ---------------------------------------
     # pos_resident[i][k]: bool [Z] zones holding a match for term k (or None
-    # when no resident matches that term anywhere)
+    # when no resident matches that term anywhere). Matching runs through
+    # the columnar occupancy index — one interned-label pass per key,
+    # memoized per distinct (namespace, selector) term
     pos_resident: List[List[Optional[np.ndarray]]] = [
         [None] * len(ts) for ts in pos]
     anti_resident = np.zeros((G, cat.Z), bool)
-    for zone, pods_on in (occupancy or []):
-        zi = zidx.get(zone or "")
-        if zi is None or not pods_on:
-            continue
+    occ = (_OccupancyIndex(occupancy, zidx, cat.Z)
+           if occupancy and (any(pos) or any(neg)) else None)
+    if occ is not None:
         for i in range(G):
             rep = enc.groups[i].representative
             for k, t in enumerate(pos[i]):
-                if any(_selects(t, p.namespace == rep.namespace, p.labels)
-                       for p in pods_on):
-                    if pos_resident[i][k] is None:
-                        pos_resident[i][k] = np.zeros(cat.Z, bool)
-                    pos_resident[i][k][zi] = True
+                pos_resident[i][k] = occ.zones_matching(t, rep.namespace)
             for t in neg[i]:
-                if any(_selects(t, p.namespace == rep.namespace, p.labels)
-                       for p in pods_on):
-                    anti_resident[i, zi] = True
+                zs = occ.zones_matching(t, rep.namespace)
+                if zs is not None:
+                    anti_resident[i] |= zs
     for zone, p, p_terms in resident_anti:
         zi = zidx[zone]
         for i in range(G):
@@ -325,6 +369,7 @@ def _rebuild(enc: EncodedPods, allow: np.ndarray,
     n = len(rows)
     Z = allow.shape[1]
     orig = [i for i, _, _ in rows]
+    oi = np.asarray(orig, np.intp)  # one fancy-index gather per tensor
     zc = None
     if zone_conflict is not None or (self_anti is not None and self_anti.any()):
         base = (zone_conflict if zone_conflict is not None
@@ -349,25 +394,19 @@ def _rebuild(enc: EncodedPods, allow: np.ndarray,
             bool).reshape(n, Z)
     return EncodedPods(
         groups=groups,
-        requests=np.array([enc.requests[i] for i, _, _ in rows],
-                          np.float32).reshape(n, -1),
-        counts=np.array([c for _, c, _ in rows], np.int32),
-        compat=np.array([enc.compat[i] for i, _, _ in rows],
-                        bool).reshape(n, -1),
+        requests=enc.requests[oi],
+        counts=np.fromiter((c for _, c, _ in rows), np.int32, n),
+        compat=enc.compat[oi],
         allow_zone=np.array([r for _, _, r in rows], bool).reshape(n, Z),
-        allow_cap=np.array([enc.allow_cap[i] for i, _, _ in rows],
-                           bool).reshape(n, -1),
-        max_per_node=np.array([enc.max_per_node[i] for i, _, _ in rows],
-                              np.int32),
-        spread_zone=np.array([enc.spread_zone[i] for i, _, _ in rows], bool),
+        allow_cap=enc.allow_cap[oi],
+        max_per_node=enc.max_per_node[oi],
+        spread_zone=enc.spread_zone[oi],
         conflict=build_conflicts(groups),
-        spread_soft=(np.array([enc.spread_soft[i] for i, _, _ in rows], bool)
+        spread_soft=(enc.spread_soft[oi]
                      if enc.spread_soft is not None else None),
-        compat_hard=(np.array([enc.compat_hard[i] for i, _, _ in rows],
-                              bool).reshape(n, -1)
+        compat_hard=(enc.compat_hard[oi]
                      if enc.compat_hard is not None else None),
         zone_hard=hard_rows,
-        cap_hard=(np.array([enc.cap_hard[i] for i, _, _ in rows],
-                           bool).reshape(n, -1)
+        cap_hard=(enc.cap_hard[oi]
                   if enc.cap_hard is not None else None),
         zone_conflict=zc)

@@ -107,6 +107,13 @@ class CatalogTensors:
     # daemonset simulation). Zone-invariant overhead is baked into
     # `allocatable` instead (apply_daemonset_overhead). None = absent.
     zone_overhead: Optional[np.ndarray] = None
+    # encode-cache key for THIS immutable catalog view (ops/encode_cache):
+    # the facade stamps it from the (nodeclass-hash, catalog-epoch) tensor
+    # key and extends it for every derived view (block gating, daemonset
+    # overhead). None = view not cache-addressable; encode_pods then
+    # computes every row fresh. Callers that mutate tensors in place
+    # (tests poking availability holes) must clear or re-key it.
+    cache_token: Optional[tuple] = None
 
     @property
     def T(self) -> int:
@@ -313,10 +320,75 @@ class EncodedPods:
     # representative doesn't tolerate the NodePool taints) — the facade
     # reads this instead of re-scanning O(pods) for the difference
     dropped_keys: Optional[List[str]] = None
+    # encode-cache accounting for THIS encode (groups served from /
+    # inserted into the EncodeContext); zero when encoded uncached.
+    # Informational only — rebuilt encodings (affinity/spread splits)
+    # don't carry it forward
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def G(self) -> int:
         return len(self.groups)
+
+
+class TermMatcher:
+    """Columnar batch twin of models.pod.term_selects over a fixed pod
+    population: namespaces and every selector-queried label key are
+    interned to int id columns ONCE, and each (namespace, selector)
+    evaluates as one vectorized compare-and-reduce, memoized per
+    distinct term. The ONE vectorized selector implementation — both
+    the conflict-matrix build and the zone-affinity occupancy matching
+    route through it, and its semantics MUST stay identical to the
+    scalar `term_selects` oracle (same-namespace gate + full selector
+    containment; a randomized agreement test pins the pair)."""
+
+    def __init__(self, pods: Sequence[Pod]):
+        self.pods = list(pods)
+        n = len(self.pods)
+        self._ns_vocab: Dict[str, int] = {}
+        ns = np.empty(n, np.int32)
+        for j, p in enumerate(self.pods):
+            ns[j] = self._ns_vocab.setdefault(p.namespace,
+                                              len(self._ns_vocab))
+        self._ns = ns
+        self._cols: Dict[str, Tuple[np.ndarray, Dict[str, int]]] = {}
+        self._memo: Dict[tuple, np.ndarray] = {}
+
+    def _col(self, key: str) -> Tuple[np.ndarray, Dict[str, int]]:
+        hit = self._cols.get(key)
+        if hit is None:
+            vocab: Dict[str, int] = {}
+            ids = np.empty(len(self.pods), np.int32)
+            for j, p in enumerate(self.pods):
+                v = p.labels.get(key)
+                ids[j] = -1 if v is None else vocab.setdefault(v, len(vocab))
+            hit = (ids, vocab)
+            self._cols[key] = hit
+        return hit
+
+    def matches(self, namespace: str,
+                selector: Dict[str, str]) -> np.ndarray:
+        """bool [N]: pods a term with `selector`, evaluated from a pod
+        in `namespace`, selects (== term_selects per pod)."""
+        key = (namespace, tuple(sorted(selector.items())))
+        m = self._memo.get(key)
+        if m is not None:
+            return m
+        ns_id = self._ns_vocab.get(namespace)
+        if ns_id is None:
+            m = np.zeros(len(self.pods), bool)
+        else:
+            m = self._ns == ns_id
+            for k, v in selector.items():
+                if not m.any():
+                    break
+                ids, vocab = self._col(k)
+                vid = vocab.get(v)
+                m = (m & (ids == vid)) if vid is not None \
+                    else np.zeros(len(self.pods), bool)
+        self._memo[key] = m
+        return m
 
 
 def build_conflicts(groups: List[PodGroup]) -> Optional[np.ndarray]:
@@ -327,28 +399,70 @@ def build_conflicts(groups: List[PodGroup]) -> Optional[np.ndarray]:
     it, not only the other way around — so conflict[i, j] is set when
     EITHER group's term selects the other's labels (same namespace).
     Returns None when no group carries anti terms (the common case), which
-    lets every backend skip conflict tracking entirely."""
+    lets every backend skip conflict tracking entirely.
+
+    Vectorized through TermMatcher — the O(G² × terms) Python pair walk
+    was the whole re-encode cost at 2000-signature fleets."""
     G = len(groups)
     anti = [[t for t in g.representative.affinity_terms
              if t.anti and t.required and t.topology_key == L.HOSTNAME]
             for g in groups]
     if not any(anti):
         return None
-    conflict = np.zeros((G, G), bool)
+    reps = [g.representative for g in groups]
+    matcher = TermMatcher(reps)
+    per_group: Dict[int, np.ndarray] = {}  # i -> OR of its terms' matches
     for i in range(G):
-        ri = groups[i].representative
-        for j in range(i + 1, G):
-            rj = groups[j].representative
-            same_ns = ri.namespace == rj.namespace
-            hit = (any(term_selects(t, same_ns, rj.labels) for t in anti[i])
-                   or any(term_selects(t, same_ns, ri.labels)
-                          for t in anti[j]))
-            if hit:
-                conflict[i, j] = conflict[j, i] = True
+        for t in anti[i]:
+            m = matcher.matches(reps[i].namespace, t.label_selector)
+            prev = per_group.get(i)
+            per_group[i] = m if prev is None else (prev | m)
+    if not per_group:
+        return None
+    # both symmetry directions land as two batched ORs (idx is unique, so
+    # the fancy-index read-modify-write is safe) — per-term strided
+    # column writes were the scaling wall at 2000-signature fleets
+    conflict = np.zeros((G, G), bool)
+    idx = np.fromiter(per_group.keys(), np.intp, len(per_group))
+    M = np.stack([per_group[int(i)] for i in idx])
+    conflict[idx] |= M
+    conflict[:, idx] |= M.T
+    np.fill_diagonal(conflict, False)
     return conflict if conflict.any() else None
 
 
-def _allowed_vector(vs: ValueSet, vocab: Dict[str, int]) -> np.ndarray:
+def _vocab_values(cat: CatalogTensors, key: str) -> np.ndarray:
+    """Unicode array of a key's vocab values ordered by id, memoized on
+    the CatalogTensors instance — the columnar side of the set-algebra
+    lowering (one np.isin pass replaces per-value ValueSet.contains)."""
+    memo = getattr(cat, "_vocab_arrays", None)
+    if memo is None:
+        memo = {}
+        cat._vocab_arrays = memo
+    arr = memo.get(key)
+    if arr is None:
+        vocab = cat.vocab[key]
+        arr = np.empty(len(vocab), dtype=object)
+        for v, i in vocab.items():
+            arr[i] = v
+        arr = arr.astype(str) if len(vocab) else np.empty(0, dtype="<U1")
+        memo[key] = arr
+    return arr
+
+
+def _allowed_vector(vs: ValueSet, vocab: Dict[str, int],
+                    cat: Optional[CatalogTensors] = None,
+                    key: Optional[str] = None) -> np.ndarray:
+    if (cat is not None and key is not None
+            and vs.gt is None and vs.lt is None and not vs.dne):
+        # vectorized membership over the memoized id-ordered value array;
+        # bounds/DoesNotExist fall through to the exact scalar oracle
+        arr = _vocab_values(cat, key)
+        if not vs.values:
+            base = np.zeros(len(arr), bool)
+        else:
+            base = np.isin(arr, tuple(vs.values))
+        return ~base if vs.complement else base
     out = np.zeros(len(vocab), bool)
     for v, i in vocab.items():
         out[i] = vs.contains(v)
@@ -392,7 +506,7 @@ def _key_mask(vs: ValueSet, key: str, cat: CatalogTensors,
 def _categorical_mask(vs: ValueSet, key: str, cat: CatalogTensors,
                       handle_absent: bool = True) -> np.ndarray:
     ids = cat.label_val[:, cat.label_keys.index(key)]
-    allowed = _allowed_vector(vs, cat.vocab[key])
+    allowed = _allowed_vector(vs, cat.vocab[key], cat, key)
     mask = np.where(ids >= 0, allowed[np.clip(ids, 0, None)], False)
     if handle_absent:
         mask = np.where(ids == ABSENT, _tolerates_absence(vs), mask)
@@ -419,11 +533,82 @@ def _axis_allow(reqs: Requirements, key: str, axis_values: Sequence[str]) -> np.
     return np.array([vs.contains(v) for v in axis_values], bool)
 
 
+@dataclass
+class _Row:
+    """One signature's tensor row — the pure function of
+    (constraint_signature, catalog view, pool context) the EncodeContext
+    persists. `differs_*` record whether preferred-affinity narrowing
+    changed each axis (they reproduce the batch-level
+    `(hard != work).any()` hard-rows-or-None decision on gather)."""
+    compat: np.ndarray
+    zone: np.ndarray
+    capm: np.ndarray
+    hard_t: np.ndarray
+    hard_z: np.ndarray
+    hard_c: np.ndarray
+    req: np.ndarray
+    max_per_node: int
+    spread_zone: bool
+    spread_soft: bool
+    differs_t: bool
+    differs_z: bool
+    differs_c: bool
+
+
+def _group_row(rep: Pod, cat: CatalogTensors,
+               extra_requirements: Optional[Requirements],
+               template_labels: Optional[Dict[str, str]],
+               exotic: Optional[np.ndarray],
+               raw_vec, R: int) -> _Row:
+    reqs = rep.scheduling_requirements()
+    if extra_requirements is not None:
+        reqs = reqs.union_with(extra_requirements)
+    compat = compat_mask(reqs, cat, template_labels)
+    if exotic is not None and not wants_exotic(rep, reqs):
+        compat &= ~exotic
+    zone = _axis_allow(reqs, L.ZONE, cat.zones)
+    capm = _axis_allow(reqs, L.CAPACITY_TYPE, cat.captypes)
+    req = np.zeros(R, np.float32)
+    req[: len(raw_vec)] = raw_vec
+    hard_t, hard_z, hard_c = compat, zone, capm  # pre-preference rows
+    narrowed = _apply_preferred(rep, compat, zone, capm, req, cat,
+                                template_labels)
+    if narrowed is not None:
+        compat, zone, capm = narrowed  # fresh arrays; hard_* keep originals
+    max_per_node = 1 if rep.has_self_anti_affinity() else 0
+    spread_zone = False
+    any_hard_zone = False
+    for tsc in rep.topology_spread:
+        if tsc.topology_key == L.ZONE:
+            spread_zone = True
+            if tsc.when_unsatisfiable == "DoNotSchedule":
+                any_hard_zone = True
+        if tsc.topology_key == L.HOSTNAME and tsc.when_unsatisfiable == "DoNotSchedule":
+            # Conservative encoding of hostname maxSkew as a per-node
+            # cap: while any eligible node has zero matching pods (always
+            # true the moment the provisioner opens a fresh node), skew =
+            # max-count − 0, so count per node may not exceed maxSkew.
+            # This can over-spread relative to a cluster with no empty
+            # eligible nodes (where k8s would allow denser layouts) but
+            # never violates the constraint.
+            cap = max(1, tsc.max_skew)
+            max_per_node = cap if max_per_node == 0 else min(max_per_node, cap)
+    return _Row(
+        compat=compat, zone=zone, capm=capm,
+        hard_t=hard_t, hard_z=hard_z, hard_c=hard_c, req=req,
+        max_per_node=max_per_node, spread_zone=spread_zone,
+        spread_soft=spread_zone and not any_hard_zone,
+        differs_t=compat is not hard_t and bool((compat != hard_t).any()),
+        differs_z=zone is not hard_z and bool((zone != hard_z).any()),
+        differs_c=capm is not hard_c and bool((capm != hard_c).any()))
+
+
 def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
                 extra_requirements: Optional[Requirements] = None,
                 taints: Optional[List[Taint]] = None,
                 pregrouped: Optional[Sequence[Sequence[Pod]]] = None,
                 template_labels: Optional[Dict[str, str]] = None,
+                cache=None, arena=None,
                 ) -> EncodedPods:
     """Group + tensorize pods against a catalog.
 
@@ -438,85 +623,178 @@ def encode_pods(pods: Sequence[Pod], cat: CatalogTensors,
     pregrouped: optional pre-bucketed signature-equal pod lists (the
     store's admission-time pending-group index) — skips the per-pod
     grouping pass entirely; `pods` is then ignored for grouping.
+
+    cache: an ops.encode_cache.EncodeContext for this exact
+    (catalog view, extra_requirements, taints, template) combination —
+    per-signature rows persist across solves and a warm re-encode
+    becomes one gather. The caller owns the keying contract (the facade
+    derives it from CatalogTensors.cache_token); rows returned are
+    never aliased into the cache, so downstream in-place narrowing
+    stays private to this encode.
+
+    arena: an ops.encode_cache.EncodeArena supplying reusable staging
+    buffers. Arrays in the returned EncodedPods are then valid only
+    until the next encode that leases the same arena.
     """
     groups = (groups_from_lists(pregrouped) if pregrouped is not None
               else group_pods(pods))
+    lease = arena is not None and arena.acquire()
+    try:
+        return _encode_groups(groups, cat, extra_requirements, taints,
+                              template_labels, cache,
+                              arena if lease else None)
+    finally:
+        if lease:
+            arena.release()
+
+
+def _take(arena, name, shape, dtype, zero=False):
+    if arena is not None:
+        return arena.take(name, shape, dtype, zero=zero)
+    return np.zeros(shape, dtype) if zero else np.empty(shape, dtype)
+
+
+def _encode_groups(groups: List[PodGroup], cat: CatalogTensors,
+                   extra_requirements, taints, template_labels,
+                   cache, arena) -> EncodedPods:
+    from .encode_cache import DROPPED
     dropped_keys: List[str] = []
+    hits = misses = 0
+
+    if cache is not None:
+        # --- cached path: lookup per signature, compute only the misses,
+        # then ONE vectorized gather over the context's columnar rows ---
+        cache.begin()  # batch boundary: a full row store rotates here
+        kept: List[PodGroup] = []
+        row_ids: List[Optional[int]] = []
+        pend: List[Tuple[int, PodGroup, tuple]] = []  # (kept-slot, g, sig)
+        for g in groups:
+            sig = g.representative.constraint_signature()
+            rid = cache.lookup(sig)
+            if rid is None:
+                misses += 1
+                if taints and not tolerates_all(
+                        g.representative.tolerations, taints):
+                    cache.insert_dropped(sig)
+                    dropped_keys.extend(f"{p.namespace}/{p.name}"
+                                        for p in g.pods)
+                    continue
+                pend.append((len(kept), g, sig))
+                kept.append(g)
+                row_ids.append(None)
+            elif rid == DROPPED:
+                hits += 1
+                dropped_keys.extend(f"{p.namespace}/{p.name}"
+                                    for p in g.pods)
+            else:
+                hits += 1
+                kept.append(g)
+                row_ids.append(rid)
+        # settle the resource axis BEFORE computing rows: to_vector may
+        # auto-register custom resources (cached reps registered theirs
+        # at first encode — the axis only grows within a process)
+        pend_vecs = [g.representative.requests.to_vector()
+                     for _, g, _ in pend]
+        R = num_resources()
+        if pend:
+            exotic = exotic_mask(cat)
+            exotic = exotic if exotic.any() else None
+            for (slot, g, sig), vec in zip(pend, pend_vecs):
+                row = _group_row(g.representative, cat, extra_requirements,
+                                 template_labels, exotic, vec, R)
+                row_ids[slot] = cache.insert(sig, row)
+        cache.stats["hits"] += hits
+        cache.stats["misses"] += misses
+        groups = kept
+        G = len(groups)
+        if G == 0:
+            enc = EncodedPods(
+                groups=[], requests=np.zeros((0, R), np.float32),
+                counts=np.zeros(0, np.int32),
+                compat=np.zeros((0, cat.T), bool),
+                allow_zone=np.zeros((0, cat.Z), bool),
+                allow_cap=np.zeros((0, cat.C), bool),
+                max_per_node=np.zeros(0, np.int32),
+                spread_zone=np.zeros(0, bool),
+                spread_soft=np.zeros(0, bool),
+                dropped_keys=dropped_keys or None)
+        else:
+            got = cache.gather(row_ids, R, arena)
+            counts = np.fromiter((g.count for g in groups), np.int32, G)
+            gs = groups  # bind for the memo-miss builder
+            conflict = cache.conflicts(tuple(row_ids),
+                                       lambda: build_conflicts(gs))
+            enc = EncodedPods(groups=groups, counts=counts,
+                              conflict=conflict,
+                              dropped_keys=dropped_keys or None, **got)
+        enc.cache_hits, enc.cache_misses = hits, misses
+        _meter_cache(hits, misses)
+        return enc
+
+    # --- cold path: every row computed fresh (identical bytes to the
+    # cached path by construction — both run _group_row) ---
     if taints:
-        kept = []
+        filtered = []
         for g in groups:
             if tolerates_all(g.representative.tolerations, taints):
-                kept.append(g)
+                filtered.append(g)
             else:
                 dropped_keys.extend(f"{p.namespace}/{p.name}"
                                     for p in g.pods)
-        groups = kept
+        groups = filtered
 
     req_vecs = [g.representative.requests.to_vector() for g in groups]
     R = num_resources()
     G = len(groups)
-    requests = np.zeros((G, R), np.float32)
-    for i, v in enumerate(req_vecs):
-        requests[i, : len(v)] = v
-
-    counts = np.array([g.count for g in groups], np.int32) if G else np.zeros(0, np.int32)
-    compat = np.ones((G, cat.T), bool)
-    allow_zone = np.ones((G, cat.Z), bool)
-    allow_cap = np.ones((G, cat.C), bool)
+    requests = _take(arena, "requests", (G, R), np.float32, zero=True)
+    counts = (np.fromiter((g.count for g in groups), np.int32, G)
+              if G else np.zeros(0, np.int32))
+    compat = _take(arena, "compat", (G, cat.T), bool)
+    allow_zone = _take(arena, "zone", (G, cat.Z), bool)
+    allow_cap = _take(arena, "capm", (G, cat.C), bool)
     max_per_node = np.zeros(G, np.int32)
     spread_zone = np.zeros(G, bool)
-
     spread_soft = np.zeros(G, bool)
-    hard = np.ones((G, cat.T), bool)
-    hard_z = np.ones((G, cat.Z), bool)
-    hard_c = np.ones((G, cat.C), bool)
+    hard = _take(arena, "hard_t", (G, cat.T), bool)
+    hard_z = _take(arena, "hard_z", (G, cat.Z), bool)
+    hard_c = _take(arena, "hard_c", (G, cat.C), bool)
+    any_dt = any_dz = any_dc = False
 
     exotic = exotic_mask(cat)
+    exotic = exotic if exotic.any() else None
     for i, g in enumerate(groups):
-        reqs = g.representative.scheduling_requirements()
-        if extra_requirements is not None:
-            reqs = reqs.union_with(extra_requirements)
-        compat[i] = compat_mask(reqs, cat, template_labels)
-        if exotic.any() and not wants_exotic(g.representative, reqs):
-            compat[i] &= ~exotic
-        allow_zone[i] = _axis_allow(reqs, L.ZONE, cat.zones)
-        allow_cap[i] = _axis_allow(reqs, L.CAPACITY_TYPE, cat.captypes)
-        hard[i] = compat[i]
-        hard_z[i] = allow_zone[i]
-        hard_c[i] = allow_cap[i]
-        narrowed = _apply_preferred(g.representative, compat[i],
-                                    allow_zone[i], allow_cap[i],
-                                    requests[i], cat, template_labels)
-        if narrowed is not None:
-            compat[i], allow_zone[i], allow_cap[i] = narrowed
-        if g.representative.has_self_anti_affinity():
-            max_per_node[i] = 1
-        any_hard_zone = False
-        for tsc in g.representative.topology_spread:
-            if tsc.topology_key == L.ZONE:
-                spread_zone[i] = True
-                if tsc.when_unsatisfiable == "DoNotSchedule":
-                    any_hard_zone = True
-            if tsc.topology_key == L.HOSTNAME and tsc.when_unsatisfiable == "DoNotSchedule":
-                # Conservative encoding of hostname maxSkew as a per-node
-                # cap: while any eligible node has zero matching pods (always
-                # true the moment the provisioner opens a fresh node), skew =
-                # max-count − 0, so count per node may not exceed maxSkew.
-                # This can over-spread relative to a cluster with no empty
-                # eligible nodes (where k8s would allow denser layouts) but
-                # never violates the constraint.
-                cap = max(1, tsc.max_skew)
-                max_per_node[i] = cap if max_per_node[i] == 0 else min(max_per_node[i], cap)
-        spread_soft[i] = spread_zone[i] and not any_hard_zone
+        row = _group_row(g.representative, cat, extra_requirements,
+                         template_labels, exotic, req_vecs[i], R)
+        requests[i] = row.req
+        compat[i] = row.compat
+        allow_zone[i] = row.zone
+        allow_cap[i] = row.capm
+        hard[i] = row.hard_t
+        hard_z[i] = row.hard_z
+        hard_c[i] = row.hard_c
+        max_per_node[i] = row.max_per_node
+        spread_zone[i] = row.spread_zone
+        spread_soft[i] = row.spread_soft
+        any_dt |= row.differs_t
+        any_dz |= row.differs_z
+        any_dc |= row.differs_c
 
     return EncodedPods(groups=groups, requests=requests, counts=counts,
                        compat=compat, allow_zone=allow_zone, allow_cap=allow_cap,
                        max_per_node=max_per_node, spread_zone=spread_zone,
                        conflict=build_conflicts(groups), spread_soft=spread_soft,
-                       compat_hard=hard if (hard != compat).any() else None,
-                       zone_hard=hard_z if (hard_z != allow_zone).any() else None,
-                       cap_hard=hard_c if (hard_c != allow_cap).any() else None,
+                       compat_hard=hard if any_dt else None,
+                       zone_hard=hard_z if any_dz else None,
+                       cap_hard=hard_c if any_dc else None,
                        dropped_keys=dropped_keys or None)
+
+
+def _meter_cache(hits: int, misses: int) -> None:
+    from ..metrics import ENCODE_CACHE
+    if hits:
+        ENCODE_CACHE.inc(hits, event="hit")
+    if misses:
+        ENCODE_CACHE.inc(misses, event="miss")
 
 
 def _apply_preferred(rep: Pod, compat_row: np.ndarray, zone_row: np.ndarray,

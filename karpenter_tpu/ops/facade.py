@@ -130,7 +130,20 @@ def apply_daemonset_overhead(cat: CatalogTensors, daemonsets,
     from dataclasses import replace as _dc_replace
     alloc = (np.maximum(cat.allocatable - base, 0.0)
              if base is not None else cat.allocatable)
-    return _dc_replace(cat, allocatable=alloc, zone_overhead=zvar)
+    # derived view → derived encode-cache token: the overhead bytes pin
+    # the view's identity. A content DIGEST, not Python hash(): the
+    # digest is the only part of the token carrying this identity, so a
+    # collision would silently alias two different allocatable views
+    # onto one EncodeContext — blake2b makes that a non-event
+    token = None
+    if cat.cache_token is not None:
+        import hashlib
+        h = hashlib.blake2b(digest_size=16)
+        h.update(base.tobytes() if base is not None else b"-")
+        h.update(zvar.tobytes() if zvar is not None else b"-")
+        token = cat.cache_token + ("ds", h.hexdigest())
+    return _dc_replace(cat, allocatable=alloc, zone_overhead=zvar,
+                       cache_token=token)
 
 
 def targets_reserved(requirements: Optional[Requirements]) -> bool:
@@ -191,9 +204,16 @@ class Solver:
     # solves native/host and reserves the TPU for the large ones
     DEVICE_MIN_PODS = 4096
 
+    # encoded-catalog views kept warm (LRU): clusters alternating a few
+    # NodeClass views per reconcile must not re-encode the catalog (and
+    # re-upload device tensors) on every flip — a single-slot cache
+    # thrashed exactly that way
+    CAT_CACHE_SIZE = 4
+
     def __init__(self, catalog: CatalogProvider, backend: str = "auto",
                  device_min_pods: Optional[int] = None,
-                 profile_dir: str = ""):
+                 profile_dir: str = "", encode_cache: bool = True):
+        from collections import OrderedDict
         self.catalog = catalog
         self.device_min_pods = (self.DEVICE_MIN_PODS if device_min_pods is None
                                 else device_min_pods)
@@ -202,9 +222,14 @@ class Solver:
         if backend == "auto":
             backend = self._detect_backend()
         self.backend = backend
-        self._cat_cache: Dict[tuple, CatalogTensors] = {}
+        self._cat_cache: "OrderedDict[tuple, CatalogTensors]" = OrderedDict()
         self._dcat_cache: Dict[tuple, object] = {}  # device-resident tensors
         self._last_cat_key: tuple = ()
+        # columnar encode pipeline (ops/encode_cache): per-signature rows
+        # persist across solves, staged through one reusable arena
+        from .encode_cache import EncodeArena, EncodeCache
+        self._encode_cache = EncodeCache() if encode_cache else None
+        self._arena = EncodeArena()
         self._mesh_obj = _MESH_UNSET
         # degraded mode: >0 while device/mesh dispatches are rerouted to
         # the fallback backend after a mid-solve device fault; decremented
@@ -324,17 +349,32 @@ class Solver:
 
     def tensors(self, node_class: Optional[NodeClassSpec] = None) -> CatalogTensors:
         nc = node_class or NodeClassSpec()
+        # hydrate BEFORE keying: the first raw-catalog pull bumps the
+        # epoch (pricing hydration), and a key computed pre-pull would
+        # cache the first view under a token no later solve reproduces
+        self.catalog.raw_types()
         key = (nc.hash(),) + tuple(self.catalog.epoch)
         hit = self._cat_cache.get(key)
         if hit is None:
             types = self.catalog.list(nc)
             hit = encode_catalog(types)
-            self._cat_cache.clear()  # one epoch's views at a time
+            hit.cache_token = key  # encode-cache lineage for derived views
             self._cat_cache[key] = hit
+            # small LRU, not single-slot: two NodeClass views alternating
+            # each reconcile must both stay resident (a clear-on-new-key
+            # policy re-encoded — and re-uploaded — on every flip); the
+            # evicted view's device-resident variants go with it
+            while len(self._cat_cache) > self.CAT_CACHE_SIZE:
+                old_key, _ = self._cat_cache.popitem(last=False)
+                for k in [k for k in self._dcat_cache
+                          if k[: len(old_key)] == old_key]:
+                    del self._dcat_cache[k]
             # availability-tensor rebuild counter: chaos tests assert an
             # ICE mark re-keys this (and the device upload cache) exactly
             # once per epoch change, not once per solve
             self.stats["catalog_rebuilds"] += 1
+        else:
+            self._cat_cache.move_to_end(key)
         self._last_cat_key = key
         return hit
 
@@ -374,7 +414,10 @@ class Solver:
         if (_gate_blocks and cat.is_block is not None and cat.is_block.any()
                 and not targets_reserved(nodepool.requirements)):
             from dataclasses import replace as _dc_replace
-            cat = _dc_replace(cat, available=cat.available & ~cat.is_block)
+            cat = _dc_replace(cat, available=cat.available & ~cat.is_block,
+                              cache_token=(cat.cache_token + ("noblocks",)
+                                           if cat.cache_token is not None
+                                           else None))
             blocks_gated = True
         all_pods = pods  # reference, captured before the colocation path
         # rebinds the local; only read if the reserved retry fires
@@ -455,16 +498,32 @@ class Solver:
                 return self._retry_reserved_unschedulable(
                     out, blocks_gated, all_pods, nodepool, node_class,
                     spread_occupancy, daemonsets)
+        taints = nodepool.taints + nodepool.startup_taints
+        enc_ctx = (self._encode_cache.context_for(
+                       cat, nodepool.requirements, taints, template)
+                   if self._encode_cache is not None else None)
         sp = (TRACER.span("solve.encode", pods=len(pods),
                           pregrouped=pregrouped is not None)
               if TRACER.enabled else NOOP_SPAN)
         with sp:
-            enc = encode_pods(pods, cat,
-                              extra_requirements=nodepool.requirements,
-                              taints=nodepool.taints + nodepool.startup_taints,
-                              pregrouped=pregrouped,
-                              template_labels=template)
+            lsp = (TRACER.span("encode.lower") if TRACER.enabled
+                   else NOOP_SPAN)
+            with lsp:
+                enc = encode_pods(pods, cat,
+                                  extra_requirements=nodepool.requirements,
+                                  taints=taints,
+                                  pregrouped=pregrouped,
+                                  template_labels=template,
+                                  cache=enc_ctx, arena=self._arena)
+                lsp.set(groups=int(enc.G), cache_hits=enc.cache_hits,
+                        cache_misses=enc.cache_misses)
+            if TRACER.enabled and enc.cache_hits:
+                # a dedicated marker span so the flight recorder can
+                # attribute a fast encode to the gather path at a glance
+                with TRACER.span("encode.cache_hit", rows=enc.cache_hits):
+                    pass
             sp.set(groups=int(enc.G))
+        self._meter_encode_rows(enc_ctx)
         if fits_cap is not None:
             enc.compat &= fits_cap[None, :]
             if enc.compat_hard is not None:
@@ -485,7 +544,10 @@ class Solver:
                     for name, placed in plan.existing_placements.items()]
         sp = (TRACER.span("solve.spread") if TRACER.enabled else NOOP_SPAN)
         with sp:
-            enc = apply_zone_affinity(enc, cat, occupancy)
+            asp = (TRACER.span("encode.affinity") if TRACER.enabled
+                   else NOOP_SPAN)
+            with asp:
+                enc = apply_zone_affinity(enc, cat, occupancy)
             enc = split_spread_groups(
                 enc, cat, self._spread_constraints(enc, cat, occupancy))
             sp.set(groups=int(enc.G))
@@ -530,14 +592,14 @@ class Solver:
                                                  blocks_gated, ds_fp)
                     dcat = self._dcat_cache.get(dkey)
                     if dcat is None:
-                        # one EPOCH resident at a time — but every variant
-                        # of the current epoch (both block-gating states,
-                        # mesh vs single) may stay, or mixed pools would
-                        # thrash a full host→device transfer on every
-                        # alternate solve
-                        prefix = self._last_cat_key
+                        # device residency follows the host LRU: every
+                        # variant (block-gating states, mesh vs single)
+                        # of any CACHED catalog view may stay — mixed
+                        # pools and alternating NodeClasses must not
+                        # thrash a full host→device transfer per solve
+                        n = len(self._last_cat_key)
                         for k in [k for k in self._dcat_cache
-                                  if k[:len(prefix)] != prefix]:
+                                  if k[:n] not in self._cat_cache]:
                             del self._dcat_cache[k]
                         dcat = device_catalog(cat, R, mesh=mesh)
                         self._dcat_cache[dkey] = dcat
@@ -598,6 +660,14 @@ class Solver:
                              if k not in retried] + second.unschedulable
         return out
 
+    def _meter_encode_rows(self, enc_ctx) -> None:
+        """Refresh the resident-rows gauge after ANY cached encode —
+        warm-path admissions dominate steady state, so solve()-only
+        updates would report hours-stale residency there."""
+        if enc_ctx is not None:
+            from ..metrics import ENCODE_CACHE_ROWS
+            ENCODE_CACHE_ROWS.set(float(self._encode_cache.resident_rows))
+
     # --- warm-path seam ---------------------------------------------------
     # The warm-path subsystem (karpenter_tpu/warmpath/) admits arrival-only
     # reconciles against a standing headroom ledger instead of paying a
@@ -620,7 +690,12 @@ class Solver:
         if (cat.is_block is not None and cat.is_block.any()
                 and not targets_reserved(nodepool.requirements)):
             from dataclasses import replace as _dc_replace
-            cat = _dc_replace(cat, available=cat.available & ~cat.is_block)
+            # same token suffix as solve()'s gate — warm and cold paths
+            # share one encode context per (pool, class) view
+            cat = _dc_replace(cat, available=cat.available & ~cat.is_block,
+                              cache_token=(cat.cache_token + ("noblocks",)
+                                           if cat.cache_token is not None
+                                           else None))
         if daemonsets:
             cat = apply_daemonset_overhead(cat, daemonsets, nodepool,
                                            nodepool.template_labels())
@@ -639,14 +714,28 @@ class Solver:
         EncodedPods.dropped_keys (they fall through to the next pool, as
         in the cold path)."""
         template = nodepool.template_labels()
-        enc = encode_pods([p for g in pregrouped for p in g], cat,
-                          extra_requirements=nodepool.requirements,
-                          taints=nodepool.taints + nodepool.startup_taints,
-                          pregrouped=pregrouped,
-                          template_labels=template)
+        taints = nodepool.taints + nodepool.startup_taints
+        enc_ctx = (self._encode_cache.context_for(
+                       cat, nodepool.requirements, taints, template)
+                   if self._encode_cache is not None else None)
+        lsp = (TRACER.span("encode.lower", warm=True) if TRACER.enabled
+               else NOOP_SPAN)
+        with lsp:
+            enc = encode_pods([p for g in pregrouped for p in g], cat,
+                              extra_requirements=nodepool.requirements,
+                              taints=taints,
+                              pregrouped=pregrouped,
+                              template_labels=template,
+                              cache=enc_ctx, arena=self._arena)
+            lsp.set(groups=int(enc.G), cache_hits=enc.cache_hits,
+                    cache_misses=enc.cache_misses)
+        self._meter_encode_rows(enc_ctx)
         self._apply_min_values_caps(enc, cat, nodepool.requirements)
         dropped = enc.dropped_keys  # split_spread_groups rebuilds the enc
-        enc = apply_zone_affinity(enc, cat, occupancy)
+        asp = (TRACER.span("encode.affinity", warm=True) if TRACER.enabled
+               else NOOP_SPAN)
+        with asp:
+            enc = apply_zone_affinity(enc, cat, occupancy)
         enc = split_spread_groups(
             enc, cat, self._spread_constraints(enc, cat, occupancy))
         enc.dropped_keys = dropped

@@ -1,0 +1,302 @@
+"""Columnar encode pipeline: signature-keyed compat cache, staging
+arena, and the catalog-tensor LRU.
+
+The cache's one hard contract — cached and cold encodes are
+byte-identical — is swept by tests/test_solver_fuzz.py's parity fuzz;
+this file pins the machinery: keying/invalidation riding the catalog
+epoch, the context LRU, taint-drop caching, row rotation, arena lease
+semantics, and the tensors() LRU that replaced the single-slot
+clear-on-new-key policy (two NodeClass views alternating per reconcile
+must not rebuild — and re-upload — every flip).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.catalog import (CatalogProvider, GeneratorConfig,
+                                   generate_catalog, small_catalog)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodeClassSpec, NodePool
+from karpenter_tpu.models.pod import Pod, Taint, Toleration
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+from karpenter_tpu.ops.encode_cache import (EncodeArena, EncodeCache,
+                                            requirements_token)
+from karpenter_tpu.ops.facade import Solver
+
+
+def mk_pod(name, cpu="500m", mem="1Gi", **kw):
+    return Pod(name=name,
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def _cat(token=("t",)):
+    cat = encode_catalog(small_catalog())
+    cat.cache_token = token
+    return cat
+
+
+class TestEncodeCache:
+    def test_second_encode_is_all_hits(self):
+        cat = _cat()
+        cache = EncodeCache()
+        ctx = cache.context_for(cat)
+        pods = [mk_pod(f"a{i}") for i in range(20)] + \
+               [mk_pod(f"b{i}", cpu="2") for i in range(10)]
+        e1 = encode_pods(pods, cat, cache=ctx)
+        assert (e1.cache_hits, e1.cache_misses) == (0, 2)
+        e2 = encode_pods(pods, cat, cache=ctx)
+        assert (e2.cache_hits, e2.cache_misses) == (2, 0)
+        for f in ("requests", "compat", "allow_zone", "allow_cap",
+                  "max_per_node", "counts"):
+            assert getattr(e1, f).tobytes() == getattr(e2, f).tobytes(), f
+
+    def test_cached_rows_never_alias_the_returned_arrays(self):
+        cat = _cat()
+        ctx = EncodeCache().context_for(cat)
+        pods = [mk_pod(f"p{i}") for i in range(4)]
+        e1 = encode_pods(pods, cat, cache=ctx)
+        e1.compat[:] = False  # downstream narrowing (fits_cap, limits)
+        e1.allow_zone[:] = False
+        e2 = encode_pods(pods, cat, cache=ctx)
+        assert e2.compat.any(), "in-place narrowing leaked into the cache"
+        assert e2.allow_zone.any()
+
+    def test_token_change_is_a_fresh_context(self):
+        cache = EncodeCache()
+        pods = [mk_pod("p")]
+        e1 = encode_pods(pods, _cat(("epoch", 1)),
+                         cache=cache.context_for(_cat(("epoch", 1))))
+        e2 = encode_pods(pods, _cat(("epoch", 2)),
+                         cache=cache.context_for(_cat(("epoch", 2))))
+        assert e1.cache_misses == 1 and e2.cache_misses == 1
+        # returning to epoch 1's context hits again (LRU keeps it warm)
+        e3 = encode_pods(pods, _cat(("epoch", 1)),
+                         cache=cache.context_for(_cat(("epoch", 1))))
+        assert e3.cache_hits == 1
+
+    def test_pool_context_partitions_rows(self):
+        """Same signature under different pool requirements must not
+        share rows — the NodePool requirements enter every compat row."""
+        from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                                       Requirements)
+        cat = _cat()
+        cache = EncodeCache()
+        pods = [mk_pod("p")]
+        wide = encode_pods(pods, cat, cache=cache.context_for(cat))
+        narrow_reqs = Requirements(
+            Requirement(L.INSTANCE_FAMILY, Operator.IN, ("m5",)))
+        narrow = encode_pods(pods, cat, extra_requirements=narrow_reqs,
+                             cache=cache.context_for(
+                                 cat, extra_requirements=narrow_reqs))
+        assert narrow.compat.sum() < wide.compat.sum()
+
+    def test_taint_drop_verdict_cached(self):
+        cat = _cat()
+        taints = [Taint(key="dedicated", value="ml", effect="NoSchedule")]
+        cache = EncodeCache()
+        ctx = cache.context_for(cat, taints=taints)
+        pods = [mk_pod("plain"),
+                mk_pod("tol", tolerations=[
+                    Toleration(key="dedicated", operator="Exists")])]
+        e1 = encode_pods(pods, cat, taints=taints, cache=ctx)
+        assert e1.G == 1 and e1.dropped_keys == ["default/plain"]
+        e2 = encode_pods(pods, cat, taints=taints, cache=ctx)
+        assert e2.G == 1 and e2.dropped_keys == ["default/plain"]
+        assert e2.cache_hits == 2 and e2.cache_misses == 0
+
+    def test_row_rotation_recovers(self):
+        cat = _cat()
+        ctx = EncodeCache().context_for(cat)
+        ctx.max_rows = 4
+        for batch in range(3):
+            # distinct requests per batch → distinct signatures → the
+            # tiny row cap must rotate, and encoding must still succeed
+            # (oddball millicpu values so no other test's signatures
+            # interact with this one through the process-global intern)
+            pods = [mk_pod(f"r{batch}-{i}",
+                           cpu=f"{611 + 7 * (i + 3 * batch)}m")
+                    for i in range(3)]
+            enc = encode_pods(pods, cat, cache=ctx)
+            assert enc.G == 3  # rotation never loses groups
+        assert ctx.stats["rotations"] >= 1
+
+    def test_context_lru_bounded(self):
+        cache = EncodeCache(max_contexts=2)
+        for e in range(5):
+            cat = _cat(("epoch", e))
+            encode_pods([mk_pod("p")], cat, cache=cache.context_for(cat))
+        assert len(cache._ctxs) == 2
+        assert cache.stats["evictions"] == 3
+
+    def test_requirements_token_orders_keys(self):
+        from karpenter_tpu.models.requirements import (Operator, Requirement,
+                                                       Requirements)
+        a = Requirements(Requirement("x", Operator.IN, ("1",)),
+                         Requirement("y", Operator.IN, ("2",)))
+        b = Requirements(Requirement("y", Operator.IN, ("2",)),
+                         Requirement("x", Operator.IN, ("1",)))
+        assert requirements_token(a) == requirements_token(b)
+        assert requirements_token(None) is None
+
+
+class TestTermMatcher:
+    def test_agrees_with_term_selects_oracle(self):
+        """The columnar TermMatcher is THE vectorized selector — it must
+        agree with the scalar term_selects oracle on every (pod, term)
+        pair across a randomized population (namespaces, partial labels,
+        empty selectors, unknown keys/values)."""
+        import random
+        from karpenter_tpu.models.pod import (PodAffinityTerm, term_selects)
+        from karpenter_tpu.ops.encode import TermMatcher
+        rng = random.Random(0xE17C0DE)
+        keys = ["app", "tier", "zone-group", "absent-key"]
+        vals = ["a", "b", "c"]
+        pods = []
+        for i in range(200):
+            labels = {k: rng.choice(vals) for k in keys[:3]
+                      if rng.random() < 0.7}
+            pods.append(Pod(name=f"tm-{i}",
+                            namespace=rng.choice(["default", "team-a",
+                                                  "team-b"]),
+                            labels=labels))
+        matcher = TermMatcher(pods)
+        terms = [PodAffinityTerm(topology_key="kubernetes.io/hostname",
+                                 label_selector=sel, anti=True)
+                 for sel in ({}, {"app": "a"}, {"app": "a", "tier": "b"},
+                             {"absent-key": "a"}, {"app": "zzz"},
+                             {"tier": "c", "zone-group": "a"})]
+        for ns in ("default", "team-a", "never-seen"):
+            for t in terms:
+                got = matcher.matches(ns, t.label_selector)
+                for j, p in enumerate(pods):
+                    want = term_selects(t, p.namespace == ns, p.labels)
+                    assert bool(got[j]) == want, (
+                        f"ns={ns} sel={t.label_selector} pod={p.labels}"
+                        f"/{p.namespace}")
+
+
+class TestEncodeArena:
+    def test_buffers_reused_across_encodes(self):
+        cat = _cat()
+        arena = EncodeArena()
+        pods = [mk_pod(f"p{i}") for i in range(10)]
+        e1 = encode_pods(pods, cat, arena=arena)
+        ptr1 = e1.compat.__array_interface__["data"][0]
+        e2 = encode_pods(pods, cat, arena=arena)
+        ptr2 = e2.compat.__array_interface__["data"][0]
+        assert ptr1 == ptr2, "staging buffer was reallocated"
+        assert not arena._leased
+
+    def test_nested_lease_bypasses(self):
+        arena = EncodeArena()
+        assert arena.acquire()
+        try:
+            # a nested encode (reserved-capacity retry) must not share
+            # the leased buffers
+            cat = _cat()
+            enc = encode_pods([mk_pod("p")], cat, arena=arena)
+            assert enc.compat.any()
+        finally:
+            arena.release()
+        assert arena.acquire()
+        arena.release()
+
+    def test_take_grows_and_zeroes(self):
+        arena = EncodeArena()
+        a = arena.take("x", (2, 3), np.float32, zero=True)
+        assert a.shape == (2, 3) and not a.any()
+        a.fill(7)
+        b = arena.take("x", (4, 3), np.float32, zero=True)
+        assert b.shape == (4, 3) and not b.any()
+
+
+class TestTensorsLRU:
+    """Satellite regression: Solver.tensors() kept ONE epoch view and
+    cleared on every new key — two NodeClass views alternating each
+    reconcile rebuilt (and re-uploaded) the catalog every flip."""
+
+    def _solver(self):
+        return Solver(CatalogProvider(
+            lambda: generate_catalog(GeneratorConfig(families=["m5", "c5"]))),
+            backend="host")
+
+    def test_alternating_node_classes_dont_thrash(self):
+        s = self._solver()
+        nc_a = NodeClassSpec(name="a")
+        nc_b = NodeClassSpec(name="b", zones=["zone-a", "zone-b"])
+        s.tensors(nc_a)
+        s.tensors(nc_b)
+        built = s.stats["catalog_rebuilds"]
+        assert built == 2
+        for _ in range(8):
+            assert s.tensors(nc_a) is not None
+            assert s.tensors(nc_b) is not None
+        assert s.stats["catalog_rebuilds"] == built, (
+            "alternating NodeClass views rebuilt the catalog tensors")
+
+    def test_lru_evicts_beyond_capacity(self):
+        s = self._solver()
+        # hash() covers spec fields, not the name — vary a hashed field
+        ncs = [NodeClassSpec(name=f"nc{i}", block_device_gib=float(i + 1))
+               for i in range(Solver.CAT_CACHE_SIZE + 2)]
+        for nc in ncs:
+            s.tensors(nc)
+        assert len(s._cat_cache) == Solver.CAT_CACHE_SIZE
+        # oldest view evicted → next access rebuilds exactly once
+        before = s.stats["catalog_rebuilds"]
+        s.tensors(ncs[0])
+        assert s.stats["catalog_rebuilds"] == before + 1
+
+    def test_epoch_bump_rekeys(self):
+        s = self._solver()
+        nc = NodeClassSpec(name="a")
+        s.tensors(nc)
+        before = s.stats["catalog_rebuilds"]
+        s.catalog.unavailable.mark_unavailable("m5.large", "zone-a", "spot",
+                                               reason="test")
+        s.tensors(nc)
+        assert s.stats["catalog_rebuilds"] == before + 1
+
+
+class TestFacadeCacheWiring:
+    def test_solve_twice_hits_and_meters(self):
+        from karpenter_tpu.metrics import ENCODE_CACHE, ENCODE_CACHE_ROWS
+        s = Solver(CatalogProvider(lambda: small_catalog()), backend="host")
+        pool = NodePool(name="p")
+        pods = [mk_pod(f"p{i}") for i in range(12)]
+        h0 = ENCODE_CACHE.value(event="hit")
+        s.solve(pods, pool)
+        assert s._encode_cache.stats["misses"] >= 1
+        s.solve(pods, pool)
+        assert s._encode_cache.stats["hits"] >= 1
+        assert ENCODE_CACHE.value(event="hit") > h0
+        assert ENCODE_CACHE_ROWS.value() >= 1
+
+    def test_encode_cache_disable(self):
+        s = Solver(CatalogProvider(lambda: small_catalog()), backend="host",
+                   encode_cache=False)
+        pool = NodePool(name="p")
+        out = s.solve([mk_pod("p0")], pool)
+        assert out.launches and s._encode_cache is None
+
+    def test_trace_spans_cover_cache_path(self):
+        from karpenter_tpu.obs.tracer import TRACER
+        s = Solver(CatalogProvider(lambda: small_catalog()), backend="host")
+        pool = NodePool(name="p")
+        pods = [mk_pod(f"p{i}") for i in range(4)]
+        s.solve(pods, pool)  # prime
+        TRACER.configure(enabled=True, ring_size=4)
+        try:
+            with TRACER.trace("test.solve"):
+                s.solve(pods, pool)
+            trace = next(t for t in TRACER.recorder.slowest()
+                         if t.root.name == "test.solve")
+            names = {sp.name for sp in trace.spans}
+            assert "encode.lower" in names
+            assert "encode.cache_hit" in names
+            lower = next(sp for sp in trace.spans
+                         if sp.name == "encode.lower")
+            assert lower.attrs.get("cache_hits", 0) >= 1
+        finally:
+            TRACER.configure(enabled=False)

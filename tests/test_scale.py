@@ -31,6 +31,34 @@ def all_bound(sim):
     return all(p.node_name is not None for p in sim.store.pods.values())
 
 
+class TestEncodeCacheSmoke:
+    """Tier-1 (not slow): the provisioner's steady-state reconcile must
+    actually exercise the encode-cache hit path — a re-keying bug that
+    silently turned every reconcile into a cold encode would pass every
+    correctness test while giving back the columnar pipeline's win."""
+
+    def test_second_reconcile_hits_encode_cache(self):
+        from karpenter_tpu.metrics import ENCODE_CACHE
+        sim = make_sim()
+        hits0 = ENCODE_CACHE.value(event="hit")
+        for i in range(8):
+            sim.store.add_pod(Pod(
+                name=f"ec-{i}",
+                requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        sim.provisioner.reconcile(sim.clock.now())
+        # same-signature arrivals: the next reconcile must gather, not
+        # re-lower (catalog epoch unchanged between the two)
+        for i in range(8):
+            sim.store.add_pod(Pod(
+                name=f"ec2-{i}",
+                requests=Resources.parse({"cpu": "250m", "memory": "512Mi"})))
+        sim.provisioner.reconcile(sim.clock.now())
+        assert ENCODE_CACHE.value(event="hit") > hits0, (
+            "warm reconcile never hit the encode cache")
+        stats = sim.provisioner.solver._encode_cache.stats
+        assert stats["hits"] >= 1, stats
+
+
 @pytest.mark.slow
 class TestScaleSuite:
     def test_node_dense_500x1(self):

@@ -196,3 +196,90 @@ def _group_of(enc, pods):
     sig_to_g = {g.representative.constraint_signature(): i
                 for i, g in enumerate(enc.groups)}
     return [sig_to_g.get(p.constraint_signature()) for p in pods]
+
+
+def _assert_enc_identical(a, b, seed: int, step: int) -> None:
+    """Byte-identity between a cold and a cache-served EncodedPods."""
+    where = f"seed {seed} step {step}"
+    assert len(a.groups) == len(b.groups), f"{where}: group count"
+    for ga, gb in zip(a.groups, b.groups):
+        assert (ga.representative.constraint_signature()
+                == gb.representative.constraint_signature()), (
+            f"{where}: group order/signature")
+    for f in ("requests", "counts", "compat", "allow_zone", "allow_cap",
+              "max_per_node", "spread_zone", "spread_soft"):
+        assert getattr(a, f).tobytes() == getattr(b, f).tobytes(), (
+            f"{where}: {f} bytes")
+    for f in ("compat_hard", "zone_hard", "cap_hard", "conflict"):
+        fa, fb = getattr(a, f), getattr(b, f)
+        assert (fa is None) == (fb is None), f"{where}: {f} presence"
+        if fa is not None:
+            assert fa.tobytes() == fb.tobytes(), f"{where}: {f} bytes"
+    assert (a.dropped_keys or None) == (b.dropped_keys or None), (
+        f"{where}: dropped_keys")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_encode_cache_parity_random(seed):
+    """The signature-keyed encode cache must be INVISIBLE: across random
+    pod churn and catalog mutations — ICE marks re-keying the epoch,
+    forced epoch bumps, availability-driven context rotation — the
+    cache-served encode is byte-identical to a cold encode, and a
+    cache-enabled Solver's SolveOutput matches a cache-disabled one."""
+    from karpenter_tpu.catalog import CatalogProvider
+    from karpenter_tpu.models.nodepool import NodePool
+    from karpenter_tpu.models.pod import Taint, Toleration
+    from karpenter_tpu.ops.encode_cache import EncodeCache
+    from karpenter_tpu.ops.facade import Solver
+
+    rng = random.Random(seed * 2029 + 11)
+    types = generate_catalog(GeneratorConfig(
+        families=rng.sample(["m5", "c5", "r5", "m6", "c6"], 3)))
+    prov = CatalogProvider(lambda: types)
+    cached = Solver(prov, backend="host")
+    cold = Solver(prov, backend="host", encode_cache=False)
+    pool = NodePool(name="fuzz",
+                    taints=[Taint(key="team", value="a",
+                                  effect="NoSchedule")])
+    pods = _random_pods(rng, 80)
+    # some pods tolerate the pool taint, some get dropped per group
+    for p in pods:
+        if rng.random() < 0.6:
+            p.tolerations = [Toleration(key="team", operator="Exists")]
+            p.invalidate_group_key()
+    cache = EncodeCache()
+    hits_seen = 0
+    for step in range(6):
+        mutation = rng.randrange(4)
+        if mutation == 0 and step:   # pod churn: drop + add
+            del pods[: rng.randrange(1, 10)]
+            pods.extend(_random_pods(rng, rng.randrange(5, 25)))
+        elif mutation == 1 and step:  # ICE mark → epoch re-key
+            t = rng.choice(types)
+            o = rng.choice(t.offerings)
+            prov.unavailable.mark_unavailable(
+                t.name, o.zone, o.capacity_type, reason="fuzz")
+        elif mutation == 2 and step:  # forced catalog-epoch bump
+            prov.bump_epoch()
+        out_a = cached.solve(list(pods), pool)
+        out_b = cold.solve(list(pods), pool)
+        assert out_a.launches == out_b.launches, f"seed {seed} step {step}"
+        assert out_a.existing_placements == out_b.existing_placements
+        assert sorted(out_a.unschedulable) == sorted(out_b.unschedulable)
+        # ops-level byte identity on the base catalog view, twice (the
+        # second encode is the all-hits gather)
+        cat = cached.tensors()
+        taints = pool.taints + pool.startup_taints
+        ctx = cache.context_for(cat, pool.requirements, taints,
+                                pool.template_labels())
+        kw = dict(extra_requirements=pool.requirements, taints=taints,
+                  template_labels=pool.template_labels())
+        enc_cold = encode_pods(list(pods), cat, **kw)
+        enc_miss = encode_pods(list(pods), cat, cache=ctx, **kw)
+        _assert_enc_identical(enc_cold, enc_miss, seed, step)
+        enc_hit = encode_pods(list(pods), cat, cache=ctx, **kw)
+        _assert_enc_identical(enc_cold, enc_hit, seed, step)
+        assert enc_hit.cache_misses == 0, f"seed {seed} step {step}"
+        hits_seen += enc_hit.cache_hits
+    assert hits_seen > 0
+    assert cached._encode_cache.stats["hits"] > 0

@@ -1,0 +1,118 @@
+"""Encode-pipeline report — `make encode-report`.
+
+A quick CPU-only probe of the columnar encode pipeline (the bench's c9
+config at adjustable scale): cold first-encode vs cached steady-state
+re-encode under N% churn per tick, plus cache hit rate and resident
+rows. Prints one human table and one JSON line, so it serves both a
+terminal spot-check and scripted regression tracking.
+
+Usage:
+    python tools/encode_report.py [--pods 10000] [--ticks 5]
+                                  [--churn-pct 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--ticks", type=int, default=5)
+    ap.add_argument("--churn-pct", type=float, default=1.0)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from karpenter_tpu.catalog import generate_catalog
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.pod import (Pod, PodAffinityTerm,
+                                          TopologySpreadConstraint)
+    from karpenter_tpu.models.resources import Resources
+    from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+    from karpenter_tpu.ops.encode_cache import EncodeArena, EncodeCache
+    from karpenter_tpu.state.store import Store
+
+    shapes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"),
+              ("2", "4Gi"), ("500m", "4Gi"), ("1", "8Gi")]
+
+    manifests = max(40, args.pods // 25)  # ~25 pods per distinct manifest
+
+    def mk(i: int, gen: int = 0) -> Pod:
+        s = i % manifests
+        kw = dict(requests=Resources.parse(
+            {"cpu": shapes[s % len(shapes)][0],
+             "memory": shapes[s % len(shapes)][1]}),
+            labels={"app": f"svc-{s}"})
+        if s % 3 == 0:
+            kw["topology_spread"] = [TopologySpreadConstraint(
+                topology_key=L.ZONE, max_skew=1)]
+        if s % 7 == 0:
+            kw["affinity_terms"] = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": f"svc-{s}"}, anti=True)]
+        return Pod(name=f"er-{gen}-{i}", **kw)
+
+    cat = encode_catalog(generate_catalog())
+    cat.cache_token = ("encode-report",)
+    store = Store()
+    live = [mk(i) for i in range(args.pods)]
+    cache, arena = EncodeCache(), EncodeArena()
+    ctx = cache.context_for(cat)
+
+    # cold = first contact: raw uninterned pods, empty cache (interning +
+    # grouping + full lowering); cached ticks then ride the store's
+    # admission-time group index + the signature row cache
+    t0 = time.perf_counter()
+    enc = encode_pods(live, cat, cache=ctx, arena=arena)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    for p in live:
+        store.add_pod(p)
+
+    churn = max(1, int(args.pods * args.churn_pct / 100.0))
+    cached_ms = []
+    for tick in range(1, args.ticks + 1):
+        for p in live[:churn]:
+            store.delete_pod(p.namespace, p.name)
+        fresh = [mk(i, gen=tick) for i in range(churn)]
+        for p in fresh:
+            store.add_pod(p)
+        live = live[churn:] + fresh
+        t0 = time.perf_counter()
+        enc = encode_pods(live, cat,
+                          pregrouped=store.pending_unnominated_groups(),
+                          cache=ctx, arena=arena)
+        cached_ms.append((time.perf_counter() - t0) * 1e3)
+
+    med = statistics.median(cached_ms)
+    report = {
+        "pods": args.pods, "ticks": args.ticks,
+        "churn_per_tick": churn, "groups": int(enc.G),
+        "encode_cold_ms": round(cold_ms, 2),
+        "encode_cached_ms": round(med, 3),
+        "cached_vs_cold": round(cold_ms / max(med, 1e-9), 1),
+        "cache_hit_rate": round(cache.hit_rate(), 4),
+        "resident_rows": cache.resident_rows,
+        "arena_bytes": arena.nbytes(),
+    }
+    print(f"encode report — {args.pods} pods, {enc.G} groups, "
+          f"{churn} churn/tick × {args.ticks} ticks")
+    print(f"  cold first encode : {report['encode_cold_ms']:10.2f} ms")
+    print(f"  cached re-encode  : {report['encode_cached_ms']:10.3f} ms "
+          f"(p50, {report['cached_vs_cold']}x faster)")
+    print(f"  cache hit rate    : {report['cache_hit_rate']:.2%}  "
+          f"({report['resident_rows']} resident rows, "
+          f"arena {report['arena_bytes'] / 1e6:.1f} MB)")
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
