@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
-        deflake run native trace-report chaos warmpath-audit \
+        deflake run native trace-report chaos crash-audit warmpath-audit \
         encode-report clean
 
 help:
@@ -25,9 +25,12 @@ benchmark:  ## one JSON line on the attached TPU (reference: make benchmark)
 trace-report:  ## slowest spans from $$KARPENTER_TPU_TRACE_DIR/traces.jsonl (or TRACE=path)
 	$(PY) tools/trace_report.py $(TRACE)
 
-chaos:  ## chaos scenario catalog (incl. slow soaks) + seed-reproducibility check
-	$(PY) -m pytest tests/test_faults.py tests/test_chaos.py -q
+chaos:  ## chaos scenario catalog (incl. slow soaks + restart scenarios) + seed-reproducibility check
+	$(PY) -m pytest tests/test_faults.py tests/test_chaos.py tests/test_restart.py -q
 	$(PY) -m karpenter_tpu.faults all --repeat 2
+
+crash-audit:  ## crash-restart matrix: the restart scenarios across 5 seeds, each --repeat 2 (identical end-state hash required)
+	$(PY) -m karpenter_tpu.faults restart --seeds 5 --repeat 2
 
 warmpath-audit:  ## warm-path auditor in always-on mode over the chaos smoke + storm scenarios
 	$(PY) -m karpenter_tpu.faults warmpath_smoke --repeat 2

@@ -235,6 +235,20 @@ class BatchingCloud:
         finally:
             self._describe_cache.flush()  # reads must see the new instances
 
+    def shutdown(self) -> None:
+        """Clean-stop flush: a queued termination batch whose idle/max
+        window never closed must not die with the process — a clean stop
+        that dropped it would leak every instance in it until the NEXT
+        process's GC sweep. Ship it now, ignoring the window and any
+        backoff gate (this is the last wire call this process gets; if
+        the cloud still throttles it, the cross-restart GC sweep remains
+        the backstop). Registered as a runtime stop hook by
+        main.build_operator; idempotent — a drained batcher is a no-op."""
+        if not self._pending:
+            return
+        self._retry_after = 0.0
+        self._flush_terminations()
+
     def flusher(self):
         """A controller driving the window clock — register with the
         runtime (or engine) alongside the real controllers."""

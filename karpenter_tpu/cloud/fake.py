@@ -108,7 +108,12 @@ class FakeCloud:
         self.on_node_created: List[Callable[[Node], None]] = []
         self._nodes_created: Dict[str, Node] = {}
         self.api_calls: Dict[str, int] = {"create_fleet": 0, "terminate": 0,
-                                          "describe": 0}
+                                          "describe": 0, "launch_dedup": 0}
+        # idempotency-token ledger: token -> instance id it minted. A
+        # replayed request whose token already produced a live instance
+        # dedupes to it (the crash-restart double-launch guard); the
+        # ledger is cloud-side durable state, like the instances
+        self._token_instances: Dict[str, str] = {}
         # queued interruption events; deque so FIFO acks are O(1)
         self.interruptions: "deque[dict]" = deque()
         self.expired_reservations: set = set()
@@ -160,6 +165,21 @@ class FakeCloud:
         return out
 
     def _launch_one(self, req: LaunchRequest) -> "Instance | CloudError":
+        # idempotency gate FIRST (before auth/capacity: a replay must
+        # return the original instance even if the pool has since
+        # exhausted or the request's profile was deleted — EC2's
+        # client-token semantics): a token that already minted a live
+        # instance dedupes instead of double-provisioning
+        tok = getattr(req, "idempotency_token", "")
+        if tok:
+            prior = self._token_instances.get(tok)
+            if prior is not None:
+                inst = self.instances.get(prior)
+                if inst is not None and inst.state != "terminated":
+                    self.api_calls["launch_dedup"] += 1
+                    from ..metrics import LAUNCH_DEDUP
+                    LAUNCH_DEDUP.inc()
+                    return inst
         # authorization/validity gates before capacity (reference: RunInstances
         # rejects unknown SGs / instance profiles before placement)
         for ng in req.network_groups:
@@ -214,6 +234,8 @@ class FakeCloud:
                 network_groups=list(req.network_groups),
                 profile=req.profile)
             self.instances[inst.id] = inst
+            if tok:
+                self._token_instances[tok] = inst.id
             return inst
         # failure taxonomy (reference errors.go:68-227): pure address
         # exhaustion → InsufficientFreeAddresses analog; pure capacity-type
@@ -446,9 +468,11 @@ class FakeCloud:
             "instances": {k: vars(v).copy() for k, v in self.instances.items()},
             "capacity_pools": dict(self.capacity_pools),
             "zone_ips": dict(self.zone_ips),
+            "token_instances": dict(self._token_instances),
         }
 
     def restore(self, snap: dict) -> None:
         self.instances = {k: Instance(**v) for k, v in snap["instances"].items()}
         self.capacity_pools = dict(snap["capacity_pools"])
         self.zone_ips = dict(snap.get("zone_ips", {}))
+        self._token_instances = dict(snap.get("token_instances", {}))

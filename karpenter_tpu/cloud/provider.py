@@ -37,6 +37,13 @@ class LaunchRequest:
     # instance-profile analog, reference spec.role/spec.instanceProfile)
     network_groups: List[str] = field(default_factory=list)
     profile: str = ""
+    # launch idempotency token (state/journal.launch_token — hash of
+    # claim name + pool fingerprint + attempt): a cloud that has already
+    # minted an instance for this token returns THAT instance instead of
+    # provisioning a second one, so a request replayed across an
+    # operator crash-restart cannot double-launch. Empty = no dedupe
+    # (legacy callers); the provisioner always sets it.
+    idempotency_token: str = ""
 
 
 @dataclass

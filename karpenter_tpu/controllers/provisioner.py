@@ -33,6 +33,7 @@ from ..obs.tracer import NOOP_SPAN, TRACER
 from ..models.resources import Resources
 from ..ops.facade import NodeLaunch, Solver, virtual_node_from_claim
 from ..state.store import Store
+from ..utils import crashpoints
 
 NOMINATED = L.NOMINATED  # canonical home: models/labels.py
 
@@ -51,6 +52,13 @@ class Provisioner:
     # the standing headroom ledger, no full solve) or cold (anything else
     # changed — full solve, then recommit the ledger). None = always cold.
     warmpath: Optional[object] = None
+    # optional state.journal.IntentJournal: the provisioning write-ahead
+    # log. When set, every launch batch records its intents BEFORE the
+    # CreateFleet wire call and resolves them after the commit, so a
+    # crash anywhere in between is recoverable (restart replay adopts or
+    # aborts; the GC sweep skips instances with open intents). None =
+    # no journaling (tests exercising the bare launch path).
+    journal: Optional[object] = None
     stats: Dict[str, int] = field(default_factory=lambda: {
         "solves": 0, "launches": 0, "ice_errors": 0, "unschedulable": 0})
     _throttled: bool = False  # set by a throttled _launch within a pass
@@ -248,6 +256,9 @@ class Provisioner:
                    (o.reservation_id, o.reservation_type)
                    for t in self.catalog.raw_types()
                    for o in t.offerings if o.reservation_id}
+        from ..state.journal import launch_token
+        pool_hash = pool.hash()  # the token's pool-fingerprint component
+        attempts: Dict[str, int] = {}  # claim -> the attempt its token bakes in
         requests, claims = [], []
         for launch in launches:
             claim = NodeClaim(
@@ -268,6 +279,15 @@ class Provisioner:
             claim.instance_type = launch.instance_type
             self.store.add_nodeclaim(claim)
             claims.append((claim, launch))
+            # idempotency token: hash of claim name + pool fingerprint +
+            # attempt. Deterministic, so a request replayed after a
+            # crash-restart maps to the same token and the cloud dedupes
+            # it instead of double-provisioning; stamped as an instance
+            # tag too, so restart replay can match intents to instances
+            attempt = (self.journal.next_attempt(claim.name)
+                       if self.journal is not None else 1)
+            attempts[claim.name] = attempt
+            token = launch_token(claim.name, pool_hash, attempt)
             overrides = [
                 LaunchOverride(*o,
                                reservation_id=res_ids.get(o[:3], (None, ""))[0],
@@ -284,10 +304,12 @@ class Provisioner:
                 user_data=self._user_data(pool, node_class, launch),
                 # adoption tags: enough for state.rehydrate to rebuild the
                 # NodeClaim from the instance after an operator restart
+                idempotency_token=token,
                 tags={**node_class.tags,
                       L.TAG_NODEPOOL: pool.name,
                       L.TAG_NODECLAIM: claim.name,
                       L.TAG_NODECLASS: node_class.name,
+                      L.TAG_LAUNCH_TOKEN: token,
                       L.TAG_NODECLASS_HASH:
                           claim.annotations["karpenter.tpu/nodeclass-hash"],
                       L.TAG_NODECLASS_HASH_VERSION:
@@ -314,7 +336,27 @@ class Provisioner:
                 if (self._floors_hold(pre, floors)
                         and not self._floors_hold(req.overrides, floors)):
                     req.overrides = pre
-        fleet_sp = (TRACER.span("provision.launch", pool=pool.name,
+        # write-ahead intent record: one open intent per request, written
+        # (and fsync'd when file-backed) BEFORE the wire call — the only
+        # reason a crash between here and the commit below is recoverable.
+        # A non-retryable create_fleet raise deliberately leaves the
+        # intents open: the engine crashes, and restart replay
+        # (state/rehydrate.replay_intents) adopts whatever the wire call
+        # actually minted and aborts the rest.
+        intents: Dict[str, object] = {}
+        if self.journal is not None:
+            # attempt is passed through explicitly: it MUST be the one
+            # the idempotency token baked in above, not a recount
+            opened = self.journal.open_batch(
+                [{"claim_name": req.nodeclaim_name, "nodepool": pool.name,
+                  "node_class": node_class.name,
+                  "token": req.idempotency_token,
+                  "attempt": attempts[req.nodeclaim_name]}
+                 for req in requests],
+                now=now)
+            intents = {i.claim_name: i for i in opened}
+        crashpoints.fire("mid_launch_batch")  # cut point: intents open,
+        fleet_sp = (TRACER.span("provision.launch", pool=pool.name,  # no wire call yet
                                 requests=len(requests))
                     if TRACER.enabled else NOOP_SPAN)
         try:
@@ -322,24 +364,34 @@ class Provisioner:
                 results = self.cloud.create_fleet(requests)
         except CloudError as e:
             if not getattr(e, "retryable", False):
+                # the call was rejected wholesale (auth/validation —
+                # a raise, unlike the per-request in-band errors, means
+                # nothing was processed): roll back the claims and close
+                # the intents before re-raising. Crucially this must NOT
+                # leave intents open: the production Runtime SURVIVES
+                # this raise (it is not a process death), so an
+                # open-forever intent would both leak the gauge and
+                # shield any stray instance from GC for the process's
+                # whole lifetime. If a misbehaving cloud minted anything
+                # anyway, its adoption tags keep it recoverable: GC
+                # reaps it after MIN_AGE in-process, restart adopts it.
+                self._rollback_launch(claims, intents, now)
                 raise
-            # throttled/5xx batch: nothing reached the wire — roll back
-            # the claims (a PENDING claim with no instance would live
-            # forever; the liveness reaper only covers LAUNCHED ones) and
-            # leave the pods pending for the NEXT reconcile. They are
+            # throttled/5xx batch: roll back and leave the pods pending
+            # for the NEXT reconcile. They are
             # deliberately NOT handed to later pools: that would re-solve
             # and re-hammer the throttled cloud once per pool and record
             # bogus FailedScheduling events for pods that are merely
             # throttled. The reconcile requeues at the retryable backoff.
-            for claim, _launch in claims:
-                self.store.delete_nodeclaim(claim.name)
+            self._rollback_launch(claims, intents, now)
             self.stats["throttled"] = self.stats.get("throttled", 0) + 1
             self._throttled = True
             self.store.record_event("provisioner", pool.name,
                                     "CreateFleetThrottled", str(e))
             return [], []
 
-        launched: List[NodeClaim] = []
+        crashpoints.fire("post_launch")  # cut point: instances may exist,
+        launched: List[NodeClaim] = []   # nothing committed to the store
         failed_pods: List[Pod] = []
         bind_sp = (TRACER.span("provision.bind", claims=len(claims))
                    if TRACER.enabled else NOOP_SPAN)
@@ -381,11 +433,38 @@ class Provisioner:
                     NODECLAIMS_CREATED.inc(nodepool=claim.nodepool,
                                            instance_type=claim.instance_type,
                                            capacity_type=claim.capacity_type)
+                    intent = intents.get(claim.name)
+                    if intent is not None:
+                        # the commit above is what the intent guarded;
+                        # it lands, the intent closes
+                        self.journal.resolve(intent, "committed",
+                                             provider_id=res.provider_id,
+                                             now=now)
                 else:
                     self._handle_launch_error(claim, res)
                     failed_pods.extend(self.store.pods[k] for k in launch.pod_keys
                                        if k in self.store.pods)
+                    intent = intents.get(claim.name)
+                    if intent is not None:
+                        # the cloud answered with an error: no instance
+                        # exists for this token, nothing to recover
+                        self.journal.resolve(intent, "aborted", now=now)
             return launched, failed_pods
+
+    def _rollback_launch(self, claims, intents: Dict[str, object],
+                         now: float) -> None:
+        """Unwind a launch batch whose CreateFleet call RAISED (throttle
+        or wholesale rejection — nothing reached the wire): delete the
+        pre-created claims (a PENDING claim with no instance would live
+        forever; the liveness reaper only covers LAUNCHED ones) and close
+        their intents aborted (an open-forever intent would leak the
+        gauge and shield strays from GC for the process's lifetime). The
+        retry path mints fresh claims, hence fresh tokens."""
+        for claim, _launch in claims:
+            self.store.delete_nodeclaim(claim.name)
+            intent = intents.get(claim.name)
+            if intent is not None:
+                self.journal.resolve(intent, "aborted", now=now)
 
     def _handle_launch_error(self, claim: NodeClaim, err: CloudError) -> None:
         claim.phase = Phase.FAILED

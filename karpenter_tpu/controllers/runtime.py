@@ -43,6 +43,11 @@ class Runtime:
     # retryable CloudError every cycle would otherwise spin silently,
     # indistinguishable from healthy idle
     backoff_counts: Dict[str, int] = field(default_factory=dict)
+    # clean-shutdown hooks, run AFTER every controller task has stopped
+    # (so nothing re-enqueues work behind the flush) and before the
+    # metrics server closes — e.g. BatchingCloud.shutdown, which ships
+    # any termination batch still waiting on an idle window
+    on_stop: List[object] = field(default_factory=list)
     _stop: Optional[asyncio.Event] = None
     _server: object = None
 
@@ -193,6 +198,11 @@ class Runtime:
         for t in tasks:
             t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
+        for fn in self.on_stop:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one failed hook must not
+                log.exception("shutdown hook failed")  # skip the rest
         if self._server is not None:
             self._server.close()
 

@@ -16,6 +16,7 @@ from ..models import labels as L
 from ..models.nodeclaim import NodeClaim, Phase
 from ..models.pod import Taint
 from ..state.store import Store
+from ..utils import crashpoints
 from .provisioner import NOMINATED
 
 DISRUPTED_TAINT = Taint(key=L.DISRUPTED_TAINT_KEY, effect="NoSchedule")
@@ -121,6 +122,10 @@ class TerminationController:
                 self.store.unnominate_pod(p)
                 self.store.unbind_pod(p)
         if claim.provider_id:
+            # cut point: the node is gone from the store, the instance is
+            # still running — a crash here must resurrect the claim from
+            # the instance's adoption tags on restart, never leak it
+            crashpoints.fire("mid_drain")
             iid = claim.provider_id.rsplit("/", 1)[-1]
             self.cloud.terminate([iid])
         rid = claim.annotations.get("karpenter.tpu/reservation-id")

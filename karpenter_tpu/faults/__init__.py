@@ -10,13 +10,16 @@ scheduled, no leaked NodeClaims, store/cloud consistency, and an identical
 end-state hash for identical seeds. See docs/robustness.md.
 """
 
-from .plan import (ApiFault, ClockJump, DeviceFault, FaultPlan, IceWindow,
-                   InjectedFault, InterruptionBurst)
-from .runner import ScenarioReport, ScenarioRunner, check_invariants, state_hash
+from .plan import (ApiFault, ClockJump, CrashPoint, DeviceFault, FaultPlan,
+                   IceWindow, InjectedFault, InterruptionBurst)
+from .runner import (RestartRunner, ScenarioReport, ScenarioRunner,
+                     check_invariants, restart_invariants, state_hash)
 from .scenarios import SCENARIOS, Scenario, get_scenario
 
 __all__ = [
-    "FaultPlan", "IceWindow", "ApiFault", "ClockJump", "DeviceFault",
-    "InterruptionBurst", "InjectedFault", "ScenarioRunner", "ScenarioReport",
-    "check_invariants", "state_hash", "SCENARIOS", "Scenario", "get_scenario",
+    "FaultPlan", "IceWindow", "ApiFault", "ClockJump", "CrashPoint",
+    "DeviceFault", "InterruptionBurst", "InjectedFault", "ScenarioRunner",
+    "RestartRunner", "ScenarioReport", "check_invariants",
+    "restart_invariants", "state_hash", "SCENARIOS", "Scenario",
+    "get_scenario",
 ]

@@ -3,12 +3,19 @@
     python -m karpenter_tpu.faults                  # list the catalog
     python -m karpenter_tpu.faults smoke            # one scenario
     python -m karpenter_tpu.faults all              # whole catalog
+    python -m karpenter_tpu.faults restart          # crash-restart group
     python -m karpenter_tpu.faults ice_storm --seed 7 --repeat 2
+    python -m karpenter_tpu.faults restart --seeds 5 --repeat 2
 
 --repeat N re-runs the same (scenario, seed) and fails unless every run
 produced the identical end-state hash and fault-timeline fingerprint —
 the from-a-seed reproduction check docs/robustness.md describes.
-Exit status is non-zero when any run fails its invariants.
+--seeds N widens the matrix to seeds 0..N-1 (each still honoring
+--repeat); `make crash-audit` runs the restart group this way.
+Scenarios carrying CrashPoint rules are driven by RestartRunner (the
+engine is torn down and rebuilt at each injected crash); everything
+else runs under ScenarioRunner. Exit status is non-zero when any run
+fails its invariants.
 """
 
 from __future__ import annotations
@@ -18,15 +25,19 @@ import sys
 
 
 def main(argv=None) -> int:
-    from .runner import ScenarioRunner
+    from .runner import RestartRunner, ScenarioRunner
     from .scenarios import SCENARIOS
 
     ap = argparse.ArgumentParser(
         prog="python -m karpenter_tpu.faults",
         description="run chaos scenarios from the catalog")
     ap.add_argument("scenario", nargs="?", default="",
-                    help="scenario name, or 'all' (empty: list catalog)")
+                    help="scenario name, 'all', or 'restart' (the "
+                         "crash-restart group; empty: list catalog)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seeds", type=int, default=0,
+                    help="run seeds 0..N-1 instead of the single --seed "
+                         "(the crash-audit matrix)")
     ap.add_argument("--repeat", type=int, default=1,
                     help="re-run and require identical hashes")
     ap.add_argument("--skip-slow", action="store_true",
@@ -35,30 +46,40 @@ def main(argv=None) -> int:
 
     if not args.scenario:
         for sc in SCENARIOS.values():
-            tag = " [slow]" if sc.slow else ""
+            tag = (" [slow]" if sc.slow else "") + \
+                (" [restart]" if sc.restart else "")
             print(f"{sc.name}{tag}: {sc.description}")
         return 0
 
-    names = (sorted(SCENARIOS) if args.scenario == "all"
-             else [args.scenario])
-    if args.scenario == "all" and args.skip_slow:
-        names = [n for n in names if not SCENARIOS[n].slow]
+    if args.scenario == "all":
+        names = sorted(SCENARIOS)
+        if args.skip_slow:
+            names = [n for n in names if not SCENARIOS[n].slow]
+    elif args.scenario == "restart":
+        names = sorted(n for n, sc in SCENARIOS.items() if sc.restart)
+    else:
+        names = [args.scenario]
 
+    seeds = (list(range(args.seeds)) if args.seeds > 0 else [args.seed])
     failed = False
     for name in names:
-        reports = [ScenarioRunner(name, seed=args.seed).run()
-                   for _ in range(max(1, args.repeat))]
-        for rep in reports:
-            print(rep.summary())
-            failed |= not rep.ok
-        if args.repeat > 1:
-            hashes = {(r.end_hash, r.fault_fingerprint) for r in reports}
-            if len(hashes) != 1:
-                print(f"[FAIL] {name}: {args.repeat} runs at seed "
-                      f"{args.seed} diverged: {sorted(hashes)}")
-                failed = True
-            else:
-                print(f"  reproducible: {args.repeat} runs identical")
+        runner_cls = (RestartRunner if SCENARIOS[name].restart
+                      else ScenarioRunner)
+        for seed in seeds:
+            reports = [runner_cls(name, seed=seed).run()
+                       for _ in range(max(1, args.repeat))]
+            for rep in reports:
+                print(rep.summary())
+                failed |= not rep.ok
+            if args.repeat > 1:
+                hashes = {(r.end_hash, r.fault_fingerprint)
+                          for r in reports}
+                if len(hashes) != 1:
+                    print(f"[FAIL] {name}: {args.repeat} runs at seed "
+                          f"{seed} diverged: {sorted(hashes)}")
+                    failed = True
+                else:
+                    print(f"  reproducible: {args.repeat} runs identical")
     return 1 if failed else 0
 
 

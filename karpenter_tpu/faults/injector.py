@@ -101,6 +101,24 @@ def install_bursts(engine, cloud, plan: FaultPlan, store=None) -> None:
 
 
 @contextlib.contextmanager
+def crash_point_hook(plan: Optional[FaultPlan]):
+    """Arm utils.crashpoints' process-global hook for the plan's
+    CrashPoint rules; always disarms on exit (same contract as
+    device_fault_hook — a crashed harness can't leave the seam armed).
+    Only the restart harness (runner.RestartRunner) should arm this: a
+    fired crash unwinds the engine, and nothing else rebuilds it."""
+    from ..utils import crashpoints
+    if plan is None or not plan.crash_points:
+        yield
+        return
+    crashpoints.set_crash_hook(plan.on_crash_point)
+    try:
+        yield
+    finally:
+        crashpoints.set_crash_hook(None)
+
+
+@contextlib.contextmanager
 def device_fault_hook(plan: Optional[FaultPlan]):
     """Arm ops.solver's dispatch hook for the plan's DeviceFault rules;
     always disarms on exit so the process-global seam can't leak between

@@ -95,6 +95,25 @@ class DeviceFault:
 
 
 @dataclass(frozen=True)
+class CrashPoint:
+    """The operator process dies at a named commit-path cut point
+    (utils/crashpoints.CUT_POINTS: mid_launch_batch, post_launch,
+    mid_drain, mid_warm_audit). `nth` is the 1-based cumulative firing
+    count of that point — counted across the whole run, INCLUDING
+    firings in rebuilt processes, so a plan's crashes sequence
+    deterministically through restarts; `at` arms the gate only from
+    that run-relative sim time (a firing before `at` still counts but
+    cannot crash). Each rule fires at most once. CrashInjected unwinds
+    the whole engine — only faults/runner.RestartRunner (which rebuilds
+    the stack on the surviving cloud/clock/journal) can run a plan
+    carrying these rules."""
+
+    point: str
+    nth: int = 1
+    at: float = 0.0
+
+
+@dataclass(frozen=True)
 class InterruptionBurst:
     """At sim time `at`, `count` running instances receive an interruption:
     kind="spot" queues a 2-minute spot reclaim warning, kind="kill"
@@ -129,6 +148,10 @@ class FaultPlan:
             key=lambda r: r.at)
         self.device_faults = [r for r in self.rules
                               if isinstance(r, DeviceFault)]
+        self.crash_points = [r for r in self.rules
+                             if isinstance(r, CrashPoint)]
+        self._point_fires: dict = {}   # point -> cumulative firing count
+        self._crashed: set = set()     # indices of consumed CrashPoints
         self._bursts = sorted(
             (r for r in self.rules if isinstance(r, InterruptionBurst)),
             key=lambda r: r.at)
@@ -211,6 +234,35 @@ class FaultPlan:
                 raise InjectedFault(
                     f"injected {backend} fault on dispatch "
                     f"#{self._dispatches}")
+
+    def on_crash_point(self, point: str) -> None:
+        """The utils.crashpoints hook (armed by injector.crash_point_hook):
+        counts the firing and raises CrashInjected when an unconsumed
+        CrashPoint rule covers it. Counts and consumed rules live on the
+        plan, which SURVIVES the crash — the restart harness re-arms the
+        same plan on the rebuilt stack, so firing numbers keep advancing
+        monotonically through process lifetimes."""
+        if not self.crash_points:
+            return
+        n = self._point_fires.get(point, 0) + 1
+        self._point_fires[point] = n
+        now = self.clock.now() if self.clock is not None else 0.0
+        rel = now - self.origin
+        for i, r in enumerate(self.crash_points):
+            if i in self._crashed or r.point != point:
+                continue
+            if rel >= r.at and n >= r.nth:
+                self._crashed.add(i)
+                self.record(now, "crash", f"{point}#{n}")
+                from ..utils.crashpoints import CrashInjected
+                raise CrashInjected(
+                    f"injected operator crash at {point} (firing #{n})")
+
+    @property
+    def crashes_remaining(self) -> int:
+        """CrashPoint rules not yet consumed — the restart harness keeps
+        the run open until every scheduled death has happened."""
+        return len(self.crash_points) - len(self._crashed)
 
     def on_jump(self, new_now: float, delta: float) -> None:
         """FakeClock.schedule_jump callback — records the applied skew."""

@@ -141,6 +141,50 @@ class ScenarioReport:
         return "\n".join(lines)
 
 
+def restart_invariants(sim) -> List[str]:
+    """Extra end-of-run invariants for crash-restart runs, on top of
+    check_invariants: the intent journal must be fully resolved, and the
+    idempotency layer must have prevented every double-provision — no
+    token ever minted two instances, no claim is backed by two live
+    ones. A nonzero launch_dedup count is fine (that is the token layer
+    WORKING on a replay); a duplicate instance is the failure."""
+    v: List[str] = []
+    journal = getattr(sim, "journal", None)
+    if journal is not None:
+        still_open = journal.open_intents()
+        if still_open:
+            v.append(f"{len(still_open)} launch intent(s) still open at "
+                     f"end of run: "
+                     f"{sorted(i.claim_name for i in still_open)[:5]}")
+    by_token: Dict[str, list] = {}
+    live_by_claim: Dict[str, list] = {}
+    for inst in sim.cloud.instances.values():
+        if inst.state == "terminated":
+            # a token legitimately re-mints once its prior instance is
+            # terminated (FakeCloud._launch_one dedupes to LIVE
+            # instances only; the ledger then points at the
+            # replacement) — only live duplicates are a double-provision
+            continue
+        tok = inst.tags.get(L.TAG_LAUNCH_TOKEN)
+        if tok:
+            by_token.setdefault(tok, []).append(inst.id)
+        claim = inst.tags.get(L.TAG_NODECLAIM)
+        if claim:
+            live_by_claim.setdefault(claim, []).append(inst.id)
+    dup_tokens = {t: ids for t, ids in by_token.items() if len(ids) > 1}
+    if dup_tokens:
+        v.append(f"duplicate launch: {len(dup_tokens)} idempotency "
+                 f"token(s) minted more than one instance: "
+                 f"{sorted(dup_tokens.values())[:3]}")
+    dup_claims = {c: ids for c, ids in live_by_claim.items()
+                  if len(ids) > 1}
+    if dup_claims:
+        v.append(f"duplicate launch: {len(dup_claims)} claim(s) backed "
+                 f"by more than one live instance: "
+                 f"{sorted(dup_claims.items())[:3]}")
+    return v
+
+
 class ScenarioRunner:
     """Run one named scenario (faults/scenarios.py) at a seed."""
 
@@ -218,6 +262,157 @@ class ScenarioRunner:
                 violations.append(
                     f"warm-path auditor diverged "
                     f"{wp.stats['divergences']} time(s)")
+        report = ScenarioReport(
+            scenario=sc.name, seed=self.seed, converged=converged,
+            violations=violations, end_hash=state_hash(sim),
+            fault_fingerprint=plan.fingerprint(),
+            faults_injected=len(plan.timeline),
+            sim_seconds=sim.clock.now() - t0,
+            stats=stats)
+        self.last_sim = sim
+        self.last_plan = plan
+        return report
+
+
+class RestartRunner:
+    """Crash-restart chaos: run a scenario whose FaultPlan carries
+    CrashPoint rules, tearing the engine down at each injected crash and
+    rebuilding it the way a real operator restart would.
+
+    What survives a crash (durable): the cloud (instances + their
+    adoption tags and idempotency-token ledger), the clock, the armed
+    FaultPlan, and the provisioning intent journal. What does not: the
+    Store, the engine, every controller, the warm-path ledgers, and the
+    process-local claim-name counter (reset to zero, like a fresh
+    process — rehydration must advance it past adopted names).
+
+    On each rebuild the scenario's workload is re-applied: pods are
+    durable in real Kubernetes but our Store is operator-local, so the
+    workload "re-listing" models the watch re-sync — re-listed pods must
+    be absorbed into the adopted fleet's headroom, never re-launched
+    (state/rehydrate + the idempotency tokens guarantee it; the
+    restart_invariants duplicate-launch check asserts it).
+
+    Convergence additionally requires every CrashPoint consumed and the
+    intent journal fully resolved — a run that 'converged' before its
+    scheduled deaths happened proves nothing."""
+
+    def __init__(self, scenario, seed: int = 0):
+        from .scenarios import Scenario, get_scenario
+        self.scenario = (scenario if isinstance(scenario, Scenario)
+                         else get_scenario(scenario))
+        self.seed = seed
+        self.restarts = 0
+
+    def build(self):
+        from ..sim import make_sim
+        from ..state.journal import IntentJournal
+        sc = self.scenario
+        plan = FaultPlan(seed=self.seed, rules=sc.build_rules())
+        sim = make_sim(types=sc.types() if sc.types else None,
+                       backend=sc.backend, fault_plan=plan,
+                       warmpath=sc.warmpath, journal=IntentJournal())
+        sc.workload(sim)
+        return sim, plan
+
+    def _restart(self, old_sim, plan):
+        """Kill the operator, boot a successor on the surviving durable
+        state. make_sim detects the plan is already installed on this
+        clock (origin preserved, jumps not re-scheduled); rehydration
+        inside it adopts the fleet and replays open intents."""
+        import itertools
+
+        from ..cloud.provider import CloudError
+        from ..models import nodeclaim as ncmod
+        from ..sim import make_sim
+        ncmod._seq = itertools.count(0)  # fresh process, counter resets
+        # no `types=` here even for scenarios that define one: types
+        # configure the FakeCloud, which SURVIVES the crash — make_sim
+        # rejects types alongside an existing cloud, and the rebuilt
+        # catalog hydrates from that cloud's describe_types()
+        delay = 0.5
+        while True:
+            try:
+                sim = make_sim(cloud=old_sim.cloud, clock=old_sim.clock,
+                               backend=self.scenario.backend,
+                               fault_plan=plan,
+                               warmpath=self.scenario.warmpath,
+                               journal=old_sim.journal)
+                break
+            except CloudError as e:
+                if not getattr(e, "retryable", False):
+                    raise
+                # the restart landed inside a throttling window and the
+                # boot-path hydrate got 429'd: a real operator crash-loops
+                # here and the orchestrator restarts it with backoff —
+                # model that by stepping sim time and booting again
+                # (deterministic: fixed exponential schedule)
+                old_sim.clock.step(delay)
+                delay = min(delay * 2, 8.0)
+        self.scenario.workload(sim)      # the watch re-sync / pod re-list
+        return sim
+
+    def run(self) -> ScenarioReport:
+        from ..models.nodeclaim import Phase
+        from ..utils.crashpoints import CrashInjected
+        from .injector import crash_point_hook, device_fault_hook
+        sim, plan = self.build()
+        sc = self.scenario
+        t0 = sim.clock.now()
+        deadline = t0 + sc.timeout
+        horizon = ScenarioRunner._fault_horizon(plan)
+        self.restarts = 0
+
+        def quiet() -> bool:
+            if plan.crashes_remaining:
+                return False  # scheduled deaths outstanding: keep flying
+            if sim.clock.now() - plan.origin < horizon:
+                return False
+            if sim.store.pending_pods():
+                return False
+            for c in sim.store.nodeclaims.values():
+                if c.is_deleting() or c.phase != Phase.INITIALIZED:
+                    return False
+            if sim.journal.open_intents():
+                return False
+            return not len(sim.cloud.interruptions)
+
+        converged = False
+        with device_fault_hook(plan), crash_point_hook(plan):
+            while True:
+                remaining = deadline - sim.clock.now()
+                if remaining <= 0:
+                    converged = quiet()
+                    break
+                try:
+                    converged = sim.engine.run_until(quiet,
+                                                     timeout=remaining,
+                                                     step=sc.step)
+                    break
+                except CrashInjected:
+                    self.restarts += 1
+                    sim = self._restart(sim, plan)
+        violations = check_invariants(sim) + restart_invariants(sim)
+        stats = {
+            "restarts": float(self.restarts),
+            "launch_dedups": float(sim.cloud.api_calls.get("launch_dedup",
+                                                           0)),
+            "intents_opened": float(sim.journal.stats["opened"]),
+            "intents_committed": float(sim.journal.stats["committed"]),
+            "intents_aborted": float(sim.journal.stats["aborted"]),
+            "intents_reaped": float(sim.journal.stats["reaped"]),
+            "gc_inflight_skipped": float(
+                sim.gc.stats.get("inflight_skipped", 0)),
+            "ice_marks": sim.catalog.unavailable.stats["marks"],
+        }
+        if sim.warmpath is not None:
+            stats["warm_divergences"] = float(
+                sim.warmpath.stats["divergences"])
+            if sim.warmpath.stats["divergences"]:
+                violations.append(
+                    f"warm-path auditor diverged "
+                    f"{sim.warmpath.stats['divergences']} time(s) "
+                    f"post-restart")
         report = ScenarioReport(
             scenario=sc.name, seed=self.seed, converged=converged,
             violations=violations, end_hash=state_hash(sim),

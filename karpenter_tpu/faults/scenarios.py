@@ -22,7 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
-from .plan import (ApiFault, ClockJump, DeviceFault, IceWindow,
+from .plan import (ApiFault, ClockJump, CrashPoint, DeviceFault, IceWindow,
                    InterruptionBurst)
 
 
@@ -42,6 +42,11 @@ class Scenario:
     # auditor divergence == 0 — the warm path may only ever fall COLD
     # under weather, never place wrong
     warmpath: bool = False
+    # the plan carries CrashPoint rules: the engine WILL be torn down
+    # mid-run and must be driven by runner.RestartRunner (which rebuilds
+    # the stack on the surviving cloud/clock/journal and re-lists the
+    # workload); ScenarioRunner cannot run these
+    restart: bool = False
 
 
 # --- workloads -------------------------------------------------------------
@@ -130,6 +135,13 @@ SCENARIOS = {}
 
 
 def _register(sc: Scenario) -> Scenario:
+    # the restart flag routes the scenario to RestartRunner (the only
+    # runner that survives a fired CrashPoint) — a mismatch would either
+    # crash ScenarioRunner mid-run or silently never arm the deaths
+    has_crash = any(isinstance(r, CrashPoint) for r in sc.build_rules())
+    assert has_crash == sc.restart, (
+        f"scenario {sc.name!r}: restart={sc.restart} but its rules "
+        f"{'do' if has_crash else 'do not'} contain CrashPoint")
     SCENARIOS[sc.name] = sc
     return sc
 
@@ -288,6 +300,95 @@ _register(Scenario(
     workload=_warm_trickle_workload,
     timeout=900.0,
     warmpath=True))
+
+
+# --- crash-restart scenarios (driven by runner.RestartRunner) --------------
+
+
+def _storm_waves(*waves):
+    """Mixed-size staged arrivals for restart scenarios: waves of
+    (t, n, prefix, podkw). Restart-safe by construction: the fired-set
+    lives inside the per-call closure, so re-invoking the workload on a
+    rebuilt sim re-lists every already-due wave (the watch re-sync) and
+    later waves still arrive on schedule."""
+    def workload(sim):
+        origin = (sim.fault_plan.origin if sim.fault_plan is not None
+                  else sim.clock.now())
+        fired = set()
+
+        def fire_due(now: float) -> None:
+            for t, n, prefix, kw in waves:
+                if prefix not in fired and now - origin >= t:
+                    fired.add(prefix)
+                    _add_pods(sim, n, prefix=prefix, **kw)
+        fire_due(sim.clock.now())
+        sim.engine.add_hook(fire_due)
+    return workload
+
+
+_register(Scenario(
+    name="restart_smoke",
+    description="Tier-1 crash-restart member: the operator dies once "
+                "POST-LAUNCH (instances minted, nothing committed — "
+                "restart must adopt them via intent replay, never "
+                "double-launch) and once MID-LAUNCH-BATCH on a later "
+                "wave (intents open, nothing launched — restart must "
+                "abort them and re-solve). Zero leaked instances, zero "
+                "duplicate launches, all pods bound.",
+    build_rules=lambda: [
+        CrashPoint(point="post_launch", nth=1),
+        CrashPoint(point="mid_launch_batch", nth=2, at=10.0),
+    ],
+    workload=_storm_waves(
+        (0.0, 12, "p0", dict(cpu="2", mem="4Gi")),
+        (15.0, 12, "p1", dict(cpu="2", mem="4Gi"))),
+    timeout=300.0,
+    restart=True))
+
+_register(Scenario(
+    name="crash_launch_storm",
+    description="Crash-restart under weather with the warm path armed: "
+                "the operator dies post-launch during the initial fleet "
+                "build, then MID-WARM-AUDIT during a warm trickle "
+                "(nominations made, audit unproven — the rebuilt "
+                "process must force cold and re-solve), then "
+                "mid-launch-batch when a late big wave forces new "
+                "launches through an API brownout. Divergence-free "
+                "audits post-restart, no duplicate launches.",
+    build_rules=lambda: [
+        CrashPoint(point="post_launch", nth=1),
+        CrashPoint(point="mid_warm_audit", nth=1, at=15.0),
+        CrashPoint(point="mid_launch_batch", nth=2, at=50.0),
+        ApiFault(("create_fleet", "describe"), 55.0, 90.0, p=0.2,
+                 error="rate_limited", retry_after=2.0),
+    ],
+    workload=_storm_waves(
+        (0.0, 24, "w0", dict(cpu="2", mem="2Gi")),
+        (20.0, 8, "w1", dict(cpu="200m", mem="256Mi")),
+        (35.0, 8, "w2", dict(cpu="200m", mem="256Mi")),
+        (60.0, 24, "w3", dict(cpu="2", mem="2Gi"))),
+    timeout=600.0,
+    warmpath=True,
+    restart=True))
+
+_register(Scenario(
+    name="crash_drain",
+    description="The operator dies MID-DRAIN: a spot reclaim wave "
+                "starts draining nodes and the process crashes between "
+                "deleting the store node and terminating the instance — "
+                "restart must resurrect the claim from its adoption "
+                "tags (instance still running, nothing leaked, nothing "
+                "double-terminated); a later kill burst proves the "
+                "rebuilt stack still recovers dead capacity.",
+    build_rules=lambda: [
+        InterruptionBurst(at=40.0, count=2, kind="spot"),
+        CrashPoint(point="mid_drain", nth=1, at=35.0),
+        InterruptionBurst(at=150.0, count=1, kind="kill"),
+    ],
+    workload=_storm_waves(
+        (0.0, 20, "p0", dict(cpu="2", mem="4Gi"))),
+    timeout=600.0,
+    restart=True))
 
 
 def get_scenario(name: str) -> Scenario:

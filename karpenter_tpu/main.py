@@ -74,10 +74,14 @@ def build_operator(options: Optional[Options] = None,
         from .warmpath import WarmPathEngine
         warm_engine = WarmPathEngine(store, solver, catalog,
                                      audit_every=opts.warmpath_audit_every)
+    # provisioning write-ahead log: file-backed when configured, so a
+    # restarted operator replays its predecessor's open launch intents
+    from .state.journal import IntentJournal
+    journal = IntentJournal(path=opts.intent_journal_file or None)
     provisioner = Provisioner(store=store, solver=solver, cloud=bcloud,
                               catalog=catalog,
                               batch_idle=opts.batch_idle_seconds,
-                              warmpath=warm_engine)
+                              warmpath=warm_engine, journal=journal)
     lifecycle = LifecycleController(store=store, cloud=bcloud)
     binding = BindingController(store=store)
     termination = TerminationController(store=store, cloud=bcloud,
@@ -87,7 +91,8 @@ def build_operator(options: Optional[Options] = None,
                                       provisioner=provisioner,
                                       termination=termination,
                                       spot_to_spot=opts.gate("SpotToSpotConsolidation"))
-    gc = GarbageCollectionController(store=store, cloud=bcloud)
+    gc = GarbageCollectionController(store=store, cloud=bcloud,
+                                     journal=journal)
     metrics_c = CloudProviderMetricsController(catalog=catalog, store=store)
     from .cloud.image import ImageProvider
     from .controllers.auxiliary import (CatalogRefreshController,
@@ -144,6 +149,10 @@ def build_operator(options: Optional[Options] = None,
     runtime = Runtime(clock=clock, metrics_port=opts.metrics_port,
                       elector=elector)
     runtime.add(*controllers)
+    # clean stop must ship any termination batch still waiting on its
+    # idle window — dropping it would leak instances until the next
+    # process's GC sweep
+    runtime.on_stop.append(bcloud.shutdown)
 
     class _CloudTicker:
         name = "cloud.tick"
@@ -158,7 +167,10 @@ def build_operator(options: Optional[Options] = None,
     store.add_nodepool(NodePool(name="default"))
     nodeclass_c.reconcile(clock.now())  # sync hydrate before start
     from .state.rehydrate import rehydrate
-    rehydrate(store, cloud, catalog, clock.now())  # adopt fleet after restart
+    rehydrate(store, cloud, catalog, clock.now(),
+              journal=journal)  # adopt fleet + replay intents after restart
+    if warm_engine is not None:
+        warm_engine.on_restart()  # never trust a warm window across a boot
     return runtime, store, cloud
 
 

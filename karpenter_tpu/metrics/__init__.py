@@ -189,6 +189,31 @@ ENCODE_CACHE_ROWS = REGISTRY.gauge(
     "Signature rows resident across the solver's encode-cache contexts "
     "(bounded: a small context LRU × a per-context row cap with "
     "intern-style rotation)")
+LAUNCH_DEDUP = REGISTRY.counter(
+    "karpenter_tpu_launch_dedup_total",
+    "CreateFleet requests the cloud deduplicated by idempotency token: a "
+    "replayed launch (crash-restart resending a journaled request, or a "
+    "retry racing its own in-flight attempt) returned the instance the "
+    "token already minted instead of provisioning a second one — nonzero "
+    "after a crash is the resilience layer WORKING; a double-provision "
+    "would show up as a duplicate-launch invariant violation instead")
+INTENT_JOURNAL_OPEN = REGISTRY.gauge(
+    "karpenter_tpu_intent_journal_open",
+    "Provisioning intents currently open in the write-ahead intent "
+    "journal (state/journal.py): launches recorded before their "
+    "CreateFleet call whose commit has not resolved yet. Steady-state "
+    "this is 0 between reconciles; a persistently nonzero value means a "
+    "launch died between the wire call and the commit and is waiting "
+    "for restart replay — the GC sweep will not touch its instance")
+RESTART_ADOPTIONS = REGISTRY.counter(
+    "karpenter_tpu_restart_adoptions_total",
+    "Open-intent resolutions during restart rehydration "
+    "(state/rehydrate.replay_intents), by outcome: adopted = a live "
+    "token-tagged instance was re-bound to its rebuilt NodeClaim, "
+    "aborted = the crash landed before the wire call (nothing "
+    "launched), reaped = a live instance whose claim could not be "
+    "rebuilt was terminated immediately instead of leaking until GC",
+    ("outcome",))
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

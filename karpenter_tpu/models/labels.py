@@ -89,6 +89,11 @@ TAG_NODECLASS_HASH = f"{_G}/nodeclass-hash"
 TAG_NODECLASS_HASH_VERSION = f"{_G}/nodeclass-hash-version"
 TAG_NODEPOOL_HASH = f"{_G}/nodepool-hash"
 TAG_NODEPOOL_HASH_VERSION = f"{_G}/nodepool-hash-version"
+# launch idempotency token (state/journal.launch_token), stamped on the
+# instance at launch: restart replay matches open intents to the
+# instances they actually minted by this tag, and the GC sweep skips
+# instances whose token still has an open intent (launch in flight)
+TAG_LAUNCH_TOKEN = f"{_G}/launch-token"
 
 # restricted: users may not set these directly on NodePool templates
 RESTRICTED_LABELS = frozenset({NODEPOOL, NODE_INITIALIZED, NODE_REGISTERED, HOSTNAME})

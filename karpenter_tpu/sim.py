@@ -50,6 +50,11 @@ class SimEnvironment:
     # arrival-only reconciles admit against the standing headroom ledger
     # instead of paying a full solve; None = every reconcile is cold
     warmpath: Optional[object] = None
+    # state.journal.IntentJournal: the provisioning write-ahead log.
+    # Always present; pass the previous stack's journal to make_sim
+    # (with its cloud) to simulate a crash-restart — open intents replay
+    # during rehydration
+    journal: Optional[object] = None
 
     def start_chaos(self, interval: float = 60.0, seed: int = 0) -> None:
         """kwok kill-node-thread analog (kwok/ec2/ec2.go:253-282): kill a
@@ -85,10 +90,14 @@ def make_sim(types: Optional[List[InstanceType]] = None,
              clock: Optional[FakeClock] = None,
              fault_plan: Optional[object] = None,
              warmpath: bool = False,
-             warm_audit_every: int = 1) -> SimEnvironment:
+             warm_audit_every: int = 1,
+             journal: Optional[object] = None) -> SimEnvironment:
     """Passing an existing `cloud` (+ its clock) simulates an operator
     restart: the new stack rehydrates its fresh Store from the cloud's
-    durable state instead of starting empty-world.
+    durable state instead of starting empty-world. Passing the previous
+    stack's intent `journal` alongside replays its open launch intents
+    (adopt-or-reap) during that rehydration — the crash-window recovery
+    path (state/journal.py).
 
     fault_plan: an armed faults.FaultPlan — every controller then speaks
     to the cloud through a faults.injector.FaultyCloud decorator (injected
@@ -110,14 +119,23 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     # api_cloud is what controllers hold; identical to `cloud` unless a
     # fault plan interposes the injection decorator
     api_cloud = cloud
+    from .state.journal import IntentJournal
+    journal = journal if journal is not None else IntentJournal()
     if fault_plan is not None:
         from .faults.injector import FaultyCloud
+        # first install on this clock stamps the origin and schedules the
+        # skew jumps; a RE-install (the restart harness rebuilding a
+        # stack on the surviving clock) must do neither — rule times stay
+        # relative to the ORIGINAL run start, and jumps already consumed
+        # or scheduled must not double-apply
+        first_install = fault_plan.clock is not clock
         fault_plan.clock = clock
-        fault_plan.origin = clock.now()        # rule times are run-relative
+        if first_install:
+            fault_plan.origin = clock.now()    # rule times are run-relative
+            for j in fault_plan.clock_jumps:   # skew
+                clock.schedule_jump(fault_plan.origin + j.at, j.delta,
+                                    fault_plan.on_jump)
         cloud.fault_plan = fault_plan          # ICE windows
-        for j in fault_plan.clock_jumps:       # skew
-            clock.schedule_jump(fault_plan.origin + j.at, j.delta,
-                                fault_plan.on_jump)
         api_cloud = FaultyCloud(cloud, fault_plan, clock)
     # the catalog's backend listing goes through the gated view too, so
     # an ApiFault on "describe_types" really browns out catalog refresh
@@ -135,7 +153,8 @@ def make_sim(types: Optional[List[InstanceType]] = None,
         warm_engine = WarmPathEngine(store, solver, catalog,
                                      audit_every=warm_audit_every)
     provisioner = Provisioner(store=store, solver=solver, cloud=api_cloud,
-                              catalog=catalog, warmpath=warm_engine)
+                              catalog=catalog, warmpath=warm_engine,
+                              journal=journal)
     lifecycle = LifecycleController(store=store, cloud=api_cloud)
     binding = BindingController(store=store)
     termination = TerminationController(store=store, cloud=api_cloud,
@@ -146,7 +165,8 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     interruption = InterruptionController(store=store, cloud=api_cloud,
                                           catalog=catalog,
                                           termination=termination)
-    gc = GarbageCollectionController(store=store, cloud=api_cloud)
+    gc = GarbageCollectionController(store=store, cloud=api_cloud,
+                                     journal=journal)
     from .cloud.image import ImageProvider
     from .controllers.auxiliary import (CatalogRefreshController,
                                         DiscoveredCapacityController,
@@ -226,11 +246,19 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     store.add_nodepool(nodepool or NodePool(name="default"))
     nodeclass_c.reconcile(clock.now())  # sync hydrate (operator.go:151 analog)
     from .state.rehydrate import rehydrate
-    rehydrate(store, cloud, catalog, clock.now())  # adopt any pre-existing fleet
+    rh = rehydrate(store, cloud, catalog, clock.now(),
+                   journal=journal)  # adopt any pre-existing fleet
+    if warm_engine is not None and (rh["claims_adopted"]
+                                    or rh["intents_adopted"]
+                                    or rh["intents_aborted"]
+                                    or rh["intents_reaped"]):
+        # this stack took over a live fleet: the warm window must open
+        # cold (no predecessor ledger is trustworthy across a restart)
+        warm_engine.on_restart()
     return SimEnvironment(clock=clock, store=store, cloud=cloud,
                           catalog=catalog, solver=solver, engine=engine,
                           provisioner=provisioner, lifecycle=lifecycle,
                           binding=binding, termination=termination,
                           disruption=disruption, interruption=interruption,
                           gc=gc, fault_plan=fault_plan,
-                          warmpath=warm_engine)
+                          warmpath=warm_engine, journal=journal)

@@ -26,7 +26,8 @@ from ..models.labels import (TAG_NODECLAIM, TAG_NODECLASS, TAG_NODECLASS_HASH,
                              TAG_NODEPOOL_HASH, TAG_NODEPOOL_HASH_VERSION)
 
 
-def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0) -> Dict[str, int]:
+def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0,
+              journal=None) -> Dict[str, int]:
     """Rebuild Store from the cloud's durable state; marks the store hydrated.
 
     Idempotent: instances already backed by a NodeClaim (matched on
@@ -34,8 +35,18 @@ def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0) -> Dict[str, 
     a warm store is a no-op. Untagged instances are not ours — they are
     left alone (the reference GC likewise only considers instances carrying
     the cluster's ownership tags).
+
+    journal: the predecessor process's provisioning intent journal
+    (state/journal.IntentJournal). Open intents — launches the dead
+    process recorded but never resolved — are replayed AFTER tag
+    adoption: each either adopts the instance its token actually minted,
+    aborts (the crash landed before the wire call), or reaps a live
+    instance whose claim could not be rebuilt. Replaying twice is a
+    no-op (resolved intents leave the open set).
     """
-    stats = {"nodes_adopted": 0, "claims_adopted": 0}
+    stats = {"nodes_adopted": 0, "claims_adopted": 0,
+             "intents_adopted": 0, "intents_aborted": 0,
+             "intents_reaped": 0}
     # 1. nodes: node objects live with the cluster and survive operator
     #    restarts (in k8s they sit in the API server; our fake cloud plays
     #    the kubelet/API-server side and exposes them via describe_nodes)
@@ -90,6 +101,8 @@ def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0) -> Dict[str, 
         # instance to GC)
         from ..models.nodeclaim import advance_name_sequence
         advance_name_sequence(max_suffix)
+    if journal is not None and journal.open_intents():
+        replay_intents(store, cloud, journal, instances, now, stats)
     store.hydrated = True
     if stats["claims_adopted"]:
         # disruption honors a settle window after adoption so workloads can
@@ -97,6 +110,66 @@ def rehydrate(store: Store, cloud, catalog=None, now: float = 0.0) -> Dict[str, 
         # reference's analog: disruption waits for cluster-state sync)
         store.adopted_at = now
     return stats
+
+
+def replay_intents(store: Store, cloud, journal, instances, now: float,
+                   stats: Dict[str, int]) -> None:
+    """Resolve the dead process's open launch intents deterministically:
+
+    - a live instance carrying the intent's token tag + a rebuilt claim
+      tracking it → the crash landed between the wire call and the
+      commit; the tag adoption above already rebuilt the claim, so the
+      intent simply commits (``adopted``);
+    - a live token-tagged instance with NO rebuilt claim (adoption tags
+      stripped, nodepool gone) → reap it NOW instead of leaking it until
+      the GC sweep (``reaped``);
+    - no instance for the token → the crash landed before the wire call
+      (or the launch failed); nothing exists, the intent closes
+      (``aborted``) and the re-listed pods re-solve normally.
+
+    Metered per outcome (`karpenter_tpu_restart_adoptions_total`) and
+    trace-visible as a `restart.adopt` span."""
+    from ..cloud.provider import CloudError
+    from ..metrics import RESTART_ADOPTIONS
+    from ..obs.tracer import NOOP_SPAN, TRACER
+    open_intents = journal.open_intents()
+    sp = (TRACER.span("restart.adopt", intents=len(open_intents))
+          if TRACER.enabled else NOOP_SPAN)
+    with sp:
+        by_token = {}
+        for inst in instances:
+            tok = inst.tags.get(L.TAG_LAUNCH_TOKEN)
+            if tok and inst.state != "terminated":
+                by_token[tok] = inst
+        for intent in open_intents:
+            inst = by_token.get(intent.token)
+            if inst is None:
+                journal.resolve(intent, "aborted", now=now)
+                stats["intents_aborted"] += 1
+                RESTART_ADOPTIONS.inc(outcome="aborted")
+                continue
+            claim = store.nodeclaims.get(intent.claim_name)
+            if claim is not None and claim.provider_id == inst.provider_id:
+                journal.resolve(intent, "committed",
+                                provider_id=inst.provider_id, now=now)
+                stats["intents_adopted"] += 1
+                RESTART_ADOPTIONS.inc(outcome="adopted")
+                store.record_event("nodeclaim", intent.claim_name,
+                                   "IntentAdopted",
+                                   f"open intent resolved to {inst.id}")
+            else:
+                try:
+                    cloud.terminate([inst.id])
+                except CloudError:
+                    pass  # intent closes either way; GC backstops the reap
+                journal.resolve(intent, "reaped", now=now)
+                stats["intents_reaped"] += 1
+                RESTART_ADOPTIONS.inc(outcome="reaped")
+                store.record_event("instance", inst.id, "IntentReaped",
+                                   f"unadoptable launch of {intent.claim_name}")
+        sp.set(adopted=stats["intents_adopted"],
+               aborted=stats["intents_aborted"],
+               reaped=stats["intents_reaped"])
 
 
 def _describe_with_retry(cloud, attempts: int = 6):

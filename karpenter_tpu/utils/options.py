@@ -51,6 +51,13 @@ class Options:
     # backend and removes the shared-RWX-volume requirement
     leader_elect_endpoint: str = ""
     leader_elect_identity: str = ""       # default: hostname-pid
+    # provisioning intent journal (state/journal.py): the write-ahead
+    # log of launches, fsync'd before every CreateFleet call. Set a path
+    # in production — a restarted operator replays the file's open
+    # intents (adopt-or-reap) during rehydration; empty keeps the
+    # journal in-memory (crash recovery then rests on adoption tags +
+    # idempotency tokens alone)
+    intent_journal_file: str = ""
     # warm-path audit cadence: every K-th warm admission window is
     # replayed through a full solve (docs/warmpath.md; tier-1 tests and
     # chaos scenarios run at 1 = always-on). Only read when the

@@ -63,6 +63,17 @@ class Auditor:
         self._since_audit = 0
         self.stats = {"audits": 0, "divergences": 0, "audited_pods": 0}
 
+    def reset(self) -> None:
+        """Restart: recorded-but-unaudited warm batches died with the
+        old process, and the baselines they were admitted against
+        describe a store that no longer exists — replaying them against
+        a rebuilt baseline would manufacture false divergences. Drop
+        everything; the forced-cold commit that follows a restart
+        (WarmPathEngine.on_restart) re-establishes audit coverage."""
+        self._baselines = {}
+        self._batches = {}
+        self._since_audit = 0
+
     # --- commit-time snapshot ---
     def on_commit(self, ledgers: Dict[str, PoolLedger],
                   occupancy: List[Tuple[Optional[str], List[Pod]]]) -> None:

@@ -30,6 +30,7 @@ from ..models.pod import Pod
 from ..obs.tracer import NOOP_SPAN, TRACER
 from ..ops.facade import Solver
 from ..state.store import Store
+from ..utils import crashpoints
 from .admitter import PoolLedger, WarmAdmitter, build_pool_ledger
 from .auditor import Auditor
 from .delta import DeltaTracker
@@ -162,8 +163,22 @@ class WarmPathEngine:
         self._publish()
         return admitted > 0, escalated
 
+    def on_restart(self, reason: str = "restart") -> None:
+        """A rebuilt operator may NOT trust a warm window: the ledgers,
+        baselines, and recorded-but-unaudited batches all described the
+        dead process's view of the cluster. Drop them and force the next
+        reconcile cold — the full solve + commit rebuilds coverage from
+        the adopted fleet (called by make_sim/rehydrate after a restart
+        adoption)."""
+        self.auditor.reset()
+        self.ledgers = {}
+        self._occupancy = []
+        self._occ_by_claim = {}
+        self.force_cold(reason)
+
     def _run_audit(self) -> None:
-        divergences = self.auditor.audit()
+        crashpoints.fire("mid_warm_audit")  # cut point: admissions
+        divergences = self.auditor.audit()  # nominated, audit unproven
         if divergences:
             self.stats["divergences"] += len(divergences)
             WARMPATH_DIVERGENCE.inc(len(divergences))

@@ -4,7 +4,7 @@ observability, and the colocated-bundle interruption wave."""
 import pytest
 
 from karpenter_tpu.faults import (FaultPlan, InterruptionBurst,
-                                  ScenarioRunner, SCENARIOS)
+                                  RestartRunner, ScenarioRunner, SCENARIOS)
 from karpenter_tpu.obs.tracer import TRACER
 
 
@@ -22,8 +22,14 @@ def tracer():
      TRACER.trace_dir, TRACER.drop_empty) = saved
 
 
-FAST = sorted(n for n, sc in SCENARIOS.items() if not sc.slow)
-SLOW = sorted(n for n, sc in SCENARIOS.items() if sc.slow)
+# restart scenarios tear the engine down mid-run — only RestartRunner
+# (which rebuilds the stack on the surviving durable state) can drive
+# them; they get their own class below
+FAST = sorted(n for n, sc in SCENARIOS.items()
+              if not sc.slow and not sc.restart)
+SLOW = sorted(n for n, sc in SCENARIOS.items()
+              if sc.slow and not sc.restart)
+RESTART = sorted(n for n, sc in SCENARIOS.items() if sc.restart)
 
 
 class TestScenarioCatalog:
@@ -64,6 +70,55 @@ class TestScenarioCatalog:
         assert a.ok and b.ok
         assert a.fault_fingerprint == b.fault_fingerprint
         assert a.end_hash == b.end_hash
+
+
+class TestRestartScenarios:
+    """Crash-restart resilience (docs/robustness.md 'Restart & crash
+    recovery'): the engine is torn down at seeded cut points and rebuilt
+    from durable state (cloud + intent journal); the run must end with
+    zero leaked instances, zero duplicate launches, all pods bound, and
+    a fully resolved journal."""
+
+    @pytest.mark.parametrize("name", RESTART)
+    def test_restart_scenarios_converge(self, name):
+        """Acceptance: every restart scenario converges with clean
+        invariants (check_invariants + restart_invariants — the latter
+        adds journal-resolved and no-duplicate-launch) and actually
+        crashed at least once."""
+        rep = RestartRunner(name, seed=0).run()
+        assert rep.converged, rep.summary()
+        assert not rep.violations, rep.summary()
+        assert rep.stats["restarts"] >= 1, (
+            f"{name} converged without a single injected crash — the "
+            f"scenario's deaths never happened")
+        assert rep.stats["intents_opened"] > 0
+        # every opened intent resolved one way or another
+        assert (rep.stats["intents_committed"]
+                + rep.stats["intents_aborted"]
+                + rep.stats["intents_reaped"]
+                == rep.stats["intents_opened"])
+
+    def test_restart_smoke_reproducible(self):
+        """restart_smoke: same seed ⇒ identical fault timeline (crash
+        firings included) and identical end-state hash, across the
+        teardown/rebuild cycles."""
+        a = RestartRunner("restart_smoke", seed=5).run()
+        b = RestartRunner("restart_smoke", seed=5).run()
+        assert a.ok and b.ok, (a.summary(), b.summary())
+        assert a.fault_fingerprint == b.fault_fingerprint
+        assert a.end_hash == b.end_hash
+        assert a.stats["restarts"] == b.stats["restarts"] >= 1
+
+    def test_crash_storm_warm_path_forced_cold_and_divergence_free(self):
+        """The warm path may never survive a restart: the rebuilt engine
+        opens cold, and every post-restart warm audit must be
+        divergence-free."""
+        runner = RestartRunner("crash_launch_storm", seed=0)
+        rep = runner.run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["warm_divergences"] == 0
+        sim = runner.last_sim
+        assert sim.warmpath is not None
 
 
 class TestIceStormObservability:
@@ -225,10 +280,13 @@ class TestZeroOverheadWhenDisabled:
     def test_plain_sim_has_no_armed_hooks(self):
         from karpenter_tpu.ops import solver as solver_mod
         from karpenter_tpu.sim import make_sim
+        from karpenter_tpu.utils import crashpoints
         sim = make_sim()
         assert sim.fault_plan is None
         assert sim.cloud.fault_plan is None
         assert sim.clock._jumps == []
         assert solver_mod._dispatch_fault_hook is None
+        # the crash-point seams are disarmed too (one None check each)
+        assert crashpoints._hook is None
         # controllers hold the raw cloud — no decorator in the path
         assert sim.provisioner.cloud is sim.cloud

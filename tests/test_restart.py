@@ -1,0 +1,513 @@
+"""Crash-restart resilience layer: launch idempotency tokens, the
+provisioning intent journal, the GC in-flight gate, the batcher's
+clean-stop flush, and the rehydrate retry/replay paths
+(docs/robustness.md "Restart & crash recovery")."""
+
+import asyncio
+
+import pytest
+
+from karpenter_tpu.catalog import small_catalog
+from karpenter_tpu.cloud.batcher import BatchingCloud
+from karpenter_tpu.cloud.fake import FakeCloud, FakeCloudConfig
+from karpenter_tpu.cloud.provider import (Instance, LaunchOverride,
+                                          LaunchRequest, RateLimitedError)
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.sim import make_sim
+from karpenter_tpu.state.journal import IntentJournal, launch_token
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _mk_cloud(clock=None, **cfg):
+    clock = clock or FakeClock()
+    config = FakeCloudConfig(**cfg) if cfg else None
+    return FakeCloud(small_catalog(), clock=clock, config=config), clock
+
+
+def _request(token="", name="nc-test-1"):
+    return LaunchRequest(
+        nodeclaim_name=name,
+        overrides=[LaunchOverride("m5.large", "zone-a", "on-demand", 0.1)],
+        tags={L.TAG_NODECLAIM: name, L.TAG_LAUNCH_TOKEN: token},
+        idempotency_token=token)
+
+
+def add_pods(sim, n, cpu="2", mem="4Gi", prefix="p"):
+    pods = [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+            for i in range(n)]
+    for p in pods:
+        sim.store.add_pod(p)
+    return pods
+
+
+def all_bound(sim):
+    return all(p.node_name is not None for p in sim.store.pods.values())
+
+
+class TestIdempotencyToken:
+    def test_token_is_deterministic_and_attempt_sensitive(self):
+        a = launch_token("nc-1", "poolhash", 1)
+        assert a == launch_token("nc-1", "poolhash", 1)
+        assert a != launch_token("nc-1", "poolhash", 2)
+        assert a != launch_token("nc-2", "poolhash", 1)
+        assert a != launch_token("nc-1", "otherpool", 1)
+
+    def test_replayed_launch_dedupes_to_original_instance(self):
+        """The crash-restart double-launch guard: re-sending the same
+        request (same token) returns the instance the token minted, not
+        a second one."""
+        from karpenter_tpu.metrics import LAUNCH_DEDUP
+        cloud, _ = _mk_cloud()
+        tok = launch_token("nc-test-1", "ph", 1)
+        before = LAUNCH_DEDUP.value()
+        (first,) = cloud.create_fleet([_request(tok)])
+        assert isinstance(first, Instance)
+        (replay,) = cloud.create_fleet([_request(tok)])
+        assert replay is first
+        assert len(cloud.instances) == 1
+        assert cloud.api_calls["launch_dedup"] == 1
+        assert LAUNCH_DEDUP.value() == before + 1
+
+    def test_dedupe_wins_even_after_capacity_exhausted(self):
+        """EC2 client-token semantics: the replay returns the original
+        instance even if the pool has since run dry — the replay must
+        not surface a spurious ICE for capacity the original launch
+        already consumed."""
+        cloud, _ = _mk_cloud()
+        cloud.set_capacity("m5.large", "zone-a", "on-demand", 1)
+        tok = launch_token("nc-test-1", "ph", 1)
+        (first,) = cloud.create_fleet([_request(tok)])
+        assert isinstance(first, Instance)
+        (replay,) = cloud.create_fleet([_request(tok)])
+        assert replay is first
+
+    def test_empty_token_never_dedupes(self):
+        cloud, _ = _mk_cloud()
+        a, b = (cloud.create_fleet([_request("")])[0],
+                cloud.create_fleet([_request("")])[0])
+        assert a.id != b.id
+
+    def test_token_survives_snapshot_restore(self):
+        cloud, clock = _mk_cloud()
+        tok = launch_token("nc-test-1", "ph", 1)
+        (first,) = cloud.create_fleet([_request(tok)])
+        snap = cloud.snapshot()
+        cloud2, _ = _mk_cloud(clock=clock)
+        cloud2.restore(snap)
+        (replay,) = cloud2.create_fleet([_request(tok)])
+        assert replay.id == first.id and len(cloud2.instances) == 1
+
+    def test_token_round_trips_the_wire_codec(self):
+        """cloud/remote.py: the token is part of the LaunchRequest wire
+        shape — a gateway that dropped it would silently disable the
+        dedupe layer for remote deployments."""
+        from karpenter_tpu.cloud.remote import decode, encode
+        req = _request(launch_token("nc-test-1", "ph", 1))
+        back = decode(encode(req))
+        assert back.idempotency_token == req.idempotency_token
+        assert back.tags[L.TAG_LAUNCH_TOKEN] == req.tags[L.TAG_LAUNCH_TOKEN]
+
+    def test_token_dedupes_through_remote_server(self):
+        """Full RPC path: two create_fleet calls with the same token
+        against a served cloud mint ONE instance."""
+        from karpenter_tpu.cloud.remote import RemoteCloud, serve_in_thread
+        cloud, _ = _mk_cloud()
+        srv, port = serve_in_thread(cloud)
+        try:
+            rc = RemoteCloud("127.0.0.1", port)
+            tok = launch_token("nc-test-1", "ph", 1)
+            (a,) = rc.create_fleet([_request(tok)])
+            (b,) = rc.create_fleet([_request(tok)])
+            assert isinstance(a, Instance) and isinstance(b, Instance)
+            assert a.id == b.id
+            assert len(cloud.instances) == 1
+        finally:
+            srv.shutdown()
+
+    def test_token_passes_through_batcher(self):
+        """cloud/batcher.py create_fleet is a pass-through: the request
+        OBJECTS (tokens included) reach the wire untouched, so a replay
+        through the batching wrapper still dedupes."""
+        cloud, clock = _mk_cloud()
+        bcloud = BatchingCloud(cloud, clock)
+        tok = launch_token("nc-test-1", "ph", 1)
+        (a,) = bcloud.create_fleet([_request(tok)])
+        (b,) = bcloud.create_fleet([_request(tok)])
+        assert a.id == b.id and len(cloud.instances) == 1
+
+
+class TestIntentJournal:
+    def test_open_resolve_lifecycle_and_gauge(self):
+        from karpenter_tpu.metrics import INTENT_JOURNAL_OPEN
+        j = IntentJournal()
+        i1 = j.open_launch("nc-1", "default", "default", "tok1", now=1.0)
+        i2 = j.open_launch("nc-2", "default", "default", "tok2", now=1.0)
+        assert j.open_tokens() == {"tok1", "tok2"}
+        assert j.open_claim_names() == {"nc-1", "nc-2"}
+        assert INTENT_JOURNAL_OPEN.value() == 2.0
+        j.resolve(i1, "committed", provider_id="tpu:///z/i-1", now=2.0)
+        j.resolve(i2, "aborted", now=2.0)
+        assert not j.open_intents()
+        assert INTENT_JOURNAL_OPEN.value() == 0.0
+        assert j.stats == {"opened": 2, "committed": 1, "aborted": 1,
+                           "reaped": 0}
+        # the ledger is append-only: both opens and both resolutions
+        assert [r["op"] for r in j.records] == ["open", "open",
+                                                "resolve", "resolve"]
+
+    def test_attempt_counter_advances_per_claim(self):
+        j = IntentJournal()
+        assert j.next_attempt("nc-1") == 1
+        j.open_launch("nc-1", "default", "default", "t", now=0.0)
+        assert j.next_attempt("nc-1") == 2
+        assert j.next_attempt("nc-other") == 1
+
+    def test_file_backing_replays_open_intents(self, tmp_path):
+        """The real-runtime restart path: a journal file whose process
+        died with an open intent resumes with that intent open; resolved
+        intents stay resolved."""
+        path = str(tmp_path / "intents.jsonl")
+        j1 = IntentJournal(path=path)
+        done = j1.open_launch("nc-1", "default", "default", "tok1", now=1.0)
+        j1.resolve(done, "committed", provider_id="tpu:///z/i-1", now=2.0)
+        j1.open_launch("nc-2", "default", "default", "tok2", now=3.0)
+        # "crash": a fresh journal replays the same file
+        j2 = IntentJournal(path=path)
+        assert j2.open_tokens() == {"tok2"}
+        assert j2.next_attempt("nc-2") == 2  # attempts survive the restart
+        # the restored journal carries the predecessor's ledger + stats
+        assert [r["op"] for r in j2.records] == ["open", "resolve", "open"]
+        assert j2.stats["opened"] == 2 and j2.stats["committed"] == 1
+        # truncated trailing line (died mid-append) is skipped, not fatal
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"op": "open", "seq": 99, "claim_na')
+        j3 = IntentJournal(path=path)
+        assert j3.open_tokens() == {"tok2"}
+
+    def test_provisioner_opens_and_commits_intents(self):
+        sim = make_sim()
+        add_pods(sim, 8)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        assert sim.journal.stats["opened"] >= 1
+        assert sim.journal.stats["committed"] == sim.journal.stats["opened"]
+        assert not sim.journal.open_intents()
+        # the instance carries the token its intent recorded
+        committed = [r for r in sim.journal.records if r["op"] == "open"]
+        tokens = {i.tags.get(L.TAG_LAUNCH_TOKEN)
+                  for i in sim.cloud.instances.values()}
+        assert {r["token"] for r in committed} <= tokens
+
+    def test_failed_launch_aborts_intent(self):
+        """A launch the cloud answers with an error closes its intent
+        aborted — nothing for restart replay or the GC gate to hold."""
+        sim = make_sim(cloud_config=FakeCloudConfig(
+            unlimited_capacity=False))
+        add_pods(sim, 2)
+        sim.engine.tick()  # one provisioning pass: every pool is empty
+        assert sim.journal.stats["opened"] >= 1
+        assert sim.journal.stats["aborted"] == sim.journal.stats["opened"]
+        assert not sim.journal.open_intents()
+
+
+class TestNonRetryableLaunchRollback:
+    def test_wholesale_rejection_rolls_back_claims_and_intents(self):
+        """A RAISED non-retryable create_fleet error (auth/validation —
+        rejected wholesale) must not strand PENDING claims or leave
+        intents open: the production Runtime survives the raise, so an
+        open-forever intent would shield stray instances from GC for
+        the process's whole life."""
+        from karpenter_tpu.cloud.provider import UnauthorizedError
+        sim = make_sim()
+        add_pods(sim, 2)
+
+        class _Rejecting:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def create_fleet(self, requests):
+                raise UnauthorizedError("expired credentials")
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        sim.provisioner.cloud = _Rejecting(sim.cloud)
+        with pytest.raises(UnauthorizedError):
+            sim.provisioner.reconcile(sim.clock.now())
+        assert not sim.store.nodeclaims          # rolled back
+        assert not sim.journal.open_intents()    # closed, not stranded
+        assert sim.journal.stats["aborted"] == sim.journal.stats["opened"]
+
+
+class TestGCInflightGate:
+    """Satellite regression: the sweep must not reap an instance whose
+    launch intent is still open (commit in flight / batcher window),
+    even past MIN_AGE — and must reap it once the intent resolves
+    without a claim."""
+
+    def test_open_intent_blocks_reap_until_resolved(self):
+        from karpenter_tpu.controllers.gc import MIN_AGE
+        sim = make_sim()
+        add_pods(sim, 2)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        # simulate a commit that never landed: instance exists, claim
+        # does not, intent open (the exact crash-window shape)
+        tok = launch_token("nc-ghost", "ph", 1)
+        (inst,) = sim.cloud.create_fleet([_request(tok, name="nc-ghost")])
+        intent = sim.journal.open_launch("nc-ghost", "default", "default",
+                                         tok, now=sim.clock.now())
+        sim.clock.step(MIN_AGE + 600)  # far past the age guard
+        sim.gc.reconcile(sim.clock.now())
+        assert sim.cloud.instances[inst.id].state != "terminated"
+        assert sim.gc.stats["inflight_skipped"] >= 1
+        assert sim.gc.stats["instances_reaped"] == 0
+        # intent resolves with no claim -> next sweep reaps
+        sim.journal.resolve(intent, "aborted", now=sim.clock.now())
+        sim.gc.reconcile(sim.clock.now())
+        assert sim.cloud.instances[inst.id].state == "terminated"
+        assert sim.gc.stats["instances_reaped"] == 1
+
+    def test_wedged_intent_stops_shielding_past_grace(self):
+        """The gate is a GRACE window, not an unbounded shield: an
+        intent wedged open longer than INTENT_GRACE (a bug, not an
+        in-flight launch) stops protecting its instance, restoring the
+        pre-journal bounded-leak guarantee."""
+        from karpenter_tpu.controllers.gc import INTENT_GRACE
+        sim = make_sim()
+        tok = launch_token("nc-wedged", "ph", 1)
+        (inst,) = sim.cloud.create_fleet([_request(tok, name="nc-wedged")])
+        sim.journal.open_launch("nc-wedged", "default", "default", tok,
+                                now=sim.clock.now())
+        sim.clock.step(INTENT_GRACE / 2)
+        sim.gc.reconcile(sim.clock.now())
+        assert sim.cloud.instances[inst.id].state != "terminated"
+        sim.clock.step(INTENT_GRACE)  # now well past the window
+        sim.gc.reconcile(sim.clock.now())
+        assert sim.cloud.instances[inst.id].state == "terminated"
+
+    def test_gate_matches_on_claim_name_too(self):
+        """An instance launched before tokens existed (no token tag) is
+        still protected while an intent names its claim."""
+        from karpenter_tpu.controllers.gc import MIN_AGE
+        sim = make_sim()
+        req = _request("", name="nc-legacy")
+        req.tags.pop(L.TAG_LAUNCH_TOKEN)
+        (inst,) = sim.cloud.create_fleet([req])
+        sim.journal.open_launch("nc-legacy", "default", "default",
+                                "some-token", now=sim.clock.now())
+        sim.clock.step(MIN_AGE + 600)
+        sim.gc.reconcile(sim.clock.now())
+        assert sim.cloud.instances[inst.id].state != "terminated"
+
+
+class TestBatcherShutdownFlush:
+    """Satellite: a clean stop must ship the pending termination batch —
+    before this, a stop inside the idle window silently dropped it."""
+
+    def test_shutdown_flushes_pending_window(self):
+        cloud, clock = _mk_cloud()
+        (inst,) = cloud.create_fleet([_request()])
+        bcloud = BatchingCloud(cloud, clock, idle=0.1, max_window=1.0)
+        bcloud.terminate([inst.id])
+        # window not closed: nothing on the wire yet
+        assert cloud.instances[inst.id].state != "terminated"
+        bcloud.shutdown()
+        assert cloud.instances[inst.id].state == "terminated"
+        assert not bcloud._pending
+        bcloud.shutdown()  # idempotent on a drained batcher
+
+    def test_shutdown_overrides_backoff_gate(self):
+        """A batch stuck behind a throttle backoff still flushes on the
+        last call — the gate protects a live process's pacing, not a
+        dying process's data."""
+        cloud, clock = _mk_cloud(terminate_rate=0.0001, terminate_burst=1)
+        insts = cloud.create_fleet([_request() for _ in range(2)])
+        bcloud = BatchingCloud(cloud, clock, idle=0.01)
+        bcloud.terminate([insts[0].id])
+        clock.step(0.05)
+        bcloud.flush()  # consumes the single token
+        assert cloud.instances[insts[0].id].state == "terminated"
+        bcloud.terminate([insts[1].id])
+        clock.step(0.05)
+        bcloud.flush()  # throttled -> backoff gate raised, batch pending
+        assert bcloud._pending and bcloud._retry_after > clock.now()
+        cloud._terminate_bucket.tokens = 1.0  # cloud recovered
+        bcloud.shutdown()
+        assert cloud.instances[insts[1].id].state == "terminated"
+
+    def test_stop_restart_cycle_loses_nothing(self):
+        """End-to-end: terminations queued in a batcher window when the
+        operator stops cleanly are on the wire before the successor
+        boots — the restarted stack sees them gone, and nothing leaks."""
+        sim = make_sim()
+        add_pods(sim, 4)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        bcloud = BatchingCloud(sim.cloud, sim.clock)
+        victim = next(iter(sim.cloud.instances.values()))
+        bcloud.terminate([victim.id])           # queued, window open
+        bcloud.shutdown()                       # clean stop
+        sim2 = make_sim(cloud=sim.cloud, clock=sim.clock,
+                        journal=sim.journal)
+        live = {i.id for i in sim2.cloud.describe()}
+        assert victim.id not in live
+
+    def test_runtime_runs_stop_hooks(self):
+        """controllers/runtime.py: on_stop hooks run after the
+        controller tasks stop (the wiring main.build_operator uses for
+        BatchingCloud.shutdown)."""
+        from karpenter_tpu.controllers.runtime import Runtime
+        flushed = []
+        rt = Runtime(metrics_port=0)
+        rt.on_stop.append(lambda: flushed.append(True))
+
+        async def drive():
+            task = asyncio.create_task(rt.start())
+            await asyncio.sleep(0.02)
+            rt.stop()
+            await task
+        asyncio.run(drive())
+        assert flushed == [True]
+
+
+class TestRehydrateRetryAndReplay:
+    def test_describe_with_retry_survives_throttle_window(self):
+        """Satellite: a restart landing in a throttling window must not
+        crash-loop — the boot-path describe backs off (stepping the
+        injected fake clock) until the window lifts."""
+        from karpenter_tpu.faults import ApiFault, FaultPlan
+        from karpenter_tpu.faults.injector import FaultyCloud
+        from karpenter_tpu.state.rehydrate import _describe_with_retry
+        cloud, clock = _mk_cloud()
+        (inst,) = cloud.create_fleet([_request()])
+        plan = FaultPlan(seed=0, rules=[
+            ApiFault(("describe",), 0.0, 3.0, p=1.0,
+                     error="rate_limited", retry_after=1.0)])
+        plan.clock = clock
+        plan.origin = clock.now()
+        faulty = FaultyCloud(cloud, plan, clock)
+        out = _describe_with_retry(faulty)
+        assert [i.id for i in out] == [inst.id]
+        assert any(k == "api" for _, k, _ in plan.timeline)
+
+    def test_describe_with_retry_gives_up_on_permanent_throttle(self):
+        from karpenter_tpu.faults import ApiFault, FaultPlan
+        from karpenter_tpu.faults.injector import FaultyCloud
+        from karpenter_tpu.state.rehydrate import _describe_with_retry
+        cloud, clock = _mk_cloud()
+        plan = FaultPlan(seed=0, rules=[
+            ApiFault(("describe",), 0.0, p=1.0, error="rate_limited")])
+        plan.clock = clock
+        plan.origin = clock.now()
+        with pytest.raises(RateLimitedError):
+            _describe_with_retry(FaultyCloud(cloud, plan, clock))
+
+    def test_rehydrate_twice_on_warm_store_is_noop(self):
+        """Satellite: adoption idempotency — a second rehydrate of an
+        already-hydrated store adopts nothing, replays nothing, and
+        leaves the claim objects untouched."""
+        from karpenter_tpu.state.rehydrate import rehydrate
+        sim = make_sim()
+        add_pods(sim, 6)
+        assert sim.engine.run_until(lambda: all_bound(sim), timeout=60)
+        claims_before = dict(sim.store.nodeclaims)
+        nodes_before = dict(sim.store.nodes)
+        stats = rehydrate(sim.store, sim.cloud, sim.catalog,
+                          sim.clock.now(), journal=sim.journal)
+        assert stats["claims_adopted"] == 0
+        assert stats["nodes_adopted"] == 0
+        assert stats["intents_adopted"] == stats["intents_aborted"] == 0
+        assert sim.store.nodeclaims == claims_before  # same objects
+        assert sim.store.nodes == nodes_before
+
+    def test_replay_adopts_committed_but_uncommitted_launch(self):
+        """The post-launch/pre-commit crash window: instance exists with
+        tags + token, claim never committed, intent open. Restart must
+        adopt the instance AND resolve the intent committed."""
+        from karpenter_tpu.metrics import RESTART_ADOPTIONS
+        sim1 = make_sim()
+        tok = launch_token("nc-crashed", "ph", 1)
+        req = _request(tok, name="nc-crashed")
+        req.tags[L.TAG_NODEPOOL] = "default"
+        req.tags[L.TAG_NODECLASS] = "default"
+        (inst,) = sim1.cloud.create_fleet([req])
+        sim1.journal.open_launch("nc-crashed", "default", "default", tok,
+                                 now=sim1.clock.now())
+        before = RESTART_ADOPTIONS.value(outcome="adopted")
+        sim2 = make_sim(cloud=sim1.cloud, clock=sim1.clock,
+                        journal=sim1.journal)
+        assert not sim2.journal.open_intents()
+        assert sim2.journal.stats["committed"] == 1
+        claim = sim2.store.nodeclaims.get("nc-crashed")
+        assert claim is not None
+        assert claim.provider_id == inst.provider_id
+        assert RESTART_ADOPTIONS.value(outcome="adopted") == before + 1
+
+    def test_replay_aborts_never_launched_intent(self):
+        """The mid-launch-batch crash window: intent open, nothing on
+        the wire. Restart aborts it; nothing is launched on its
+        behalf."""
+        sim1 = make_sim()
+        sim1.journal.open_launch("nc-never", "default", "default",
+                                 launch_token("nc-never", "ph", 1),
+                                 now=sim1.clock.now())
+        instances_before = len(sim1.cloud.instances)
+        sim2 = make_sim(cloud=sim1.cloud, clock=sim1.clock,
+                        journal=sim1.journal)
+        assert not sim2.journal.open_intents()
+        assert sim2.journal.stats["aborted"] == 1
+        assert len(sim2.cloud.instances) == instances_before
+        assert "nc-never" not in sim2.store.nodeclaims
+
+    def test_replay_reaps_unadoptable_instance(self):
+        """A live token-tagged instance whose claim cannot be rebuilt
+        (adoption tags stripped) is reaped at replay time instead of
+        leaking until the GC sweep."""
+        sim1 = make_sim()
+        tok = launch_token("nc-stripped", "ph", 1)
+        req = _request(tok, name="nc-stripped")
+        req.tags.pop(L.TAG_NODECLAIM)  # unadoptable: no claim tag
+        (inst,) = sim1.cloud.create_fleet([req])
+        sim1.journal.open_launch("nc-stripped", "default", "default", tok,
+                                 now=sim1.clock.now())
+        sim2 = make_sim(cloud=sim1.cloud, clock=sim1.clock,
+                        journal=sim1.journal)
+        assert sim2.journal.stats["reaped"] == 1
+        assert sim1.cloud.instances[inst.id].state == "terminated"
+
+
+class TestCrashPointSeam:
+    def test_unarmed_fire_is_noop(self):
+        from karpenter_tpu.utils import crashpoints
+        assert crashpoints._hook is None
+        crashpoints.fire("post_launch")  # nothing raises
+
+    def test_plan_counts_firings_and_honors_nth_and_at(self):
+        from karpenter_tpu.faults import CrashPoint, FaultPlan
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils.crashpoints import CrashInjected
+        plan = FaultPlan(seed=0, rules=[
+            CrashPoint(point="post_launch", nth=2, at=10.0)])
+        plan.clock = FakeClock()
+        plan.origin = plan.clock.now()
+        plan.on_crash_point("post_launch")      # firing 1: nth not met
+        plan.on_crash_point("mid_drain")        # other point: no count
+        plan.clock.step(5.0)
+        plan.on_crash_point("post_launch")      # firing 2 but rel < at
+        plan.clock.step(6.0)
+        with pytest.raises(CrashInjected):
+            plan.on_crash_point("post_launch")  # firing 3, armed
+        assert plan.crashes_remaining == 0
+        plan.on_crash_point("post_launch")      # consumed: never refires
+        assert [k for _, k, _ in plan.timeline] == ["crash"]
+
+    def test_hook_scoped_by_context_manager(self):
+        from karpenter_tpu.faults import CrashPoint, FaultPlan
+        from karpenter_tpu.faults.injector import crash_point_hook
+        from karpenter_tpu.utils import crashpoints
+        plan = FaultPlan(seed=0, rules=[CrashPoint(point="mid_drain")])
+        plan.clock = FakeClock()
+        with crash_point_hook(plan):
+            assert crashpoints._hook is not None
+        assert crashpoints._hook is None
