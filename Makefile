@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
         deflake run native trace-report chaos crash-audit warmpath-audit \
-        encode-report clean
+        encode-report fleet fleet-audit clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -38,6 +38,13 @@ warmpath-audit:  ## warm-path auditor in always-on mode over the chaos smoke + s
 
 encode-report:  ## columnar encode pipeline: cold vs cached cost + hit rate (PODS=n TICKS=n)
 	$(PY) tools/encode_report.py --pods $(or $(PODS),10000) --ticks $(or $(TICKS),5)
+
+fleet:  ## drive TENANTS (default 50) tenant control planes through one process + one SolverService
+	$(PY) -m karpenter_tpu.fleet fleet_smoke --tenants $(or $(TENANTS),50)
+	$(PY) -m karpenter_tpu.fleet fleet_noisy_neighbor
+
+fleet-audit:  ## fleet reproducibility: fleet_smoke at 2 seeds x --repeat 2, identical per-tenant end-state hashes required
+	$(PY) -m karpenter_tpu.fleet fleet_smoke --seeds 2 --repeat 2
 
 docgen:  ## regenerate docs/reference/* from the live registry + catalog
 	$(PY) tools/gen_docs.py

@@ -563,6 +563,89 @@ def main() -> None:
                  f"{statistics.median(cached_ms):.2f}ms vs cold "
                  f"{c9_cold:.1f}ms")
 
+    progress("c12: tenant fleet through one SolverService vs serial facades")
+    # --- config 12: the fleet multiplexer (docs/fleet.md). N tenant
+    # control planes share ONE SolverService: persistent per-tenant
+    # facades behind a fair queue, one content-keyed SharedCatalogCache
+    # (identical pools share encoded tensors / device uploads / compiled
+    # executables). Baseline = the serial per-tenant facade loop that
+    # serving N tenants from one process otherwise requires: a facade is
+    # bound to ONE CatalogProvider, so each tenant reconcile builds its
+    # own and pays the full catalog list + encode before solving.
+    # Acceptance (ISSUE 6): fleet aggregate solves/sec >= 5x serial.
+    from karpenter_tpu.catalog import CatalogProvider
+    from karpenter_tpu.fleet.service import SolverService
+    from karpenter_tpu.models.nodepool import NodePool as _Pool12
+    from karpenter_tpu.ops.facade import Solver as _Solver12
+    from karpenter_tpu.utils.clock import FakeClock as _Clock12
+    N12, R12, B12 = 16, 10, 48
+    types12 = generate_catalog()
+    pool12 = _Pool12(name="default")
+    bursts12 = [[Pod(name=f"c12-{t}-{i}",
+                     requests=Resources.parse(
+                         {"cpu": shapes[(t + i) % len(shapes)][0],
+                          "memory": shapes[(t + i) % len(shapes)][1]}))
+                 for i in range(B12)] for t in range(N12)]
+
+    # regime 1 — the stateless serial loop (the ISSUE 6 baseline): a
+    # facade is built per tenant-reconcile, so every solve re-pays the
+    # catalog list + encode. This is what multiplexing N tenants through
+    # one process WITHOUT per-tenant solver state amounts to, and it is
+    # what the >=5x headline is measured against.
+    t0 = time.perf_counter()
+    for _ in range(R12):
+        for t in range(N12):
+            facade = _Solver12(CatalogProvider(lambda: types12),
+                               backend="host")
+            out = facade.solve(bursts12[t], pool12)
+            assert out.launches
+    serial_s = time.perf_counter() - t0
+
+    # regime 2 — persistent per-tenant facades, NO sharing: the best
+    # serial case (each tenant's epoch-keyed caches stay warm; N cold
+    # encodes total instead of N*R). Reported alongside so the headline
+    # cannot be mistaken for a claim about this regime — the fleet's
+    # edge here is the single shared encode + the fairness/caps layer,
+    # not an order of magnitude.
+    t0 = time.perf_counter()
+    persistent12 = [_Solver12(CatalogProvider(lambda: types12),
+                              backend="host") for _ in range(N12)]
+    for _ in range(R12):
+        for t in range(N12):
+            out = persistent12[t].solve(bursts12[t], pool12)
+            assert out.launches
+    serial_persistent_s = time.perf_counter() - t0
+
+    # regime 3 — the fleet SolverService: persistent per-tenant facades
+    # behind the fair queue, ONE shared catalog encode across tenants.
+    t0 = time.perf_counter()
+    service12 = SolverService(_Clock12(), backend="host")
+    clients12 = [service12.register(f"b{t:03d}",
+                                    CatalogProvider(lambda: types12))
+                 for t in range(N12)]
+    for _ in range(R12):
+        for t in range(N12):
+            out = clients12[t].solve(bursts12[t], pool12)
+            assert out.launches
+    fleet_s = time.perf_counter() - t0
+
+    solves12 = N12 * R12
+    detail["c12_tenants"] = N12
+    detail["c12_serial_solves_per_sec"] = round(solves12 / serial_s, 1)
+    detail["c12_serial_persistent_solves_per_sec"] = round(
+        solves12 / serial_persistent_s, 1)
+    detail["c12_fleet_solves_per_sec"] = round(solves12 / fleet_s, 1)
+    detail["c12_fleet_vs_serial"] = round(serial_s / fleet_s, 1)
+    detail["c12_fleet_vs_serial_persistent"] = round(
+        serial_persistent_s / fleet_s, 2)
+    detail["c12_catalog_shared_hits"] = service12.shared_catalog.stats["hits"]
+    # the two headline fleet keys (ISSUE 6 acceptance):
+    detail["fleet_solves_per_sec"] = detail["c12_fleet_solves_per_sec"]
+    detail["fleet_vs_serial"] = detail["c12_fleet_vs_serial"]
+    if serial_s < 5 * fleet_s:
+        progress(f"FLEET BELOW 5x: fleet {solves12 / fleet_s:.0f}/s vs "
+                 f"serial {solves12 / serial_s:.0f}/s")
+
     progress("done")
     if server is not None:
         server.stop()

@@ -132,3 +132,31 @@ def device_fault_hook(plan: Optional[FaultPlan]):
         yield
     finally:
         solver_mod.set_dispatch_fault_hook(None)
+
+
+@contextlib.contextmanager
+def fleet_device_fault_hook(plans: dict):
+    """Tenant-scoped device faults for a fleet: the ONE process-global
+    dispatch seam is armed with a router that consults the CURRENT
+    tenant's plan (metrics/tenant.py scope — the fleet runner wraps every
+    shard tick in one), so tenant A's DeviceFault rule fires only on
+    tenant A's dispatches. Dispatches outside any armed tenant's scope
+    (including "default") pass through untouched."""
+    from ..metrics.tenant import current_tenant
+    from ..ops import solver as solver_mod
+    armed = {t: p for t, p in plans.items()
+             if p is not None and p.has_device_faults}
+    if not armed:
+        yield
+        return
+
+    def route(backend: str) -> None:
+        plan = armed.get(current_tenant())
+        if plan is not None:
+            plan.on_dispatch(backend)
+
+    solver_mod.set_dispatch_fault_hook(route)
+    try:
+        yield
+    finally:
+        solver_mod.set_dispatch_fault_hook(None)

@@ -1,0 +1,36 @@
+"""Fleet: multi-tenant control-plane sharding over one shared solver.
+
+The Omega/Borg shared-state shape (PAPERS.md) applied to this framework:
+N independent tenant control planes — each a full `make_sim` stack with
+its own Store, fake cloud, intent journal, warm-path engine, and
+controller set — multiplexed onto ONE `SolverService` that owns the
+single device-backed solver path behind a request queue with a fair
+(deficit-round-robin) scheduler and per-tenant in-flight caps.
+
+    from karpenter_tpu.fleet import FleetRunner
+    report = FleetRunner("fleet_smoke", tenants=50, seed=0).run()
+
+or from the shell:
+
+    python -m karpenter_tpu.fleet fleet_smoke --tenants 50
+    make fleet / make fleet-audit
+
+Isolation invariants (docs/fleet.md): one tenant's ICE storm, API
+brownout, or solve storm must not stall another tenant's solves beyond a
+bounded queueing delay; per-tenant end-state hashes are seed-
+deterministic; two shards never share a WAL file or an RNG stream.
+"""
+
+from .service import (SolverService, SolverServiceBusy, SolveTicket,
+                      TenantSolverClient)
+from .tenant import (TenantShard, build_shard, tenant_journal_path,
+                     tenant_seed)
+from .runner import FleetReport, FleetRunner
+from .scenarios import FLEET_SCENARIOS, FleetScenario, get_fleet_scenario
+
+__all__ = [
+    "SolverService", "SolverServiceBusy", "SolveTicket",
+    "TenantSolverClient", "TenantShard", "build_shard", "tenant_seed",
+    "tenant_journal_path", "FleetRunner", "FleetReport", "FleetScenario",
+    "FLEET_SCENARIOS", "get_fleet_scenario",
+]

@@ -1,0 +1,184 @@
+"""FleetRunner: drive N tenant shards through one process and judge them.
+
+Modeled on `faults/runner.ScenarioRunner` (and the RestartRunner's
+build/drive/judge shape): build every shard on ONE FakeClock and ONE
+SolverService, interleave engine ticks round-robin (each under its
+tenant's metric scope), keep flying until every shard is quiet or the
+deadline passes, then:
+
+- check EVERY shard against the chaos runner's end-of-run invariants
+  (all pods bound, no leaked claims/instances, store<->cloud
+  consistency) — per-tenant isolation means per-tenant judgment;
+- compute each shard's id-free end-state hash plus its fault-timeline
+  fingerprint. Same fleet seed => identical per-tenant hashes, the
+  fleet reproducibility contract `make fleet-audit` asserts;
+- fold in the scenario's analyze() verdict (noisy-neighbor isolation
+  bounds) and the service's fairness stats.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.clock import FakeClock
+from .scenarios import FleetScenario, get_fleet_scenario
+from .service import SolverService
+from .tenant import TenantShard, build_shard
+
+
+@dataclass
+class FleetReport:
+    scenario: str
+    seed: int
+    tenants: int
+    converged: bool
+    violations: List[str]
+    tenant_hashes: Dict[str, str]
+    tenant_fingerprints: Dict[str, str]
+    sim_seconds: float
+    stats: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.violations
+
+    @property
+    def fleet_hash(self) -> str:
+        """One digest over every tenant's end-state hash (tenant-keyed,
+        so a pair of swapped tenant states cannot cancel out)."""
+        h = hashlib.sha256()
+        for tenant in sorted(self.tenant_hashes):
+            h.update(f"{tenant}={self.tenant_hashes[tenant]}\n".encode())
+        return h.hexdigest()
+
+    @property
+    def fleet_fingerprint(self) -> str:
+        """Tenant-keyed digest of every shard's fault-timeline
+        fingerprint — the other half of the repeat contract: end states
+        that coincidentally agree must not mask a nondeterministic
+        fault timeline."""
+        h = hashlib.sha256()
+        for tenant in sorted(self.tenant_fingerprints):
+            h.update(
+                f"{tenant}={self.tenant_fingerprints[tenant]}\n".encode())
+        return h.hexdigest()
+
+    def summary(self) -> str:
+        status = "PASS" if self.ok else "FAIL"
+        lines = [f"[{status}] fleet={self.scenario} seed={self.seed} "
+                 f"tenants={self.tenants} "
+                 f"sim_seconds={self.sim_seconds:g}",
+                 f"  fleet_hash={self.fleet_hash}"]
+        for k in sorted(self.stats):
+            lines.append(f"  {k}={self.stats[k]:g}")
+        if not self.converged:
+            lines.append("  DID NOT CONVERGE before the sim deadline")
+        lines += [f"  violation: {x}" for x in self.violations]
+        return "\n".join(lines)
+
+
+class FleetRunner:
+    """Run one fleet scenario at a seed."""
+
+    def __init__(self, scenario="fleet_smoke", tenants: Optional[int] = None,
+                 seed: int = 0, backend: str = "host",
+                 inflight_cap: Optional[int] = None,
+                 journal_dir: Optional[str] = None,
+                 warmpath: Optional[bool] = None):
+        self.scenario: FleetScenario = (
+            scenario if isinstance(scenario, FleetScenario)
+            else get_fleet_scenario(scenario))
+        self.tenants = int(tenants) if tenants else self.scenario.tenants
+        self.seed = seed
+        self.backend = backend
+        self.inflight_cap = (inflight_cap if inflight_cap is not None
+                             else self.scenario.inflight_cap)
+        self.journal_dir = journal_dir
+        self.warmpath = (self.scenario.warmpath if warmpath is None
+                         else warmpath)
+        self.clock: Optional[FakeClock] = None
+        self.service: Optional[SolverService] = None
+        self.shards: List[TenantShard] = []
+        self.origin = 0.0
+
+    def build(self) -> None:
+        sc = self.scenario
+        self.clock = FakeClock()
+        self.origin = self.clock.now()
+        self.service = SolverService(self.clock, backend=self.backend,
+                                     inflight_cap=self.inflight_cap,
+                                     quantum=sc.quantum, window=sc.window)
+        self.shards = []
+        for i in range(self.tenants):
+            name = f"t{i:03d}"
+            self.shards.append(build_shard(
+                name, self.clock, self.service,
+                fleet_seed=self.seed,
+                rules=sc.tenant_rules(i, name),
+                workload=sc.tenant_workload(i, name),
+                warmpath=self.warmpath,
+                journal_dir=self.journal_dir))
+
+    def run(self) -> FleetReport:
+        from ..faults.injector import fleet_device_fault_hook
+        from ..faults.runner import check_invariants, state_hash
+        sc = self.scenario
+        if not self.shards:
+            self.build()
+        clock = self.clock
+        deadline = clock.now() + sc.timeout
+        plans = {s.name: s.plan for s in self.shards if s.plan is not None}
+        converged = False
+        with fleet_device_fault_hook(plans):
+            while clock.now() < deadline:
+                for shard in self.shards:
+                    shard.tick()
+                if all(s.quiet() for s in self.shards):
+                    converged = True
+                    break
+                clock.step(sc.step)
+
+        violations: List[str] = []
+        hashes: Dict[str, str] = {}
+        fingerprints: Dict[str, str] = {}
+        warm_div = 0.0
+        for shard in self.shards:
+            for v in check_invariants(shard.sim):
+                violations.append(f"[{shard.name}] {v}")
+            hashes[shard.name] = state_hash(shard.sim)
+            fingerprints[shard.name] = (shard.plan.fingerprint()
+                                        if shard.plan is not None else "")
+            wp = shard.sim.warmpath
+            if wp is not None and wp.stats["divergences"]:
+                warm_div += wp.stats["divergences"]
+                violations.append(
+                    f"[{shard.name}] warm-path auditor diverged "
+                    f"{wp.stats['divergences']} time(s)")
+
+        svc = self.service
+        stats: Dict[str, float] = {
+            "solves_dispatched": float(svc.stats["dispatched"]),
+            "solves_throttled": float(svc.stats["throttled"]),
+            "catalog_shared_hits": float(svc.shared_catalog.stats["hits"]),
+            "catalog_shared_misses": float(
+                svc.shared_catalog.stats["misses"]),
+            "faults_injected": float(sum(
+                len(s.plan.timeline) for s in self.shards
+                if s.plan is not None)),
+        }
+        wall = sum(s.wall_seconds for s in svc.tenants.values())
+        if wall > 0:
+            stats["aggregate_solves_per_wall_sec"] = round(
+                svc.stats["dispatched"] / wall, 1)
+        if warm_div:
+            stats["warm_divergences"] = warm_div
+        report = FleetReport(
+            scenario=sc.name, seed=self.seed, tenants=self.tenants,
+            converged=converged, violations=violations,
+            tenant_hashes=hashes, tenant_fingerprints=fingerprints,
+            sim_seconds=clock.now() - self.origin, stats=stats)
+        if sc.analyze is not None:
+            sc.analyze(self, report)
+        return report

@@ -1,0 +1,250 @@
+"""Fleet scenario catalog — what `make fleet` / `make fleet-audit` run.
+
+A FleetScenario describes a whole fleet: how many tenant shards, each
+tenant's workload (seeded from the tenant's OWN rng stream, so tenant
+t007's arrivals are identical whether 8 or 80 neighbors exist), each
+tenant's fault rules (tenant-scoped FaultPlans — ICE storms, API
+brownouts, interruption bursts; never ClockJump/CrashPoint, which are
+fleet-global/restart concerns), and an optional `analyze` hook that
+turns the service's per-tenant latency samples into scenario-specific
+verdicts (the noisy-neighbor isolation check).
+
+Reproduce any run from its seed:
+
+    python -m karpenter_tpu.fleet fleet_noisy_neighbor --seed 7 --repeat 2
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..faults.plan import ApiFault, IceWindow
+
+
+@dataclass(frozen=True)
+class FleetScenario:
+    name: str
+    description: str
+    # (tenant_index, tenant_name) -> workload fn(sim, rng) applied at build
+    tenant_workload: Callable[[int, str], Callable]
+    # (tenant_index, tenant_name) -> FaultPlan rules for that tenant
+    tenant_rules: Callable[[int, str], List[object]] = lambda i, n: []
+    tenants: int = 8                 # default shard count (CLI overrides)
+    timeout: float = 300.0           # sim-seconds deadline
+    step: float = 0.5
+    warmpath: bool = False
+    inflight_cap: Optional[int] = None   # SolverService override
+    window: Optional[float] = None
+    quantum: Optional[float] = None
+    # (runner, report) -> None: append scenario verdicts to the report
+    # (stats and, on failure, violations)
+    analyze: Optional[Callable] = None
+
+
+def _add_pods(sim, n: int, prefix: str, cpu: str = "500m",
+              mem: str = "1Gi") -> None:
+    from ..models.pod import Pod
+    from ..models.resources import Resources
+    for i in range(n):
+        sim.store.add_pod(Pod(
+            name=f"{prefix}-{i}",
+            requests=Resources.parse({"cpu": cpu, "memory": mem})))
+
+
+def _waved(waves: List[tuple]):
+    """Workload of (t, n, prefix, cpu, mem) waves; later waves arrive via
+    an engine hook relative to the shard's plan origin (or build time).
+    Publishes the shard's WORKLOAD HORIZON (the last wave's arrival
+    instant) so TenantShard.quiet() keeps the run open until every
+    scheduled wave has actually fired — the workload analog of the chaos
+    runner's fault horizon (a fleet that 'converges' before its late
+    waves arrive proves nothing and starves scenario analyzers of their
+    quiet-period samples)."""
+    def workload(sim, rng):
+        origin = (sim.fault_plan.origin if sim.fault_plan is not None
+                  else sim.clock.now())
+        sim.fleet_workload_horizon = origin + max(
+            (t for t, *_ in waves), default=0.0)
+        fired = set()
+        for t, n, prefix, cpu, mem in waves:
+            if t <= 0:
+                fired.add(prefix)
+                _add_pods(sim, n, prefix, cpu, mem)
+
+        def arrivals(now: float) -> None:
+            for t, n, prefix, cpu, mem in waves:
+                if prefix not in fired and now - origin >= t:
+                    fired.add(prefix)
+                    _add_pods(sim, n, prefix, cpu, mem)
+        sim.engine.add_hook(arrivals)
+    return workload
+
+
+def _spot_only(inner):
+    def workload(sim, rng):
+        from ..models import labels as L
+        from ..models.requirements import Operator, Requirement
+        sim.store.nodepools["default"].requirements.add(
+            Requirement(L.CAPACITY_TYPE, Operator.IN, (L.CAPACITY_SPOT,)))
+        inner(sim, rng)
+    return workload
+
+
+def _p99(samples: List[float]) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, math.ceil(0.99 * len(s)) - 1)]
+
+
+# --- fleet_smoke -----------------------------------------------------------
+# Every tenant: a seeded initial wave plus a later trickle; every third
+# tenant flies through a short spot ICE window (its unconstrained pool
+# slides to on-demand — weather, not a wall). The tier-1 member runs 8
+# shards; `make fleet` runs the same scenario at 50+.
+
+
+def _smoke_workload(i: int, name: str):
+    def workload(sim, rng):
+        first = 4 + rng.randrange(5)          # 4..8 pods
+        second = 2 + rng.randrange(4)         # 2..5 pods
+        at = 8.0 + rng.randrange(8)           # 8..15s
+        _waved([(0.0, first, "w0", "500m", "1Gi"),
+                (at, second, "w1", "250m", "512Mi")])(sim, rng)
+    return workload
+
+
+def _smoke_rules(i: int, name: str) -> List[object]:
+    # covers t=0: the initial wave's launch must actually fly through the
+    # window (later trickles often fit wave-1 headroom and never touch
+    # the cloud), so every third tenant really does take ICE weather
+    if i % 3 == 0:
+        return [IceWindow(0.0, 35.0, capacity_type="spot")]
+    return []
+
+
+# --- fleet_noisy_neighbor --------------------------------------------------
+# Tenant t000 is the abuser: a spot-only pool storming big waves into a
+# fleet-length spot ICE window with a CreateFleet brownout on top — its
+# reconciles re-solve every second for minutes. Every other tenant
+# trickles small waves throughout. The analyze hook is the isolation
+# verdict: victims' virtual solve latency p99 during the storm must stay
+# < 2x their quiet baseline, while the noisy tenant gets throttled.
+
+_STORM_T0, _STORM_T1 = 10.0, 150.0
+# ICE marks live 3 minutes past the last failed launch, so victim
+# samples are only "quiet" once the noisy tenant can actually launch
+# again and its solve storm has ended
+_STORM_SLACK = 200.0
+
+
+def _noisy_workload(i: int, name: str):
+    if i == 0:
+        return _spot_only(_waved([
+            (0.0, 40, "storm0", "500m", "1Gi"),
+            (20.0, 40, "storm1", "500m", "1Gi"),
+            (45.0, 30, "storm2", "500m", "1Gi")]))
+
+    def workload(sim, rng):
+        waves = [(0.0, 3 + rng.randrange(3), "v0", "500m", "1Gi")]
+        t = 20.0 + rng.randrange(10)
+        k = 1
+        while t < 380.0:
+            waves.append((t, 2 + rng.randrange(3), f"v{k}", "250m",
+                          "512Mi"))
+            t += 25.0 + rng.randrange(15)
+            k += 1
+        _waved(waves)(sim, rng)
+    return workload
+
+
+def _noisy_rules(i: int, name: str) -> List[object]:
+    if i != 0:
+        return []
+    return [IceWindow(_STORM_T0, _STORM_T1, capacity_type="spot"),
+            ApiFault(("create_fleet",), 20.0, 120.0, p=0.3,
+                     error="rate_limited", retry_after=2.0)]
+
+
+def _noisy_analyze(runner, report) -> None:
+    """Victim-isolation verdict from the service's sample streams.
+    Latency = virtual wait + virtual service (deterministic cost model),
+    so the p99s are reproducible across seeded repeats."""
+    service = runner.service
+    noisy = "t000"
+    t0 = runner.origin
+    quiet: List[float] = []
+    storm: List[float] = []
+    for tenant, state in service.tenants.items():
+        if tenant == noisy:
+            continue
+        for at, wait, cost in state.samples:
+            rel = at - t0
+            lat = wait + cost
+            if _STORM_T0 <= rel < _STORM_T1 + _STORM_SLACK:
+                storm.append(lat)
+            else:
+                quiet.append(lat)
+    p99_quiet = _p99(quiet)
+    p99_storm = _p99(storm)
+    throttled = service.tenants[noisy].throttled
+    report.stats.update({
+        "victim_p99_quiet_ms": round(p99_quiet * 1e3, 3),
+        "victim_p99_storm_ms": round(p99_storm * 1e3, 3),
+        "victim_samples_storm": float(len(storm)),
+        "noisy_throttled": float(throttled),
+        "noisy_solves": float(service.tenants[noisy].solves),
+    })
+    if storm and quiet and p99_storm >= 2.0 * p99_quiet:
+        report.violations.append(
+            f"victim solve p99 not bounded: storm {p99_storm * 1e3:.2f}ms "
+            f">= 2x quiet {p99_quiet * 1e3:.2f}ms")
+    if not throttled:
+        report.violations.append(
+            "noisy tenant was never throttled — the in-flight cap did "
+            "not engage")
+
+
+FLEET_SCENARIOS: Dict[str, FleetScenario] = {}
+
+
+def _register(sc: FleetScenario) -> FleetScenario:
+    FLEET_SCENARIOS[sc.name] = sc
+    return sc
+
+
+_register(FleetScenario(
+    name="fleet_smoke",
+    description="Seeded waves across every shard, a short spot ICE "
+                "window on every third tenant: the deterministic fleet "
+                "member (8 shards in tier-1; `make fleet` runs 50+). "
+                "Per-tenant end-state hashes must repeat under one seed.",
+    tenant_workload=_smoke_workload,
+    tenant_rules=_smoke_rules,
+    tenants=8,
+    timeout=240.0))
+
+_register(FleetScenario(
+    name="fleet_noisy_neighbor",
+    description="Tenant t000 storms a spot-only pool through a 140s ICE "
+                "window + CreateFleet brownout while 11 victims trickle "
+                "small waves. Verdict: victim solve p99 < 2x quiet "
+                "baseline, noisy tenant throttled by the in-flight cap, "
+                "all tenants converge.",
+    tenant_workload=_noisy_workload,
+    tenant_rules=_noisy_rules,
+    tenants=12,
+    timeout=900.0,
+    inflight_cap=6,
+    window=10.0,
+    analyze=_noisy_analyze))
+
+
+def get_fleet_scenario(name: str) -> FleetScenario:
+    try:
+        return FLEET_SCENARIOS[name]
+    except KeyError:
+        raise KeyError(f"unknown fleet scenario {name!r}; catalog: "
+                       f"{sorted(FLEET_SCENARIOS)}") from None

@@ -1,0 +1,353 @@
+"""SolverService: one solver, many tenants, fair dispatch.
+
+The device kernel is ~2-3ms inside a ~100ms reconcile — one cluster
+leaves the mesh idle ~98% of the time. The fleet funnels every tenant
+shard's solve through this service so the expensive resource (the
+device-backed solve path, its compiled executables, its device-resident
+catalog tensors) is owned once and multiplexed, CvxCluster-style
+amortization over many granular allocation problems (PAPERS.md).
+
+Mechanics:
+
+- each tenant registers its CatalogProvider and gets back a
+  `TenantSolverClient` — a drop-in `ops.facade.Solver` stand-in whose
+  `solve()` submits a `SolveTicket` to the service queue and blocks on
+  its future; everything host-side (tensors, warm-path encode,
+  consolidation screens) delegates straight to the tenant's facade.
+- the per-tenant facades share one `SharedCatalogCache`
+  (ops/facade.py), so tenants running identical pools share encoded
+  catalog tensors, device uploads, and compiled executables — catalog
+  views keyed per nodeclass-hash + availability fingerprint.
+- dispatch order is DEFICIT ROUND-ROBIN over tenants with queued work,
+  lightest-backlog first within a round: a tenant storming the queue
+  cannot push another tenant's single solve behind its whole backlog —
+  the victim's virtual queueing delay is bounded by roughly one quantum
+  per active tenant (the noisy-neighbor invariant the chaos scenario
+  measures via `fleet_solve_wait_ms`).
+- a per-tenant IN-FLIGHT CAP per scheduling window backpressures
+  storms: submissions beyond the cap raise `SolverServiceBusy` (a
+  retryable CloudError — the shard's engine backs the reconcile off
+  exactly as it would a cloud 429, and retries next window) and meter
+  `fleet_throttled_total{tenant}`.
+
+Determinism: the fleet drives shards strictly serially, so every ticket
+executes synchronously at dispatch; the scheduler's VIRTUAL device
+timeline (a deterministic per-request cost model, not wall time) exists
+to meter waits and starvation reproducibly — identical seeds produce
+identical wait histograms AND identical cluster end states. Throttling
+is count-based (submissions per window), so it is seed-deterministic
+too.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..cloud.provider import CloudError
+from ..metrics import (FLEET_SOLVE_WAIT, FLEET_SOLVES, FLEET_STARVATION,
+                       FLEET_THROTTLED)
+from ..obs.tracer import NOOP_SPAN, TRACER
+
+
+class SolverServiceBusy(CloudError):
+    """The tenant already has its in-flight cap of solve requests in the
+    current scheduling window. Retryable: the reconcile that hit it backs
+    off and resubmits next window — pods stay pending, nothing is lost."""
+
+    retryable = True
+
+
+@dataclass
+class SolveTicket:
+    """One queued solve request: the future a shard blocks on."""
+
+    tenant: str
+    kind: str                 # "solve" (the only queued kind today)
+    seq: int
+    submitted_at: float       # sim time
+    cost: float               # virtual device seconds (cost model)
+    done: bool = False
+    value: object = None
+    error: Optional[BaseException] = None
+    wait: float = 0.0         # virtual queueing delay, seconds
+
+    def result(self):
+        """Block on the future. The fleet is single-threaded, so by the
+        time a caller reaches this the service pump has already run the
+        ticket — a not-done ticket is a service bug, not a race."""
+        if not self.done:
+            raise RuntimeError(f"ticket {self.tenant}#{self.seq} never "
+                               f"dispatched")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class TenantSolverClient:
+    """Per-tenant `Solver` stand-in: `solve()` goes through the service
+    queue (the device-path choke point); every other facade capability —
+    `tensors`, `prepare_warm`, `warm_catalog`, `stats`, backend fields —
+    delegates to the tenant's own facade, which is host-side work that
+    needs no arbitration."""
+
+    def __init__(self, service: "SolverService", tenant: str, facade):
+        self._service = service
+        self.tenant = tenant
+        self.facade = facade
+
+    def solve(self, pods, *args, **kwargs):
+        cost = self._service.cost_model(len(pods))
+        return self._service.call(
+            self.tenant, "solve",
+            lambda: self.facade.solve(pods, *args, **kwargs),
+            cost=cost, pods=len(pods))
+
+    def __getattr__(self, name):
+        return getattr(self.facade, name)
+
+
+@dataclass
+class _TenantState:
+    # jobs dispatched this window, in arrival order: (seq, cost)
+    window_jobs: List[Tuple[int, float]] = field(default_factory=list)
+    window_cost: float = 0.0
+    max_wait: float = 0.0          # worst wait this window (starvation)
+    solves: int = 0                # lifetime dispatches
+    throttled: int = 0             # lifetime cap rejections
+    wall_seconds: float = 0.0      # measured host time inside dispatches
+    # (sim_time, virtual wait, virtual cost) per dispatch — the sample
+    # stream scenario analyzers compute per-tenant latency p99s from.
+    # A RING, not a list: a long-lived fleet process dispatches forever,
+    # and unreadable ancient samples must not accumulate unboundedly
+    # (8192 comfortably covers every catalog scenario's full run)
+    samples: "deque[Tuple[float, float, float]]" = field(
+        default_factory=lambda: deque(maxlen=8192))
+
+
+class SolverService:
+    """The shared solve queue + fair scheduler. One per fleet process."""
+
+    # virtual scheduling quantum (seconds of modeled device time) each
+    # tenant earns per DRR round — small relative to a solve so light
+    # tenants are served ahead of a heavy tenant's backlog
+    QUANTUM = 0.005
+    # scheduling-window length in sim seconds: the in-flight cap and the
+    # DRR backlog both reset each window (a storm is throttled per
+    # window, not forever)
+    WINDOW = 5.0
+    # per-tenant dispatch cap per window (--fleet-inflight-cap)
+    INFLIGHT_CAP = 16
+
+    def __init__(self, clock, backend: str = "host",
+                 inflight_cap: Optional[int] = None,
+                 quantum: Optional[float] = None,
+                 window: Optional[float] = None,
+                 shared_catalog=None):
+        from ..ops.facade import SharedCatalogCache
+        self.clock = clock
+        self.backend = backend
+        self.inflight_cap = (self.INFLIGHT_CAP if inflight_cap is None
+                             else int(inflight_cap))
+        self.quantum = self.QUANTUM if quantum is None else float(quantum)
+        self.window = self.WINDOW if window is None else float(window)
+        self.shared_catalog = (shared_catalog if shared_catalog is not None
+                               else SharedCatalogCache())
+        self.tenants: Dict[str, _TenantState] = {}
+        self.clients: Dict[str, TenantSolverClient] = {}
+        self._queue: List[SolveTicket] = []
+        self._window_start = float(clock.now())
+        self._seq = 0
+        self.stats: Dict[str, float] = {"dispatched": 0, "throttled": 0,
+                                        "windows": 0}
+        # /debug/fleet on both exposition servers: the live per-tenant
+        # queue/throttle/starvation view (last-built service wins). The
+        # route holds a WEAK reference — a bound method would pin the
+        # whole fleet (facades, encode contexts, device buffers) for the
+        # process lifetime after the run ends, and serve its corpse
+        import weakref
+        from ..obs.exposition import register_debug_route
+        this = weakref.ref(self)
+
+        def _payload():
+            svc = this()
+            return (svc.debug_payload() if svc is not None
+                    else {"inactive": True})
+        register_debug_route("/debug/fleet", _payload)
+
+    # --- registration -----------------------------------------------------
+    def register(self, tenant: str, catalog) -> TenantSolverClient:
+        """Build the tenant's facade (sharing the fleet catalog cache)
+        and return the queue-fronted client `make_sim` wires everywhere a
+        Solver goes."""
+        from ..ops.facade import Solver
+        if tenant in self.clients:
+            raise ValueError(f"tenant {tenant!r} already registered")
+        facade = Solver(catalog, backend=self.backend,
+                        shared_catalog=self.shared_catalog)
+        client = TenantSolverClient(self, tenant, facade)
+        self.tenants[tenant] = _TenantState()
+        self.clients[tenant] = client
+        return client
+
+    # --- cost model -------------------------------------------------------
+    @staticmethod
+    def cost_model(pods: int) -> float:
+        """Virtual device seconds one solve occupies the shared backend:
+        a dispatch floor plus a per-pod term, shaped after the measured
+        kernel scaling (BENCH_r0x: ~2-3ms kernel + encode/decode that
+        scales with pods). Deterministic by construction — wall time
+        feeds `wall_seconds` for reporting, never scheduling."""
+        return 0.002 + 2e-5 * max(0, pods)
+
+    # --- submission / dispatch -------------------------------------------
+    def call(self, tenant: str, kind: str, thunk: Callable[[], object],
+             cost: float, pods: int = 0):
+        """Submit + pump + block: the synchronous face of the queue."""
+        ticket = self.submit(tenant, kind, thunk, cost, pods=pods)
+        self.pump()
+        return ticket.result()
+
+    def submit(self, tenant: str, kind: str, thunk: Callable[[], object],
+               cost: float, pods: int = 0) -> SolveTicket:
+        now = float(self.clock.now())
+        self._roll_window(now)
+        state = self.tenants[tenant]
+        if len(state.window_jobs) >= self.inflight_cap:
+            state.throttled += 1
+            self.stats["throttled"] += 1
+            FLEET_THROTTLED.inc(tenant=tenant)
+            raise SolverServiceBusy(
+                f"tenant {tenant} exceeded {self.inflight_cap} solves in "
+                f"the current {self.window:g}s window")
+        self._seq += 1
+        ticket = SolveTicket(tenant=tenant, kind=kind, seq=self._seq,
+                             submitted_at=now, cost=cost)
+        ticket._thunk = thunk
+        if TRACER.enabled:
+            with TRACER.span("fleet.submit", tenant=tenant, kind=kind,
+                             pods=pods, seq=ticket.seq):
+                pass
+        self._queue.append(ticket)
+        return ticket
+
+    def pump(self) -> None:
+        """Dispatch every queued ticket in deficit-round-robin order.
+        Execution is synchronous (the fleet is one thread); the DRR
+        replay decides each ticket's VIRTUAL start on the shared device
+        timeline, which is what the wait/starvation metrics expose."""
+        import time as _time
+        while self._queue:
+            ticket = self._pick_next()
+            state = self.tenants[ticket.tenant]
+            state.window_jobs.append((ticket.seq, ticket.cost))
+            state.window_cost += ticket.cost
+            ticket.wait = self._virtual_wait(ticket)
+            sp = (TRACER.span("fleet.dispatch", tenant=ticket.tenant,
+                              kind=ticket.kind, seq=ticket.seq,
+                              wait_ms=round(ticket.wait * 1e3, 3))
+                  if TRACER.enabled else NOOP_SPAN)
+            t0 = _time.perf_counter()
+            try:
+                with sp:
+                    ticket.value = ticket._thunk()
+            except BaseException as e:  # noqa: BLE001 — the future carries it
+                ticket.error = e
+            finally:
+                ticket.done = True
+                del ticket._thunk
+                state.wall_seconds += _time.perf_counter() - t0
+                state.solves += 1
+                self.stats["dispatched"] += 1
+                now = float(self.clock.now())
+                state.max_wait = max(state.max_wait, ticket.wait)
+                state.samples.append((now, ticket.wait, ticket.cost))
+                FLEET_SOLVES.inc(tenant=ticket.tenant)
+                FLEET_SOLVE_WAIT.observe(ticket.wait * 1e3,
+                                         tenant=ticket.tenant)
+                FLEET_STARVATION.set(state.max_wait, tenant=ticket.tenant)
+
+    # --- fair scheduling --------------------------------------------------
+    def _pick_next(self) -> SolveTicket:
+        """Next ticket off the queue: among tenants with queued tickets,
+        serve the lightest current-window backlog first (FIFO within a
+        tenant). With one queued ticket — the common synchronous case —
+        this is O(1); with a contended queue it is the round order the
+        DRR replay below assumes."""
+        best_i, best_key = 0, None
+        for i, t in enumerate(self._queue):
+            key = (self.tenants[t.tenant].window_cost, t.seq)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        return self._queue.pop(best_i)
+
+    def _virtual_wait(self, ticket: SolveTicket) -> float:
+        """Deficit-round-robin replay of the current window's job list:
+        every tenant's queue is replayed from the window start, each
+        round granting `quantum` virtual seconds per tenant (lightest
+        total backlog first) and serving whole jobs the accumulated
+        deficit covers. The returned wait is this ticket's virtual start
+        minus its arrival offset — a tenant with one small job lands in
+        the first rounds regardless of how many jobs a neighbor queued,
+        which is exactly the bounded-delay isolation invariant."""
+        jobs: Dict[str, List[Tuple[int, float]]] = {
+            t: list(s.window_jobs) for t, s in self.tenants.items()
+            if s.window_jobs}
+        order = sorted(jobs, key=lambda t: (self.tenants[t].window_cost, t))
+        deficit = {t: 0.0 for t in jobs}
+        heads = {t: 0 for t in jobs}
+        vt = 0.0
+        start: Optional[float] = None
+        # bounded: every round either serves a job or grows every
+        # deficit by quantum, and total work is finite
+        while any(heads[t] < len(jobs[t]) for t in jobs):
+            for t in order:
+                if heads[t] >= len(jobs[t]):
+                    continue
+                deficit[t] += self.quantum
+                while heads[t] < len(jobs[t]):
+                    seq, cost = jobs[t][heads[t]]
+                    if deficit[t] + 1e-12 < cost:
+                        break
+                    if seq == ticket.seq:
+                        start = vt
+                    vt += cost
+                    deficit[t] -= cost
+                    heads[t] += 1
+        if start is None:  # defensive: ticket not in its window list
+            start = vt
+        arrival = max(0.0, ticket.submitted_at - self._window_start)
+        return max(0.0, start - arrival)
+
+    def _roll_window(self, now: float) -> None:
+        if now - self._window_start < self.window:
+            return
+        self._window_start = now
+        self.stats["windows"] += 1
+        for tenant, state in self.tenants.items():
+            state.window_jobs = []
+            state.window_cost = 0.0
+            state.max_wait = 0.0
+            FLEET_STARVATION.set(0.0, tenant=tenant)
+
+    # --- introspection ----------------------------------------------------
+    def debug_payload(self) -> dict:
+        return {"tenants": self.snapshot(),
+                "inflight_cap": self.inflight_cap,
+                "window_seconds": self.window,
+                "quantum_seconds": self.quantum,
+                "stats": dict(self.stats),
+                "catalog_shared": dict(self.shared_catalog.stats)}
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-tenant service view for /debug/fleet and reports."""
+        return {
+            tenant: {
+                "solves": state.solves,
+                "throttled": state.throttled,
+                "window_jobs": len(state.window_jobs),
+                "max_wait_ms": round(state.max_wait * 1e3, 3),
+                "wall_ms": round(state.wall_seconds * 1e3, 1),
+            }
+            for tenant, state in sorted(self.tenants.items())}

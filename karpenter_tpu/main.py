@@ -174,8 +174,36 @@ def build_operator(options: Optional[Options] = None,
     return runtime, store, cloud
 
 
+def run_fleet(opts: Options) -> int:
+    """Fleet mode (--fleet-tenants N): N simulated tenant control planes
+    through one process and one shared SolverService — the Omega-style
+    multi-tenant construction (docs/fleet.md). Per-tenant intent-journal
+    WAL files land next to --intent-journal-file when it is set (each
+    tenant gets its own file: shards never share a WAL)."""
+    from .fleet import FleetRunner
+    journal_dir = (os.path.dirname(opts.intent_journal_file) or "."
+                   if opts.intent_journal_file else None)
+    if journal_dir:
+        os.makedirs(journal_dir, exist_ok=True)
+    runner = FleetRunner("fleet_smoke", tenants=opts.fleet_tenants,
+                         backend=opts.solver_backend,
+                         inflight_cap=opts.fleet_inflight_cap,
+                         journal_dir=journal_dir)
+    report = runner.run()
+    print(report.summary())
+    return 0 if report.ok else 1
+
+
 def main() -> None:
-    runtime, _store, _cloud = build_operator()
+    import sys
+    # parse the REAL command line: Options.parse(None) deliberately
+    # parses an empty argv (library callers construct Options directly,
+    # and pytest's argv must never leak in), so the entrypoint is the
+    # one place that feeds sys.argv through
+    opts = Options.parse(sys.argv[1:])
+    if opts.fleet_tenants > 0:
+        raise SystemExit(run_fleet(opts))
+    runtime, _store, _cloud = build_operator(options=opts)
 
     async def _run() -> None:
         # SIGTERM is what the kubelet sends on pod termination: a leader

@@ -17,8 +17,17 @@ Parity map (reference website/docs reference/metrics.md):
 """
 
 from .registry import (Counter, Gauge, Histogram, Registry, DEFAULT_BUCKETS)
+from .tenant import current_tenant
 
 REGISTRY = Registry()
+
+# the hot-path families a fleet multiplexes across tenant shards carry a
+# `tenant` dimension whose default RESOLVES through the live tenant scope
+# (metrics/tenant.py): single-cluster processes never enter a scope, so
+# every sample and every unlabeled read lands on tenant="default" —
+# existing dashboards and tests see one coherent series, while a fleet
+# run splits the same families per shard for free
+_TENANT = {"tenant": current_tenant}
 
 NODECLAIMS_CREATED = REGISTRY.counter(
     "karpenter_tpu_nodeclaims_created_total",
@@ -35,7 +44,8 @@ SOLVE_PODS = REGISTRY.histogram(
 PODS_SCHEDULED = REGISTRY.counter(
     "karpenter_tpu_pods_scheduled_total", "pods nominated to nodes", ())
 PODS_UNSCHEDULABLE = REGISTRY.gauge(
-    "karpenter_tpu_pods_unschedulable", "pods no pool could place", ())
+    "karpenter_tpu_pods_unschedulable", "pods no pool could place",
+    ("tenant",), label_defaults=_TENANT)
 DISRUPTION_DECISIONS = REGISTRY.counter(
     "karpenter_tpu_voluntary_disruption_decisions_total",
     "disruption decisions", ("reason", "consolidation_type"))
@@ -59,10 +69,12 @@ PRICING_STALE = REGISTRY.gauge(
     "karpenter_tpu_pricing_stale",
     "1 while prices are served from the last good book/snapshot because "
     "the live pricing feed failed or returned nothing (reference "
-    "pricing.go static-table fallback)")
+    "pricing.go static-table fallback)",
+    ("tenant",), label_defaults=_TENANT)
 PRICING_LAST_UPDATE = REGISTRY.gauge(
     "karpenter_tpu_pricing_last_update_timestamp_seconds",
-    "wall time of the last successful pricing feed update")
+    "wall time of the last successful pricing feed update",
+    ("tenant",), label_defaults=_TENANT)
 LIFECYCLE_DURATION = REGISTRY.histogram(
     "karpenter_nodeclaims_lifecycle_duration_seconds",
     "Seconds from creation to each lifecycle phase (reference: "
@@ -75,14 +87,16 @@ TERMINATION_DURATION = REGISTRY.histogram(
     buckets=(1, 2, 5, 10, 30, 60, 120, 300, 600, 1800))
 CLUSTER_NODES = REGISTRY.gauge(
     "karpenter_cluster_state_node_count",
-    "Nodes currently in cluster state (reference cluster_state family)")
+    "Nodes currently in cluster state (reference cluster_state family)",
+    ("tenant",), label_defaults=_TENANT)
 CLUSTER_PODS = REGISTRY.gauge(
     "karpenter_cluster_state_pod_count",
-    "Pods currently tracked, by phase", ("phase",))
+    "Pods currently tracked, by phase", ("phase", "tenant"),
+    label_defaults=_TENANT)
 CLUSTER_UTILIZATION = REGISTRY.gauge(
     "karpenter_cluster_utilization_percent",
     "Requested / allocatable across ready nodes, per resource",
-    ("resource",))
+    ("resource", "tenant"), label_defaults=_TENANT)
 BATCH_SIZE = REGISTRY.histogram(
     "karpenter_tpu_cloud_batcher_batch_size", "requests per wire call",
     ("op",), buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500))
@@ -111,11 +125,12 @@ CLOUD_API_ERRORS = REGISTRY.counter(
 NODEPOOL_USAGE = REGISTRY.gauge(
     "karpenter_nodepools_usage",
     "Resources consumed by a NodePool's claims — reference series name, "
-    "so existing dashboards/alerts match", ("nodepool", "resource"))
+    "so existing dashboards/alerts match", ("nodepool", "resource", "tenant"),
+    label_defaults=_TENANT)
 NODEPOOL_LIMIT = REGISTRY.gauge(
     "karpenter_nodepools_limit",
     "A NodePool's spec.limits (reference karpenter_nodepools_limit)",
-    ("nodepool", "resource"))
+    ("nodepool", "resource", "tenant"), label_defaults=_TENANT)
 TRANSFER_BYTES_H2D = REGISTRY.gauge(
     "karpenter_tpu_solver_transfer_host_to_device_bytes",
     "Bytes uploaded host-to-device by the last solve — the tunnel-budget "
@@ -138,13 +153,16 @@ DEGRADED_MODE = REGISTRY.gauge(
     "degraded mode: solver = solves rerouted off the faulted TPU backend "
     "onto native/host, cloud-api = the terminate batcher is inside a "
     "throttle backoff window, capacity = live ICE marks in the "
-    "UnavailableOfferings cache", ("component",))
+    "UnavailableOfferings cache. SET-style per-cluster state, so it "
+    "carries the tenant dimension: under a fleet, a healthy neighbor's "
+    "0 must not clobber a degraded tenant's 1",
+    ("component", "tenant"), label_defaults=_TENANT)
 SOLVER_FALLBACKS = REGISTRY.counter(
     "karpenter_tpu_solver_backend_fallback_total",
     "Solves whose device/mesh dispatch faulted mid-solve and were re-run "
     "on the fallback backend (the degraded path — each increment is a "
     "solve that still returned a full placement)",
-    ("from_backend", "to_backend"))
+    ("from_backend", "to_backend", "tenant"), label_defaults=_TENANT)
 WARMPATH_DECISIONS = REGISTRY.counter(
     "karpenter_tpu_warmpath_decisions_total",
     "Provisioner reconciles with pending pods, by outcome: warm (whole "
@@ -152,30 +170,33 @@ WARMPATH_DECISIONS = REGISTRY.counter(
     "(classified warm but nothing fit — the full solver served it all), "
     "cold (classification failed; the reason dimension names why — the "
     "delta tracker's first dirty event, a catalog-epoch move, a "
-    "config-hash change, or an audit divergence)", ("path", "reason"))
+    "config-hash change, or an audit divergence)",
+    ("path", "reason", "tenant"), label_defaults=_TENANT)
 WARMPATH_ADMIT_DURATION = REGISTRY.histogram(
     "karpenter_tpu_warmpath_admit_duration_seconds",
     "Warm-path admission latency per reconcile (classify + encode + "
     "first-fit + nomination — the arrival-path cost a full solve would "
-    "otherwise be)",
+    "otherwise be)", ("tenant",),
     buckets=(.0001, .00025, .0005, .001, .0025, .005, .01, .025, .05,
-             .1, .5, 1))
+             .1, .5, 1), label_defaults=_TENANT)
 WARMPATH_HIT_RATE = REGISTRY.gauge(
     "karpenter_tpu_warmpath_warm_hit_rate",
     "Fraction of arrival pods admitted on the warm path (vs escalated "
     "or classified cold) since process start — the steady-state "
-    "effectiveness of the incremental admitter")
+    "effectiveness of the incremental admitter",
+    ("tenant",), label_defaults=_TENANT)
 WARMPATH_DIVERGENCE = REGISTRY.counter(
     "karpenter_tpu_warmpath_divergence_total",
     "Warm-path audit divergences: accumulated warm admissions replayed "
     "through a fresh full Solver.solve() disagreed with the warm "
     "placements. Each increment forces the path cold and flight-records "
     "a warmpath.divergence trace — nonzero means the incremental "
-    "admitter drifted from solve semantics and repaired itself")
+    "admitter drifted from solve semantics and repaired itself",
+    ("tenant",), label_defaults=_TENANT)
 WARMPATH_AUDITS = REGISTRY.counter(
     "karpenter_tpu_warmpath_audits_total",
     "Warm-path auditor replays, by outcome (clean / divergent)",
-    ("outcome",))
+    ("outcome", "tenant"), label_defaults=_TENANT)
 ENCODE_CACHE = REGISTRY.counter(
     "karpenter_tpu_encode_cache_total",
     "Pod signature-groups by encode-cache outcome: a 'hit' gathered the "
@@ -196,7 +217,8 @@ LAUNCH_DEDUP = REGISTRY.counter(
     "retry racing its own in-flight attempt) returned the instance the "
     "token already minted instead of provisioning a second one — nonzero "
     "after a crash is the resilience layer WORKING; a double-provision "
-    "would show up as a duplicate-launch invariant violation instead")
+    "would show up as a duplicate-launch invariant violation instead",
+    ("tenant",), label_defaults=_TENANT)
 INTENT_JOURNAL_OPEN = REGISTRY.gauge(
     "karpenter_tpu_intent_journal_open",
     "Provisioning intents currently open in the write-ahead intent "
@@ -204,7 +226,10 @@ INTENT_JOURNAL_OPEN = REGISTRY.gauge(
     "CreateFleet call whose commit has not resolved yet. Steady-state "
     "this is 0 between reconciles; a persistently nonzero value means a "
     "launch died between the wire call and the commit and is waiting "
-    "for restart replay — the GC sweep will not touch its instance")
+    "for restart replay — the GC sweep will not touch its instance. "
+    "Tenant-dimensioned (SET-style): each fleet shard's journal "
+    "publishes its own open count",
+    ("tenant",), label_defaults=_TENANT)
 RESTART_ADOPTIONS = REGISTRY.counter(
     "karpenter_tpu_restart_adoptions_total",
     "Open-intent resolutions during restart rehydration "
@@ -214,6 +239,45 @@ RESTART_ADOPTIONS = REGISTRY.counter(
     "launched), reaped = a live instance whose claim could not be "
     "rebuilt was terminated immediately instead of leaking until GC",
     ("outcome",))
+FLEET_SOLVES = REGISTRY.counter(
+    "karpenter_tpu_fleet_solves_total",
+    "Solve requests dispatched by the shared SolverService, per tenant "
+    "shard (fleet/service.py) — the aggregate rate across tenants is the "
+    "fleet's solves/sec headline (bench c12)",
+    ("tenant",), label_defaults=_TENANT)
+FLEET_SOLVE_WAIT = REGISTRY.histogram(
+    "karpenter_tpu_fleet_solve_wait_ms",
+    "Virtual queueing delay (milliseconds of modeled device time) a "
+    "tenant's solve request spent behind other tenants' work before the "
+    "shared solver served it — the deficit-round-robin scheduler bounds "
+    "this for light tenants regardless of a neighbor's storm (the "
+    "noisy-neighbor isolation invariant, docs/fleet.md)",
+    ("tenant",),
+    buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500),
+    label_defaults=_TENANT)
+FLEET_STARVATION = REGISTRY.gauge(
+    "karpenter_tpu_fleet_starvation_gauge",
+    "Worst virtual queueing delay (seconds) any of this tenant's solve "
+    "requests has seen in the current scheduling window — a persistently "
+    "high value for one tenant while others read ~0 is starvation, which "
+    "the fair scheduler exists to prevent",
+    ("tenant",), label_defaults=_TENANT)
+FLEET_THROTTLED = REGISTRY.counter(
+    "karpenter_tpu_fleet_throttled_total",
+    "Solve submissions the shared SolverService refused because the "
+    "tenant already had its in-flight cap of requests in the current "
+    "window (the noisy-neighbor backpressure: the shard's reconcile "
+    "backs off and retries, exactly like a cloud 429, while other "
+    "tenants' solves proceed)",
+    ("tenant",), label_defaults=_TENANT)
+FLEET_CATALOG_SHARED = REGISTRY.counter(
+    "karpenter_tpu_fleet_catalog_shared_total",
+    "Catalog-tensor lookups served across tenant facades, by outcome: a "
+    "'hit' reused another tenant's encoded view (identical nodeclass "
+    "hash + availability fingerprint — the tenants then also share the "
+    "device-resident tensors and compiled executables), a 'miss' paid "
+    "the full encode_catalog",
+    ("event",))
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

@@ -20,14 +20,32 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class _Metric:
-    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+    def __init__(self, name: str, help_: str, label_names: Sequence[str],
+                 label_defaults: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
+        # per-label fallback values: a label omitted by BOTH the writer
+        # and the reader resolves to its default, so retrofitting a
+        # dimension (e.g. `tenant` on the hot-path families) keeps every
+        # existing unlabeled inc()/value() call on one coherent series
+        # instead of splitting writes ("default") from reads (""). A
+        # callable default is resolved per sample — how the fleet's
+        # tenant scope attributes shard samples without touching any
+        # call site (metrics/tenant.py)
+        self.label_defaults = dict(label_defaults or {})
         self._lock = threading.Lock()
 
     def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
-        return tuple(str(labels.get(k, "")) for k in self.label_names)
+        out = []
+        for k in self.label_names:
+            v = labels.get(k)
+            if v is None:
+                v = self.label_defaults.get(k, "")
+                if callable(v):
+                    v = v()
+            out.append(str(v))
+        return tuple(out)
 
     def _fmt_labels(self, key: Tuple[str, ...]) -> str:
         if not self.label_names:
@@ -37,8 +55,8 @@ class _Metric:
 
 
 class Counter(_Metric):
-    def __init__(self, name, help_, label_names=()):
-        super().__init__(name, help_, label_names)
+    def __init__(self, name, help_, label_names=(), label_defaults=None):
+        super().__init__(name, help_, label_names, label_defaults)
         self._values: Dict[Tuple[str, ...], float] = {}
 
     def inc(self, amount: float = 1.0, **labels) -> None:
@@ -57,8 +75,8 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
-    def __init__(self, name, help_, label_names=()):
-        super().__init__(name, help_, label_names)
+    def __init__(self, name, help_, label_names=(), label_defaults=None):
+        super().__init__(name, help_, label_names, label_defaults)
         self._values: Dict[Tuple[str, ...], float] = {}
 
     def set(self, value: float, **labels) -> None:
@@ -89,8 +107,9 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
 
 
 class Histogram(_Metric):
-    def __init__(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS):
-        super().__init__(name, help_, label_names)
+    def __init__(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS,
+                 label_defaults=None):
+        super().__init__(name, help_, label_names, label_defaults)
         self.buckets = tuple(sorted(buckets))
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
@@ -160,18 +179,21 @@ class Registry:
     def __init__(self) -> None:
         self._metrics: List[_Metric] = []
 
-    def counter(self, name, help_, label_names=()) -> Counter:
-        m = Counter(name, help_, label_names)
+    def counter(self, name, help_, label_names=(),
+                label_defaults=None) -> Counter:
+        m = Counter(name, help_, label_names, label_defaults)
         self._metrics.append(m)
         return m
 
-    def gauge(self, name, help_, label_names=()) -> Gauge:
-        m = Gauge(name, help_, label_names)
+    def gauge(self, name, help_, label_names=(),
+              label_defaults=None) -> Gauge:
+        m = Gauge(name, help_, label_names, label_defaults)
         self._metrics.append(m)
         return m
 
-    def histogram(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS) -> Histogram:
-        m = Histogram(name, help_, label_names, buckets)
+    def histogram(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS,
+                  label_defaults=None) -> Histogram:
+        m = Histogram(name, help_, label_names, buckets, label_defaults)
         self._metrics.append(m)
         return m
 

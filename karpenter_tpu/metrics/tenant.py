@@ -1,0 +1,46 @@
+"""Tenant attribution for process-shared metrics.
+
+A fleet runs many tenant control planes in ONE process against one
+metric registry (docs/fleet.md), so the hot-path series the dashboards
+already watch (`warmpath_*`, `launch_dedup_total`,
+`solver_backend_fallback_total`) gain a `tenant` dimension. Single-
+cluster operators never set a scope, so every sample lands on the
+`"default"` tenant — and the registry's label defaults make unlabeled
+reads (`COUNTER.value()`) resolve to that same series, keeping existing
+dashboards and tests byte-compatible.
+
+The scope is a plain module global, not a contextvar: the fleet runner
+drives shards strictly serially on one thread (the same determinism
+contract the chaos harness relies on), and the metric call sites are
+nil-overhead enough that a contextvar lookup per sample would be the
+most expensive thing in them.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator
+
+DEFAULT_TENANT = "default"
+
+_current: str = DEFAULT_TENANT
+
+
+def current_tenant() -> str:
+    """The tenant every tenant-dimensioned metric sample is attributed
+    to right now; "default" outside any fleet scope."""
+    return _current
+
+
+@contextmanager
+def tenant_scope(name: str) -> Iterator[None]:
+    """Attribute metric samples inside the block to `name` — the fleet
+    runner wraps each shard's engine tick in one. Re-entrant: nested
+    scopes restore the outer tenant on exit."""
+    global _current
+    prev = _current
+    _current = name
+    try:
+        yield
+    finally:
+        _current = prev

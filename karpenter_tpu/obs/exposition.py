@@ -17,6 +17,18 @@ from typing import Optional, Tuple
 
 from .tracer import TRACER, Tracer, to_chrome_events
 
+# pluggable /debug/* routes: subsystems register a JSON-payload callable
+# (e.g. the fleet's SolverService serves /debug/fleet — per-tenant
+# queue/throttle/starvation state) and BOTH servers pick it up through
+# the shared route table, same no-drift contract as the built-ins
+DEBUG_ROUTES: dict = {}
+
+
+def register_debug_route(route: str, payload) -> None:
+    """Serve `payload()` (a JSON-serializable dict) at `route`. Last
+    registration wins — a rebuilt subsystem replaces its predecessor."""
+    DEBUG_ROUTES[route] = payload
+
 
 def render(path: str, tracer: Optional[Tracer] = None,
            ) -> Tuple[int, str, bytes]:
@@ -46,6 +58,9 @@ def render(path: str, tracer: Optional[Tracer] = None,
                                "count": len(traces),
                                "traces": [t.to_dict() for t in traces]})
         return 200, "application/json", body.encode()
+    fn = DEBUG_ROUTES.get(route)
+    if fn is not None:
+        return 200, "application/json", json.dumps(fn()).encode()
     return 404, "text/plain", b"not found\n"
 
 

@@ -40,6 +40,84 @@ MAX_OVERRIDES = 60  # reference MaxInstanceTypes (instance.go:62)
 _MESH_UNSET = object()
 
 
+class SharedCatalogCache:
+    """Content-keyed CatalogTensors shared across Solver facades — the
+    fleet's one-catalog-many-tenants seam (docs/fleet.md).
+
+    A fleet runs N tenant control planes, each with its own
+    CatalogProvider (own ICE marks, own pricing clocks), through one
+    process. Tenants running identical pools would each pay
+    encode_catalog (and a device upload, and — via fresh shapes — an XLA
+    compile) for byte-identical views. This cache keys views by
+    (nodeclass-hash, availability fingerprint): tenants whose resolved
+    catalogs AGREE share one CatalogTensors object, hence one
+    device-resident DeviceCatalog (ops/solver._auto_dcat keys on the
+    content token) and one compiled executable; tenants whose views
+    diverge (an ICE mark, a price move) fingerprint differently and get
+    their own entry — per-tenant isolation is preserved by content, not
+    trust.
+
+    Entries carry a content-authoritative `cache_token`
+    ("shared", nodeclass-hash, fingerprint): unlike the per-facade
+    (nodeclass-hash, epoch) token, it is collision-free ACROSS providers
+    (two tenants' epoch counters can agree while their availability
+    differs), which is what makes process-global device caching on the
+    token sound."""
+
+    MAX_ENTRIES = 16
+
+    def __init__(self):
+        from collections import OrderedDict
+        self._entries: "OrderedDict[tuple, CatalogTensors]" = OrderedDict()
+        self.stats: Dict[str, int] = {"hits": 0, "misses": 0}
+
+    @staticmethod
+    def fingerprint(types: Sequence[InstanceType]) -> str:
+        """Digest of everything encode_catalog reads from a resolved
+        type list: names, requirements, capacity, overhead, and every
+        offering's (zone, captype, price, availability, reservation)
+        tuple. ~1e4 offerings hash in well under a millisecond — paid
+        only on a facade-local epoch miss, never per solve."""
+        import hashlib
+        h = hashlib.blake2b(digest_size=16)
+        for t in types:
+            h.update(t.name.encode())
+            for key in sorted(t.requirements.keys()):
+                vs = t.requirements.get(key)
+                h.update(f"|{key}:{sorted(vs.values)}:{vs.complement}"
+                         f":{vs.gt}:{vs.lt}".encode())
+            for k in sorted(t.capacity):
+                h.update(f"|{k}={t.capacity.get(k)}".encode())
+            for k, v in sorted(t.overhead.total().items()):
+                h.update(f"|oh:{k}={v}".encode())
+            for o in t.offerings:
+                h.update(f"|{o.zone}/{o.capacity_type}/{o.price}"
+                         f"/{o.available}/{o.reservation_id}"
+                         f"/{o.reservation_capacity}/{o.reservation_type}"
+                         f"/{o.reservation_ends}".encode())
+            h.update(b";")
+        return h.hexdigest()
+
+    def get_or_encode(self, nc_hash: str,
+                      types: Sequence[InstanceType]) -> CatalogTensors:
+        from ..metrics import FLEET_CATALOG_SHARED
+        key = (nc_hash, self.fingerprint(types))
+        hit = self._entries.get(key)
+        if hit is not None:
+            self._entries.move_to_end(key)
+            self.stats["hits"] += 1
+            FLEET_CATALOG_SHARED.inc(event="hit")
+            return hit
+        cat = encode_catalog(list(types))
+        cat.cache_token = ("shared",) + key
+        self._entries[key] = cat
+        self.stats["misses"] += 1
+        FLEET_CATALOG_SHARED.inc(event="miss")
+        while len(self._entries) > self.MAX_ENTRIES:
+            self._entries.popitem(last=False)
+        return cat
+
+
 def _daemonset_overhead_parts(
         cat: CatalogTensors, daemonsets, nodepool: NodePool,
         template: Dict[str, str],
@@ -212,9 +290,16 @@ class Solver:
 
     def __init__(self, catalog: CatalogProvider, backend: str = "auto",
                  device_min_pods: Optional[int] = None,
-                 profile_dir: str = "", encode_cache: bool = True):
+                 profile_dir: str = "", encode_cache: bool = True,
+                 shared_catalog: Optional[SharedCatalogCache] = None):
         from collections import OrderedDict
         self.catalog = catalog
+        # fleet seam: when set, catalog views resolve through the
+        # process-shared content-keyed cache, so facades of tenants with
+        # identical pools share encoded tensors, device uploads, and
+        # compiled executables (SolverService wires one cache across all
+        # tenant facades); None = classic per-facade encoding
+        self._shared_catalog = shared_catalog
         self.device_min_pods = (self.DEVICE_MIN_PODS if device_min_pods is None
                                 else device_min_pods)
         # non-empty: every solve runs under jax.profiler.trace(profile_dir)
@@ -357,8 +442,16 @@ class Solver:
         hit = self._cat_cache.get(key)
         if hit is None:
             types = self.catalog.list(nc)
-            hit = encode_catalog(types)
-            hit.cache_token = key  # encode-cache lineage for derived views
+            if self._shared_catalog is not None:
+                # fleet: content-keyed lookup across every tenant facade
+                # — a hit reuses another tenant's encoded view (its
+                # "shared"-rooted cache_token makes the device tensors
+                # shareable too); the local epoch-keyed LRU still fronts
+                # it so the per-solve fast path stays two dict lookups
+                hit = self._shared_catalog.get_or_encode(nc.hash(), types)
+            else:
+                hit = encode_catalog(types)
+                hit.cache_token = key  # encode-cache lineage for derived views
             self._cat_cache[key] = hit
             # small LRU, not single-slot: two NodeClass views alternating
             # each reconcile must both stay resident (a clear-on-new-key
@@ -582,27 +675,40 @@ class Solver:
                 result = solve_native(cat, enc, existing)
             else:
                 try:
-                    from .solver import device_catalog, solve_device
+                    from .solver import (_auto_dcat, device_catalog,
+                                         solve_device)
                     R = enc.requests.shape[1]
                     mesh = self.mesh() if backend == "mesh" else None
-                    # keyed on (nodeclass hash, catalog epoch, R, placement,
-                    # block gating) — NOT id(cat): a freed CatalogTensors'
-                    # address can be reused by its successor
-                    dkey = self._last_cat_key + (R, backend == "mesh",
-                                                 blocks_gated, ds_fp)
-                    dcat = self._dcat_cache.get(dkey)
-                    if dcat is None:
-                        # device residency follows the host LRU: every
-                        # variant (block-gating states, mesh vs single)
-                        # of any CACHED catalog view may stay — mixed
-                        # pools and alternating NodeClasses must not
-                        # thrash a full host→device transfer per solve
-                        n = len(self._last_cat_key)
-                        for k in [k for k in self._dcat_cache
-                                  if k[:n] not in self._cat_cache]:
-                            del self._dcat_cache[k]
-                        dcat = device_catalog(cat, R, mesh=mesh)
-                        self._dcat_cache[dkey] = dcat
+                    if (self._shared_catalog is not None
+                            and cat.cache_token is not None
+                            and cat.cache_token[0] == "shared"):
+                        # fleet: device residency keys on the content
+                        # token in the PROCESS-global cache
+                        # (ops/solver._auto_dcat), so tenant facades
+                        # sharing this view — and its gated/daemonset-
+                        # derived tokens — share one upload and one
+                        # compiled executable
+                        dcat = _auto_dcat(cat, R, mesh=mesh)
+                    else:
+                        # keyed on (nodeclass hash, catalog epoch, R,
+                        # placement, block gating) — NOT id(cat): a freed
+                        # CatalogTensors' address can be reused by its
+                        # successor
+                        dkey = self._last_cat_key + (R, backend == "mesh",
+                                                     blocks_gated, ds_fp)
+                        dcat = self._dcat_cache.get(dkey)
+                        if dcat is None:
+                            # device residency follows the host LRU: every
+                            # variant (block-gating states, mesh vs single)
+                            # of any CACHED catalog view may stay — mixed
+                            # pools and alternating NodeClasses must not
+                            # thrash a full host→device transfer per solve
+                            n = len(self._last_cat_key)
+                            for k in [k for k in self._dcat_cache
+                                      if k[:n] not in self._cat_cache]:
+                                del self._dcat_cache[k]
+                            dcat = device_catalog(cat, R, mesh=mesh)
+                            self._dcat_cache[dkey] = dcat
                     result = solve_device(cat, enc, existing, dcat=dcat,
                                           mesh=mesh)
                 except Exception as e:  # noqa: BLE001 — graceful degradation:

@@ -167,7 +167,18 @@ def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
 # stale entry. Without this, every bare solve_device call re-uploads the
 # [T,R]+2x[T,Z,C] catalog — 3 tunnel round-trips that made round 4's
 # end-to-end numbers regress ~45 ms/solve.
+#
+# Fleet extension: views minted by the facade's SharedCatalogCache carry
+# a CONTENT-authoritative token ("shared", nodeclass-hash, fingerprint),
+# and those key here by token instead of id — the per-solve derived
+# copies (block gating, daemonset overhead) then share ONE device upload
+# across every tenant facade, and — shapes being equal — one compiled
+# executable. Only "shared"-rooted tokens qualify: the classic
+# (nodeclass-hash, epoch) tokens are unique per provider, not per
+# content, and two tenants' epoch counters can collide while their
+# availability differs.
 _dcat_auto: dict = {}
+_DCAT_TOKEN_MAX = 32  # bound for token-keyed entries (no weakref owner)
 
 
 def _auto_dcat(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
@@ -176,15 +187,24 @@ def _auto_dcat(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
     mesh-replicated one (same staleness predicate and weakref lifecycle
     — ONE implementation so the two can't diverge)."""
     import weakref
-    key = (id(cat), mesh)
+    tok = cat.cache_token
+    by_token = tok is not None and len(tok) > 0 and tok[0] == "shared"
+    key = (tuple(tok), mesh) if by_token else (id(cat), mesh)
     ent = _dcat_auto.get(key)
     if (ent is not None and ent.alloc.shape[1] >= R
             and (ent.ovh_z is not None) == (cat.zone_overhead is not None)):
         return ent
-    if ent is None:
+    if ent is None and not by_token:
         weakref.finalize(cat, _dcat_auto.pop, key, None)
     dcat = device_catalog(cat, R, mesh=mesh)
     _dcat_auto[key] = dcat
+    if by_token:
+        # token-keyed entries deliberately OUTLIVE any one CatalogTensors
+        # object (derived per-solve copies die at end of solve; their
+        # upload must not) — bound them FIFO instead of by weakref
+        tkeys = [k for k in _dcat_auto if isinstance(k[0], tuple)]
+        for k in tkeys[:max(0, len(tkeys) - _DCAT_TOKEN_MAX)]:
+            _dcat_auto.pop(k, None)
     return dcat
 
 

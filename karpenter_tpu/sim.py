@@ -91,7 +91,8 @@ def make_sim(types: Optional[List[InstanceType]] = None,
              fault_plan: Optional[object] = None,
              warmpath: bool = False,
              warm_audit_every: int = 1,
-             journal: Optional[object] = None) -> SimEnvironment:
+             journal: Optional[object] = None,
+             solver_factory: Optional[object] = None) -> SimEnvironment:
     """Passing an existing `cloud` (+ its clock) simulates an operator
     restart: the new stack rehydrates its fresh Store from the cloud's
     durable state instead of starting empty-world. Passing the previous
@@ -143,7 +144,13 @@ def make_sim(types: Optional[List[InstanceType]] = None,
     # hydrate below runs at t=0 and does not absorb cloud errors)
     catalog = CatalogProvider(lambda: api_cloud.describe_types(),
                               clock=clock)
-    solver = Solver(catalog, backend=backend)
+    # solver_factory(catalog) -> a Solver-compatible object: the fleet
+    # seam (karpenter_tpu/fleet/) — each tenant shard's controllers then
+    # speak to the shared SolverService through its queue-fronted client
+    # instead of owning a private facade. `backend` is the factory's
+    # concern in that case.
+    solver = (solver_factory(catalog) if solver_factory is not None
+              else Solver(catalog, backend=backend))
     # warm-path incremental admission (warmpath/): audit_every=1 means the
     # auditor replays EVERY warm admission through a full solve — the
     # always-on mode tier-1 tests and chaos scenarios run with

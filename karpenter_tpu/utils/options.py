@@ -63,6 +63,16 @@ class Options:
     # chaos scenarios run at 1 = always-on). Only read when the
     # WarmPathAdmission gate is on.
     warmpath_audit_every: int = 16
+    # fleet mode (docs/fleet.md): >0 runs N simulated tenant control
+    # planes through one process and ONE shared SolverService instead of
+    # the single-cluster operator (`make fleet` drives 50). Each tenant
+    # gets its own store/cloud/journal/warm path; per-tenant WAL files
+    # derive from the --intent-journal-file DIRECTORY when set.
+    fleet_tenants: int = 0
+    # per-tenant solve-dispatch cap per fleet scheduling window — the
+    # noisy-neighbor backpressure knob (fleet/service.py); only read in
+    # fleet mode
+    fleet_inflight_cap: int = 16
     # feature gates (reference Makefile:21-24 + settings.md)
     feature_gates: Dict[str, bool] = field(default_factory=lambda: {
         "SpotToSpotConsolidation": True,

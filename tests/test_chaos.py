@@ -136,7 +136,7 @@ class TestIceStormObservability:
         status, _, body = render("/metrics")
         text = body.decode()
         assert status == 200
-        assert 'karpenter_tpu_degraded_mode{component="capacity"}' in text
+        assert 'karpenter_tpu_degraded_mode{component="capacity"' in text
         assert "karpenter_tpu_faults_injected_total" in text
         assert 'kind="ice"' in text
 

@@ -209,6 +209,88 @@ class TestSolverDeviceFallback:
         assert DEGRADED_MODE.value(component="solver") == 0.0
 
 
+class TestFleetSolverServiceFallback:
+    """Shared-solver degradation under a fleet (ISSUE 6 satellite): a
+    device loss during ONE tenant's dispatch must degrade that tenant's
+    solves to host fallback without suspending any neighbor's device
+    path — per-tenant facades confine the cooldown, and the tenant-
+    routed dispatch hook confines the fault itself."""
+
+    def _fleet(self, backend="device"):
+        from karpenter_tpu.catalog import CatalogProvider
+        from karpenter_tpu.fleet import SolverService
+        svc = SolverService(FakeClock(), backend=backend)
+        a = svc.register("a", CatalogProvider(lambda: small_catalog()))
+        b = svc.register("b", CatalogProvider(lambda: small_catalog()))
+        return svc, a, b
+
+    def _pods(self, n=4, prefix="p"):
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        return [Pod(name=f"{prefix}{i}",
+                    requests=Resources.parse({"cpu": "1",
+                                              "memory": "1Gi"}))
+                for i in range(n)]
+
+    def test_device_loss_confined_to_faulted_tenant(self):
+        from karpenter_tpu.faults.injector import fleet_device_fault_hook
+        from karpenter_tpu.metrics import SOLVER_FALLBACKS
+        from karpenter_tpu.metrics.tenant import tenant_scope
+        from karpenter_tpu.models.nodepool import NodePool
+        svc, a, b = self._fleet()
+        pool = NodePool(name="default")
+        plan_a = FaultPlan(seed=0, rules=[DeviceFault(dispatch=1, count=1)])
+        # b's sentinel plan never fires (dispatch 999) — it exists to
+        # COUNT b's device dispatches through the routed hook
+        plan_b = FaultPlan(seed=0, rules=[DeviceFault(dispatch=999)])
+        with fleet_device_fault_hook({"a": plan_a, "b": plan_b}):
+            with tenant_scope("a"):
+                out = a.solve(self._pods(), pool)
+            # a's solve degraded to a per-shard host fallback but still
+            # returned a full placement
+            assert out.launches and not out.unschedulable
+            assert a.facade.stats["device_fallbacks"] == 1
+            assert a.facade._device_suspended > 0
+            assert SOLVER_FALLBACKS.value(from_backend="device",
+                                          to_backend="host",
+                                          tenant="a") + \
+                SOLVER_FALLBACKS.value(from_backend="device",
+                                       to_backend="native",
+                                       tenant="a") >= 1
+            # b's next solve DISPATCHES on the device (its plan counts
+            # it) — no cross-tenant suspension leak
+            with tenant_scope("b"):
+                out = b.solve(self._pods(prefix="q"), pool)
+            assert out.launches
+            assert plan_b._dispatches == 1
+            assert b.facade._device_suspended == 0
+            assert b.facade.stats["device_fallbacks"] == 0
+            # and a's cooldown keeps rerouting a WITHOUT device dispatches
+            d0 = plan_a._dispatches
+            with tenant_scope("a"):
+                a.solve(self._pods(3, prefix="r"), pool)
+            assert plan_a._dispatches == d0
+            assert a.facade.stats["device_fallbacks"] == 1
+
+    def test_faulted_tenant_reprobes_after_cooldown(self):
+        from karpenter_tpu.faults.injector import fleet_device_fault_hook
+        from karpenter_tpu.metrics.tenant import tenant_scope
+        from karpenter_tpu.models.nodepool import NodePool
+        svc, a, b = self._fleet()
+        pool = NodePool(name="default")
+        plan_a = FaultPlan(seed=0, rules=[DeviceFault(dispatch=1, count=1)])
+        with fleet_device_fault_hook({"a": plan_a}):
+            with tenant_scope("a"):
+                a.solve(self._pods(), pool)  # fault + fallback
+                for _ in range(a.facade.FALLBACK_COOLDOWN):
+                    a.solve(self._pods(2, prefix="c"), pool)
+                assert a.facade._device_suspended == 0
+                d0 = plan_a._dispatches
+                out = a.solve(self._pods(2, prefix="d"), pool)
+            assert plan_a._dispatches == d0 + 1  # device re-probed
+            assert not out.unschedulable
+
+
 class TestBatcherJitterAndGating:
     def _throttling(self, clock, fail_times):
         """A terminate backend failing with RateLimitedError while

@@ -539,7 +539,7 @@ class TestClusterStateMetrics:
         sim.engine.run_for(120, step=10)  # let the metrics poll fire
         text = REGISTRY.expose()
         assert "karpenter_cluster_state_node_count" in text
-        assert 'karpenter_cluster_state_pod_count{phase="bound"}' in text
+        assert 'karpenter_cluster_state_pod_count{phase="bound"' in text
         assert "karpenter_cluster_utilization_percent" in text
         assert "karpenter_nodeclaims_lifecycle_duration_seconds" in text
 

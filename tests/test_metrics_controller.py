@@ -69,10 +69,9 @@ class TestClusterState:
         mc = next(c for c in sim.engine.controllers
                   if isinstance(c, CloudProviderMetricsController))
         mc.reconcile(sim.clock.now())
-        assert _series(CLUSTER_NODES)[()] == float(len(sim.store.nodes))
-        pods = _series(CLUSTER_PODS)
-        assert pods[("bound",)] == 4.0
-        assert pods[("pending",)] == 0.0
+        assert CLUSTER_NODES.value() == float(len(sim.store.nodes))
+        assert CLUSTER_PODS.value(phase="bound") == 4.0
+        assert CLUSTER_PODS.value(phase="pending") == 0.0
 
     def test_nodepool_usage_excludes_deleting_and_failed(self):
         """The gauge must mirror Provisioner._pool_usage's exclusions —

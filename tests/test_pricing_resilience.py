@@ -19,8 +19,9 @@ from karpenter_tpu.utils.clock import FakeClock
 
 
 def _gauge_value(g):
-    vals = getattr(g, "_values", {})
-    return vals.get(tuple(), 0.0) if vals else 0.0
+    # value() resolves label defaults (the pricing gauges carry a
+    # tenant dimension defaulting to "default" since the fleet PR)
+    return g.value()
 
 
 class TestProvider:

@@ -167,8 +167,8 @@ class TestWarmAdmission:
         settle(sim)
         assert sim.warmpath.stats["warm_reconciles"] == warm_before
         assert sim.warmpath.stats["cold_reconciles"] >= 3
-        key = ("cold", "catalog-epoch")
-        assert WARMPATH_DECISIONS._values.get(key, 0) >= 1
+        assert WARMPATH_DECISIONS.value(path="cold",
+                                        reason="catalog-epoch") >= 1
 
     def test_interruption_kill_forces_cold_and_recovers(self):
         sim = steady_sim()
