@@ -4,13 +4,13 @@
 PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
-        deflake run native trace-report chaos crash-audit warmpath-audit \
-        encode-report fleet fleet-audit clean
+        deflake run native trace-report profile-report obs-audit chaos \
+        crash-audit warmpath-audit encode-report fleet fleet-audit clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
 
-test:  ## full suite on the 8-device virtual CPU mesh (tests/conftest.py)
+test: obs-audit  ## full suite on the 8-device virtual CPU mesh (tests/conftest.py)
 	$(PY) -m pytest tests/ -q
 
 e2etests:  ## the e2e slices (sim + subprocess remote cloud)
@@ -24,6 +24,12 @@ benchmark:  ## one JSON line on the attached TPU (reference: make benchmark)
 
 trace-report:  ## slowest spans from $$KARPENTER_TPU_TRACE_DIR/traces.jsonl (or TRACE=path)
 	$(PY) tools/trace_report.py $(TRACE)
+
+profile-report:  ## the "where does the 100ms go" phase table from profile_bench.json (or PROFILE=path)
+	$(PY) tools/profile_report.py $(PROFILE)
+
+obs-audit:  ## drift check: every metric family documented, every ledger phase bucket test-covered
+	$(PY) tools/obs_audit.py
 
 chaos:  ## chaos scenario catalog (incl. slow soaks + restart scenarios) + seed-reproducibility check
 	$(PY) -m pytest tests/test_faults.py tests/test_chaos.py tests/test_restart.py -q

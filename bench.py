@@ -380,7 +380,11 @@ def main() -> None:
     detail["c6_interruption_15k_ms"] = round(dt * 1e3, 1)
     detail["c6_interruption_msgs_per_sec"] = round(15_000 / dt)
 
-    progress("c7: trace artifact (warm 100k solve, full decomposition)")
+    progress("c7: trace artifact + phase ledger (warm 100k solve)")
+    # the phase-attribution ledger (obs/profile.py) ingests every traced
+    # window below; reset so profile_bench.json reports THIS run only
+    from karpenter_tpu.obs.profile import LEDGER
+    LEDGER.reset()
     # --- config 7: the flight-recorder artifact. One warm traced solve of
     # the headline config; together with the cold c2 trace the Chrome
     # artifact decomposes a solve into encode / device-put / compile /
@@ -493,6 +497,19 @@ def main() -> None:
                  f"cold p50 {cold_p50:.1f}ms")
     if divergences:
         progress(f"WARM AUDIT DIVERGENCE: {divergences}")
+    # one traced warm reconcile + one traced cold reconcile (untimed —
+    # the timed loops above run untraced) so the phase ledger's
+    # RECONCILE view carries the warm-admit/commit/launch/journal
+    # buckets, not just the solve stages
+    TRACER.configure(enabled=True)
+    _burst("profwarm")
+    with TRACER.trace("reconcile.profile", config="c8_warm"):
+        sim8.provisioner.reconcile(sim8.clock.now())
+    _burst("profcold")
+    sim8.warmpath.force_cold("bench-profile")
+    with TRACER.trace("reconcile.profile", config="c8_cold"):
+        sim8.provisioner.reconcile(sim8.clock.now())
+    TRACER.configure(enabled=False)
 
     progress("c9: steady-state 50k-pod affinity cluster, 1% churn per tick")
     # --- config 9: the encode-cache steady state. A standing 50k-pod
@@ -629,6 +646,15 @@ def main() -> None:
             assert out.launches
     fleet_s = time.perf_counter() - t0
 
+    # one traced extra round through the service (untimed): the ledger's
+    # per-TENANT solve attribution — pump() scopes each dispatch to its
+    # ticket's tenant, so phases land on b000..b015 series, which is
+    # what `make profile-report`'s per-tenant table shows for a fleet
+    TRACER.configure(enabled=True)
+    for t in range(N12):
+        clients12[t].solve(bursts12[t], pool12)
+    TRACER.configure(enabled=False)
+
     solves12 = N12 * R12
     detail["c12_tenants"] = N12
     detail["c12_serial_solves_per_sec"] = round(solves12 / serial_s, 1)
@@ -646,15 +672,48 @@ def main() -> None:
         progress(f"FLEET BELOW 5x: fleet {solves12 / fleet_s:.0f}/s vs "
                  f"serial {solves12 / serial_s:.0f}/s")
 
+    progress("profile: writing profile_bench.json (phase attribution)")
+    # --- the phase-attribution artifact (obs/profile.py): everything the
+    # traced windows above fed the ledger (c7 solve, c8 warm+cold
+    # reconciles, c12 per-tenant fleet round), with backend provenance
+    # so a CPU-fallback run can never read as a comparable TPU number.
+    from karpenter_tpu.ops.solver import provenance
+    prov = provenance()
+    prov["platform"] = platform
+    prov["comparable"] = platform == "accelerator"
+    if not prov["comparable"]:
+        progress(f"NON-COMPARABLE RUN: platform={platform} backend="
+                 f"{prov.get('backend')} — numbers must not be compared "
+                 "to TPU baselines")
+    snap = LEDGER.snapshot()
+    profile_cover = LEDGER.coverage()
+    detail["profile_coverage"] = round(profile_cover, 4)
+    detail["profile_unattributed_ms"] = round(LEDGER.unattributed_ms(), 3)
+    detail["profile_traces"] = LEDGER.traces
+    profile_path = os.path.join(trace_dir, "profile_bench.json")
+    with open(profile_path, "w") as f:
+        json.dump({"provenance": prov,
+                   "coverage": round(profile_cover, 4),
+                   "unattributed_ms": round(LEDGER.unattributed_ms(), 3),
+                   "snapshot": snap}, f, indent=1)
+    detail["profile_artifact"] = profile_path
+    if profile_cover < 0.99:
+        progress(f"PROFILE ATTRIBUTION GAP: coverage {profile_cover:.4f} "
+                 "< 0.99 — an un-spanned seam grew on the hot path")
+    print(LEDGER.report(), file=sys.stderr)
+
     progress("done")
     if server is not None:
         server.stop()
     detail["platform"] = platform
+    detail["provenance"] = prov
     result = {
         "metric": "p50 Solve() latency, 100k pods x full catalog",
         "value": round(tpu_s * 1e3, 1),
         "unit": "ms",
         "vs_baseline": round(host_s / tpu_s, 2),
+        "provenance": prov,
+        "comparable": prov["comparable"],
         "detail": detail,
     }
     print(json.dumps(result))

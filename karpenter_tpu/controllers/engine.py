@@ -48,26 +48,46 @@ class Engine:
 
     def tick(self) -> None:
         now = self.clock.now()
-        for fn in self.hooks:
-            fn(now)
-        if self.elector is not None:
-            if now >= self._next_run.get(self.elector.name, 0.0):
-                self._next_run[self.elector.name] = (
-                    now + max(0.0, self.elector.reconcile(now)))
-            if not self.elector.is_leader():
-                return
-        # one trace per tick, one span per controller reconcile: the
-        # tracer drops childless roots, so an idle tick (no controller
-        # due) records nothing; when tracing is off both calls return the
-        # shared no-op singleton and the tick is exactly as before
+        # one trace per tick, one span per controller reconcile. Opened
+        # only when a controller is actually due AND this replica leads,
+        # so an idle tick (or a non-leader standby, whose controllers
+        # stay permanently "due") still records nothing — but a BUSY
+        # tick's trace now encloses the per-tick hooks too
+        # (`engine.hooks`), so hook time (cloud tick, workload arrivals)
+        # is attributable instead of an unexplained gap in the phase
+        # ledger. Leadership is read BEFORE the elector's own
+        # bookkeeping below (which keeps its original hooks-then-elector
+        # order): the one tick where leadership is first acquired runs
+        # untraced — a fair trade against a standby flooding every
+        # tracer sink forever. When tracing is off everything here is
+        # the shared no-op singleton and the tick is exactly as before.
+        trace_on = (TRACER.enabled
+                    and (self.elector is None or self.elector.is_leader())
+                    and any(now >= self._next_run.get(c.name, 0.0)
+                            for c in self.controllers))
         tick_sp = (TRACER.trace("engine.tick", sim_now=now)
-                   if TRACER.enabled else NOOP_SPAN)
+                   if trace_on else NOOP_SPAN)
         with tick_sp:
+            hooks_sp = (TRACER.span("engine.hooks", hooks=len(self.hooks))
+                        if trace_on and self.hooks else NOOP_SPAN)
+            with hooks_sp:
+                for fn in self.hooks:
+                    fn(now)
+            if self.elector is not None:
+                if now >= self._next_run.get(self.elector.name, 0.0):
+                    self._next_run[self.elector.name] = (
+                        now + max(0.0, self.elector.reconcile(now)))
+                if not self.elector.is_leader():
+                    return
             for c in self.controllers:
                 if now >= self._next_run.get(c.name, 0.0):
+                    # gated on trace_on, not TRACER.enabled: with no
+                    # open tick trace (the leadership-acquisition edge
+                    # above) a bare span would start its own root trace
+                    # per controller — the tick must be truly untraced
                     sp = (TRACER.span(f"reconcile:{c.name}",
                                       controller=c.name)
-                          if TRACER.enabled else NOOP_SPAN)
+                          if trace_on else NOOP_SPAN)
                     t0 = _time.perf_counter()
                     try:
                         with sp:
@@ -75,7 +95,7 @@ class Engine:
                             # controllers may publish per-pass attributes
                             # (e.g. the provisioner's warm/cold path
                             # decision) onto their reconcile span
-                            if TRACER.enabled:
+                            if trace_on:
                                 attrs = getattr(c, "span_attrs", None)
                                 if attrs is not None:
                                     sp.set(**attrs())

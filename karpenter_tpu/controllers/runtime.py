@@ -174,7 +174,17 @@ class Runtime:
                 line = await reader.readline()
                 parts = line.decode("latin-1", "replace").split()
                 path = parts[1] if len(parts) >= 2 else "/metrics"
-                status, ctype, body = render(path)
+                # drain headers for the Accept value — /metrics content
+                # negotiation (OpenMetrics exemplars vs classic 0.0.4)
+                accept = ""
+                while True:
+                    h = await reader.readline()
+                    if not h or h in (b"\r\n", b"\n"):
+                        break
+                    if h.lower().startswith(b"accept:"):
+                        accept = h.split(b":", 1)[1].strip().decode(
+                            "latin-1", "replace")
+                status, ctype, body = render(path, accept=accept)
                 reason = {200: "OK", 404: "Not Found"}.get(status, "OK")
                 writer.write((f"HTTP/1.1 {status} {reason}\r\n"
                               f"Content-Type: {ctype}\r\n"

@@ -39,6 +39,10 @@ class FleetReport:
     tenant_fingerprints: Dict[str, str]
     sim_seconds: float
     stats: Dict[str, float] = field(default_factory=dict)
+    # observatory attachments (never part of the determinism contract —
+    # fleet_hash/fleet_fingerprint ignore them):
+    slo: Dict[str, object] = field(default_factory=dict)
+    explain: Dict[str, object] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -101,6 +105,7 @@ class FleetRunner:
         self.clock: Optional[FakeClock] = None
         self.service: Optional[SolverService] = None
         self.shards: List[TenantShard] = []
+        self.slo = None  # obs.slo.SloEngine, built in run()
         self.origin = 0.0
 
     def build(self) -> None:
@@ -128,6 +133,21 @@ class FleetRunner:
         if not self.shards:
             self.build()
         clock = self.clock
+        # the observatory's SLO engine rides every fleet run: declared
+        # per-tenant objectives evaluated on the SIM clock over the
+        # tenant-dimensioned families the shards already emit. Read-only
+        # over metrics + clock, so end-state hashes and fault
+        # fingerprints are untouched (the fleet-audit repeat contract
+        # holds with it on).
+        from ..obs.slo import SloEngine
+        self.slo = SloEngine(clock,
+                             tenants=tuple(s.name for s in self.shards))
+        # per-run provenance baseline: tenant/pod names are deterministic
+        # across seeded repeats in ONE process (run_matrix), so stale
+        # records from a previous run could satisfy this run's explain
+        # verdict — reset like the SLO engine baselines
+        from ..obs.explain import RECORDER
+        RECORDER.reset()
         deadline = clock.now() + sc.timeout
         plans = {s.name: s.plan for s in self.shards if s.plan is not None}
         converged = False
@@ -135,10 +155,12 @@ class FleetRunner:
             while clock.now() < deadline:
                 for shard in self.shards:
                     shard.tick()
+                self.slo.tick()
                 if all(s.quiet() for s in self.shards):
                     converged = True
                     break
                 clock.step(sc.step)
+        self.slo.tick(force=True)  # final evaluation at the end state
 
         violations: List[str] = []
         hashes: Dict[str, str] = {}
@@ -174,11 +196,24 @@ class FleetRunner:
                 svc.stats["dispatched"] / wall, 1)
         if warm_div:
             stats["warm_divergences"] = warm_div
+        stats["slo_alerts"] = float(len(self.slo.alerts))
         report = FleetReport(
             scenario=sc.name, seed=self.seed, tenants=self.tenants,
             converged=converged, violations=violations,
             tenant_hashes=hashes, tenant_fingerprints=fingerprints,
             sim_seconds=clock.now() - self.origin, stats=stats)
+        report.slo = self.slo.payload()
+        # causal trail: any tenant the service throttled gets one
+        # explained pod attached (throttle count + the funnel of the
+        # solve that finally placed it), so a starvation finding in the
+        # report comes with its provenance instead of a bare counter
+        from ..obs.explain import RECORDER
+        for tenant, state in svc.tenants.items():
+            if not state.throttled:
+                continue
+            pods = RECORDER.tenant_pods(tenant, outcome="throttled")
+            if pods:
+                report.explain[tenant] = RECORDER.explain(pods[-1], tenant)
         if sc.analyze is not None:
             sc.analyze(self, report)
         return report

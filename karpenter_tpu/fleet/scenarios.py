@@ -205,6 +205,42 @@ def _noisy_analyze(runner, report) -> None:
         report.violations.append(
             "noisy tenant was never throttled — the in-flight cap did "
             "not engage")
+    # --- SLO / error-budget verdict (obs/slo.py): the declared-objective
+    # form of the same isolation invariant — the noisy tenant must BURN
+    # (its throttles are availability bad-events; a burn-rate alert must
+    # fire), while every victim's budget survives the storm.
+    slo = getattr(runner, "slo", None)
+    if slo is not None:
+        budgets = slo.budgets()
+        noisy_alerts = [a for a in slo.alerts if a["tenant"] == noisy]
+        victim_avail = [budgets[t].get("solve_availability", 1.0)
+                        for t in budgets if t != noisy]
+        report.stats.update({
+            "noisy_burn_alerts": float(len(noisy_alerts)),
+            "noisy_availability_budget": budgets.get(noisy, {}).get(
+                "solve_availability", 1.0),
+            "victim_min_availability_budget": (min(victim_avail)
+                                               if victim_avail else 1.0),
+        })
+        if not noisy_alerts:
+            report.violations.append(
+                "noisy tenant never fired an SLO burn-rate alert despite "
+                "being throttled")
+        if victim_avail and min(victim_avail) <= 0.5:
+            report.violations.append(
+                f"a victim tenant's availability error budget did not "
+                f"survive the storm (min remaining "
+                f"{min(victim_avail):.3f})")
+    # --- provenance verdict (obs/explain.py): a throttled pod must be
+    # explainable — /debug/explain answers with its throttle trail and,
+    # once a later solve placed it, the constraint funnel.
+    explained = report.explain.get(noisy)
+    report.stats["noisy_throttled_pod_explained"] = float(
+        bool(explained and explained.get("throttles", 0) > 0))
+    if not explained:
+        report.violations.append(
+            "no /debug/explain record for any of the noisy tenant's "
+            "throttled pods")
 
 
 FLEET_SCENARIOS: Dict[str, FleetScenario] = {}

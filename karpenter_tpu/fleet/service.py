@@ -99,10 +99,23 @@ class TenantSolverClient:
 
     def solve(self, pods, *args, **kwargs):
         cost = self._service.cost_model(len(pods))
-        return self._service.call(
-            self.tenant, "solve",
-            lambda: self.facade.solve(pods, *args, **kwargs),
-            cost=cost, pods=len(pods))
+        try:
+            return self._service.call(
+                self.tenant, "solve",
+                lambda: self.facade.solve(pods, *args, **kwargs),
+                cost=cost, pods=len(pods))
+        except SolverServiceBusy:
+            # decision provenance for the refusal: the solve never ran,
+            # so the solver can't explain these pods — the throttle
+            # itself is the causal trail (/debug/explain shows
+            # binding_constraint=fleet_inflight_cap until a later solve
+            # places them and preserves the throttle count)
+            from ..obs.explain import RECORDER
+            if RECORDER.enabled:
+                RECORDER.note_throttle(
+                    self.tenant,
+                    [f"{p.namespace}/{p.name}" for p in pods])
+            raise
 
     def __getattr__(self, name):
         return getattr(self.facade, name)
@@ -163,18 +176,15 @@ class SolverService:
                                         "windows": 0}
         # /debug/fleet on both exposition servers: the live per-tenant
         # queue/throttle/starvation view (last-built service wins). The
-        # route holds a WEAK reference — a bound method would pin the
-        # whole fleet (facades, encode contexts, device buffers) for the
-        # process lifetime after the run ends, and serve its corpse
-        import weakref
+        # route table holds the service by WEAKREF — the uniform debug-
+        # route contract (obs/exposition.register_debug_route): a strong
+        # payload would pin the whole fleet (facades, encode contexts,
+        # device buffers) for the process lifetime after the run ends,
+        # and serve its corpse; a dead owner answers {"inactive": true}
         from ..obs.exposition import register_debug_route
-        this = weakref.ref(self)
-
-        def _payload():
-            svc = this()
-            return (svc.debug_payload() if svc is not None
-                    else {"inactive": True})
-        register_debug_route("/debug/fleet", _payload)
+        register_debug_route("/debug/fleet",
+                             lambda svc, query: svc.debug_payload(),
+                             owner=self)
 
     # --- registration -----------------------------------------------------
     def register(self, tenant: str, catalog) -> TenantSolverClient:
@@ -250,7 +260,16 @@ class SolverService:
                   if TRACER.enabled else NOOP_SPAN)
             t0 = _time.perf_counter()
             try:
-                with sp:
+                # every sample the solve emits (and every trace the
+                # ledger ingests) attributes to the ticket's tenant even
+                # when the caller never entered a scope (bench c12,
+                # direct clients) — re-entrant, so the fleet runner's
+                # shard scope is unchanged. Scope OUTSIDE the span: when
+                # fleet.dispatch is the trace root, its exit fires the
+                # ledger sink, which reads current_tenant() — the scope
+                # must still be active then
+                from ..metrics.tenant import tenant_scope
+                with tenant_scope(ticket.tenant), sp:
                     ticket.value = ticket._thunk()
             except BaseException as e:  # noqa: BLE001 — the future carries it
                 ticket.error = e
@@ -341,13 +360,23 @@ class SolverService:
                 "catalog_shared": dict(self.shared_catalog.stats)}
 
     def snapshot(self) -> Dict[str, dict]:
-        """Per-tenant service view for /debug/fleet and reports."""
-        return {
-            tenant: {
+        """Per-tenant service view for /debug/fleet and reports. Each
+        tenant row carries its facade's encode-cache effectiveness —
+        the queryable per-tenant face of the phase ledger's encode_cold
+        vs encode_cached split."""
+        out: Dict[str, dict] = {}
+        for tenant, state in sorted(self.tenants.items()):
+            row = {
                 "solves": state.solves,
                 "throttled": state.throttled,
                 "window_jobs": len(state.window_jobs),
                 "max_wait_ms": round(state.max_wait * 1e3, 3),
                 "wall_ms": round(state.wall_seconds * 1e3, 1),
             }
-            for tenant, state in sorted(self.tenants.items())}
+            client = self.clients.get(tenant)
+            cache = (getattr(client.facade, "_encode_cache", None)
+                     if client is not None else None)
+            if cache is not None:
+                row["encode_cache"] = cache.snapshot()
+            out[tenant] = row
+        return out

@@ -278,6 +278,52 @@ FLEET_CATALOG_SHARED = REGISTRY.counter(
     "device-resident tensors and compiled executables), a 'miss' paid "
     "the full encode_catalog",
     ("event",))
+PROFILE_PHASE_MS = REGISTRY.counter(
+    "karpenter_tpu_profile_phase_ms_total",
+    "Milliseconds of wall time the phase-attribution ledger "
+    "(obs/profile.py) attributed to each named phase bucket of a traced "
+    "solve/reconcile, by enclosing kind — the scrapeable form of the "
+    "'where does the 100ms go' table `make profile-report` prints. Only "
+    "grows while tracing is enabled (the ledger ingests finished traces)",
+    ("phase", "kind", "tenant"), label_defaults=_TENANT)
+PROFILE_UNATTRIBUTED_MS = REGISTRY.counter(
+    "karpenter_tpu_profile_unattributed_ms_total",
+    "Milliseconds of a traced solve/reconcile's wall time NO ledger "
+    "bucket claimed (the enclosing span's self-time outside every "
+    "instrumented seam). The coverage invariant: buckets must sum to "
+    ">=99% of the enclosing wall or the gap is flight-recorded as a "
+    "profile.unattributed trace — growth here means an un-spanned seam "
+    "appeared on the hot path",
+    ("kind", "tenant"), label_defaults=_TENANT)
+PROFILE_COVERAGE = REGISTRY.gauge(
+    "karpenter_tpu_profile_attribution_coverage",
+    "Running attribution coverage of the phase ledger (attributed wall "
+    "/ enclosing wall, 0..1) per traced-root kind — the bench "
+    "acceptance bar is >=0.99",
+    ("kind", "tenant"), label_defaults=_TENANT)
+SLO_ERROR_BUDGET = REGISTRY.gauge(
+    "karpenter_tpu_slo_error_budget_remaining",
+    "Fraction of a tenant's error budget remaining for one declared "
+    "objective (obs/slo.py) since the SLO engine baselined: 1 = no bad "
+    "events, 0 = budget exhausted, negative = overdrawn. The "
+    "noisy-neighbor invariant reads as: the victim's gauge stays high "
+    "while the noisy tenant's burns down",
+    ("slo", "tenant"), label_defaults=_TENANT)
+SLO_BURN_RATE = REGISTRY.gauge(
+    "karpenter_tpu_slo_burn_rate",
+    "Multi-window burn rate: bad-event rate over the window divided by "
+    "the objective's allowance (1 = spending budget exactly at the "
+    "sustainable rate; 14.4 = a 30d budget gone in 2d). Windows are "
+    "sim-time (fast=5m, slow=1h) so chaos runs evaluate burn on the "
+    "same timeline that produced the events",
+    ("slo", "window", "tenant"), label_defaults=_TENANT)
+SLO_BURN_ALERTS = REGISTRY.counter(
+    "karpenter_tpu_slo_burn_alerts_total",
+    "Burn-rate alerts fired by the SLO engine (fast AND slow window "
+    "over threshold — the classic multi-window page condition). Each "
+    "firing also lands an slo.burn trace in the flight-recorder ring "
+    "so the alert arrives with its evidence",
+    ("slo", "tenant"), label_defaults=_TENANT)
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

@@ -7,8 +7,9 @@ external client dependency; text exposition matches the Prometheus format
 so a scraper can consume `registry.expose()` verbatim — with one caveat:
 histogram exemplars (`# {trace_id="..."} v` suffixes) are an OpenMetrics
 feature the classic 0.0.4 text parser rejects, so the HTTP exposition
-layer advertises the OpenMetrics content type (obs/exposition.py); call
-`expose(exemplars=False)` for a strictly 0.0.4 document.
+layer content-negotiates (obs/exposition.py): strict 0.0.4 via
+`expose(exemplars=False)` by default, the exemplar-bearing OpenMetrics
+document only for scrapers sending `Accept: application/openmetrics-text`.
 """
 
 from __future__ import annotations
@@ -66,6 +67,18 @@ class Counter(_Metric):
 
     def value(self, **labels) -> float:
         return self._values.get(self._key(labels), 0.0)
+
+    def sum(self, **labels) -> float:
+        """Sum over every series matching the given label SUBSET (an
+        omitted label matches all its values) — the aggregation the SLO
+        indicators need over multi-dimensional families (e.g. all
+        `warmpath_decisions_total` paths of one tenant). Unlike value(),
+        omitted labels do NOT resolve through defaults here."""
+        idx = {k: i for i, k in enumerate(self.label_names)}
+        want = {idx[k]: str(v) for k, v in labels.items()}
+        with self._lock:
+            return sum(v for k, v in self._values.items()
+                       if all(k[i] == s for i, s in want.items()))
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -136,6 +149,21 @@ class Histogram(_Metric):
             if exemplar is not None:
                 self._exemplars[(k, min(i, len(self.buckets)))] = (
                     str(exemplar), value)
+
+    def total(self, **labels) -> int:
+        """Observation count for a label set (the `_count` series)."""
+        return self._totals.get(self._key(labels), 0)
+
+    def cumulative_le(self, le: float, **labels) -> int:
+        """Observations ≤ `le` for a label set — bucket counts are
+        CDF-style, so this is one lookup. `le` snaps DOWN to the nearest
+        bucket bound (a threshold between buckets under-counts rather
+        than over-counts good events — conservative for SLOs)."""
+        counts = self._counts.get(self._key(labels))
+        if not counts:
+            return 0
+        i = bisect.bisect_right(self.buckets, le) - 1
+        return counts[i] if i >= 0 else 0
 
     def percentile(self, q: float, **labels) -> Optional[float]:
         k = self._key(labels)

@@ -9,38 +9,48 @@ cluster operators never set a scope, so every sample lands on the
 reads (`COUNTER.value()`) resolve to that same series, keeping existing
 dashboards and tests byte-compatible.
 
-The scope is a plain module global, not a contextvar: the fleet runner
-drives shards strictly serially on one thread (the same determinism
-contract the chaos harness relies on), and the metric call sites are
-nil-overhead enough that a contextvar lookup per sample would be the
-most expensive thing in them.
+The scope is THREAD-LOCAL: the fleet runner drives shards strictly
+serially on one thread (the determinism contract), but the exposition
+servers scrape from their own threads, and a future threaded fleet must
+not let tenant A's scope leak into a sample tenant B's thread is
+writing (tests/test_obs.py hammers exactly this). A thread that never
+entered a scope reads the class-level default — one attribute lookup,
+no contextvar machinery on the metric hot path.
 """
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from typing import Iterator
 
 DEFAULT_TENANT = "default"
 
-_current: str = DEFAULT_TENANT
+
+class _Scope(threading.local):
+    # class attribute = the per-thread default until a scope is entered
+    value: str = DEFAULT_TENANT
+
+
+_scope = _Scope()
 
 
 def current_tenant() -> str:
     """The tenant every tenant-dimensioned metric sample is attributed
-    to right now; "default" outside any fleet scope."""
-    return _current
+    to right now on THIS thread; "default" outside any fleet scope."""
+    return _scope.value
 
 
 @contextmanager
 def tenant_scope(name: str) -> Iterator[None]:
     """Attribute metric samples inside the block to `name` — the fleet
-    runner wraps each shard's engine tick in one. Re-entrant: nested
-    scopes restore the outer tenant on exit."""
-    global _current
-    prev = _current
-    _current = name
+    runner wraps each shard's engine tick in one, and the SolverService
+    wraps each dispatched solve. Re-entrant: nested scopes restore the
+    outer tenant on exit. Per-thread: a scope entered on one thread is
+    invisible to every other."""
+    prev = _scope.value
+    _scope.value = name
     try:
         yield
     finally:
-        _current = prev
+        _scope.value = prev

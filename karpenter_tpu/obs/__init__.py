@@ -1,10 +1,15 @@
-"""Observability: span tracing, the solver flight recorder, and HTTP
-exposition. See docs/observability.md for the span taxonomy and how to
-read a bench trace."""
+"""Observability: span tracing, the solver flight recorder, HTTP
+exposition, and the solver observatory (phase-attribution profiler,
+per-tenant SLO engine, decision provenance). See docs/observability.md
+for the span/phase taxonomies and how to read a bench trace."""
 
 from .tracer import (NOOP_SPAN, TRACER, FlightRecorder, Span, Trace, Tracer,
                      summarize, to_chrome_events, write_chrome_trace)
+# importing installs the process ledger as a tracer sink and registers
+# /debug/profile + /debug/explain; both are free while tracing is off
+from .explain import RECORDER
+from .profile import LEDGER, PHASES, PhaseLedger
 
 __all__ = ["TRACER", "Tracer", "Span", "Trace", "FlightRecorder",
            "NOOP_SPAN", "to_chrome_events", "write_chrome_trace",
-           "summarize"]
+           "summarize", "LEDGER", "PHASES", "PhaseLedger", "RECORDER"]

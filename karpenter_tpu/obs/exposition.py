@@ -1,50 +1,74 @@
-"""HTTP exposition: /metrics, /debug/traces, /healthz.
+"""HTTP exposition: /metrics, /debug/*, /healthz.
 
 One route table (`render`) shared by BOTH servers so the two can't
 drift: the async runtime's handler (controllers/runtime.py — the
 deployment path, one event loop) and the stdlib ThreadingHTTPServer here
 (`ExpositionServer` — for bench runs and anything without an event
 loop). The reference ships the same trio: controller-runtime's metrics
-endpoint + health probes; /debug/traces is the flight-recorder window
-this framework adds on top.
+endpoint + health probes; /debug/* is the observatory window this
+framework adds on top.
+
+Content negotiation (/metrics): the DEFAULT document is strict
+Prometheus 0.0.4 text (no exemplars — the classic parser reads the
+`# {trace_id=...}` suffix as a malformed timestamp and fails the whole
+scrape). A scraper that advertises `Accept: application/openmetrics-text`
+gets the OpenMetrics rendering WITH histogram exemplars and the
+required `# EOF` terminator — so trace-id exemplars reach the scrapers
+that can use them without breaking the ones that can't.
+
+Debug-route contract: every registered /debug/* route holds its owner
+by WEAKREF only. `register_debug_route(route, payload, owner=obj)`
+stores `payload` (a plain callable taking `(owner, query)`) plus a
+weak reference; once the owner dies the route answers
+`{"inactive": true}` instead of pinning a dead subsystem (or serving
+its corpse). Ownerless routes take `(query)`. Last registration wins —
+a rebuilt subsystem replaces its predecessor.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import weakref
 from typing import Optional, Tuple
 
 from .tracer import TRACER, Tracer, to_chrome_events
 
-# pluggable /debug/* routes: subsystems register a JSON-payload callable
-# (e.g. the fleet's SolverService serves /debug/fleet — per-tenant
-# queue/throttle/starvation state) and BOTH servers pick it up through
-# the shared route table, same no-drift contract as the built-ins
+# route -> (payload, owner_weakref | None); see module docstring
 DEBUG_ROUTES: dict = {}
 
+OPENMETRICS_CTYPE = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8")
+TEXT_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 
-def register_debug_route(route: str, payload) -> None:
-    """Serve `payload()` (a JSON-serializable dict) at `route`. Last
-    registration wins — a rebuilt subsystem replaces its predecessor."""
-    DEBUG_ROUTES[route] = payload
+
+def register_debug_route(route: str, payload, owner=None) -> None:
+    """Serve a JSON payload at `route` on both servers.
+
+    - `owner=None`: `payload(query)` is called per request.
+    - `owner=obj`: `payload(owner, query)` is called with the LIVE
+      owner; the table keeps only a weakref, and a dead owner renders
+      `{"inactive": true}` — the uniform lifecycle every subsystem route
+      (fleet service, SLO engine, profiler, explain recorder) follows.
+      `payload` must not close over the owner, or the weakref is moot.
+    """
+    ref = weakref.ref(owner) if owner is not None else None
+    DEBUG_ROUTES[route] = (payload, ref)
 
 
 def render(path: str, tracer: Optional[Tracer] = None,
-           ) -> Tuple[int, str, bytes]:
+           accept: str = "") -> Tuple[int, str, bytes]:
     """(status, content_type, body) for an exposition route. Unknown
-    paths 404 — both servers answer identically."""
+    paths 404 — both servers answer identically. `accept` is the
+    request's Accept header (content negotiation for /metrics)."""
     tracer = tracer or TRACER
     route, _, query = path.partition("?")
     if route == "/metrics":
         from ..metrics import REGISTRY
-        # exemplars are an OpenMetrics feature — the classic 0.0.4 parser
-        # reads the '# {trace_id=...}' suffix as a malformed timestamp
-        # and fails the whole scrape, so advertise the OpenMetrics type
-        # (and close with its required EOF marker)
-        body = REGISTRY.expose().encode() + b"# EOF\n"
-        return (200, "application/openmetrics-text; version=1.0.0; "
-                     "charset=utf-8", body)
+        if "application/openmetrics-text" in (accept or ""):
+            body = REGISTRY.expose().encode() + b"# EOF\n"
+            return 200, OPENMETRICS_CTYPE, body
+        return 200, TEXT_CTYPE, REGISTRY.expose(exemplars=False).encode()
     if route == "/healthz":
         return 200, "text/plain", b"ok\n"
     if route == "/debug/traces":
@@ -58,9 +82,16 @@ def render(path: str, tracer: Optional[Tracer] = None,
                                "count": len(traces),
                                "traces": [t.to_dict() for t in traces]})
         return 200, "application/json", body.encode()
-    fn = DEBUG_ROUTES.get(route)
-    if fn is not None:
-        return 200, "application/json", json.dumps(fn()).encode()
+    entry = DEBUG_ROUTES.get(route)
+    if entry is not None:
+        payload, ref = entry
+        if ref is not None:
+            owner = ref()
+            out = ({"inactive": True} if owner is None
+                   else payload(owner, query))
+        else:
+            out = payload(query)
+        return 200, "application/json", json.dumps(out).encode()
     return 404, "text/plain", b"not found\n"
 
 
@@ -76,7 +107,8 @@ class ExpositionServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib casing)
-                status, ctype, body = render(self.path, tr)
+                status, ctype, body = render(
+                    self.path, tr, accept=self.headers.get("Accept", ""))
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

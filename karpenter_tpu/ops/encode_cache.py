@@ -346,3 +346,16 @@ class EncodeCache:
     def hit_rate(self) -> float:
         seen = self.stats["hits"] + self.stats["misses"]
         return self.stats["hits"] / seen if seen else 0.0
+
+    def snapshot(self) -> dict:
+        """JSON-ready effectiveness view — the per-tenant encode-cache
+        panel the fleet's /debug/fleet serves (and the queryable form of
+        the ledger's encode_cold vs encode_cached split): per-context
+        resident rows plus the shared hit/miss/rotation/eviction
+        counters."""
+        return {
+            "hit_rate": round(self.hit_rate(), 4),
+            "resident_rows": self.resident_rows,
+            "contexts": len(self._ctxs),
+            "stats": dict(self.stats),
+        }

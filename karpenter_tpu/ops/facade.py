@@ -729,6 +729,14 @@ class Solver:
 
         out = self._decode(cat, enc, result, nodepool, dropped)
         out = self._merge_plan(out, plan, cat, nodepool)
+        # decision provenance: per-pod placement records + the constraint
+        # elimination funnel, bounded and read-only (obs/explain.py) —
+        # solves above the recorder's pod cap are skipped, and the
+        # colocation-only early return above is not recorded (bundle
+        # placement is the planner's, not the funnel's)
+        from ..obs.explain import RECORDER
+        if RECORDER.enabled:
+            RECORDER.record_solve(cat, enc, out)
         return self._retry_reserved_unschedulable(
             out, blocks_gated, all_pods, nodepool, node_class,
             spread_occupancy, daemonsets)

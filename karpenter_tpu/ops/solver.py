@@ -54,6 +54,27 @@ def transfer_stats() -> Tuple[int, int]:
     return _TRANSFERS, _READS
 
 
+def provenance() -> dict:
+    """Backend/platform provenance for bench artifacts and profile
+    reports: which backend jax actually resolved, the device kind, and
+    host/device counts. Stamped into every BENCH_*.json and
+    profile_bench.json so a CPU-fallback run (no tunnel RTT, no real
+    kernel) can never masquerade as a comparable TPU number again
+    (BENCH_r05 did exactly that silently)."""
+    out: dict = {"backend": None, "device_kind": None, "device_count": 0,
+                 "host_count": 1, "cpu_fallback": True}
+    try:
+        out["backend"] = jax.default_backend()
+        devices = jax.devices()
+        out["device_count"] = len(devices)
+        out["device_kind"] = devices[0].device_kind if devices else None
+        out["host_count"] = jax.process_count()
+        out["cpu_fallback"] = out["backend"] == "cpu"
+    except Exception as e:  # noqa: BLE001 — provenance must not crash a bench
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def transfer_bytes() -> Tuple[int, int]:
     """(host→device, device→host) bytes since import — the companion to
     transfer_stats(): call COUNT is the RTT budget, byte volume is the

@@ -170,13 +170,21 @@ class IntentJournal:
             # opens are written + flushed + fsync'd BEFORE the wire call
             # they protect: an intent that only lived in a page cache
             # when the process died protects nothing. One fsync covers
-            # the whole batch; resolutions pass sync=False (see resolve)
-            with open(self.path, "a", encoding="utf-8") as f:
-                for record in records:
-                    f.write(json.dumps(record, sort_keys=True) + "\n")
-                f.flush()
-                if sync:
-                    os.fsync(f.fileno())
+            # the whole batch; resolutions pass sync=False (see resolve).
+            # The span feeds the phase ledger's journal_fsync bucket —
+            # fsync latency on the launch hot path is exactly the kind
+            # of host-side cost the profiler exists to attribute.
+            from ..obs.tracer import NOOP_SPAN, TRACER
+            sp = (TRACER.span("journal.fsync", records=len(records),
+                              sync=sync)
+                  if TRACER.enabled else NOOP_SPAN)
+            with sp:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    for record in records:
+                        f.write(json.dumps(record, sort_keys=True) + "\n")
+                    f.flush()
+                    if sync:
+                        os.fsync(f.fileno())
 
     def _replay_file(self, path: str) -> None:
         """Rebuild the open set from an existing journal file (operator

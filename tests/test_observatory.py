@@ -1,0 +1,494 @@
+"""Solver observatory: phase-attribution profiler (obs/profile.py),
+per-tenant SLO/error-budget engine (obs/slo.py), and decision
+provenance (obs/explain.py).
+
+The per-bucket mapping table in TestPhaseLedgerMapping is the canonical
+test coverage of the ledger taxonomy — `make obs-audit` greps this file
+for every bucket name, so a new bucket without a row here fails the
+audit."""
+
+import json
+import time
+
+import pytest
+
+from karpenter_tpu.obs.profile import (DEVICE_PHASES, LEDGER, PHASES,
+                                       PhaseLedger, format_report)
+from karpenter_tpu.obs.tracer import TRACER, FlightRecorder, Tracer
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def ring():
+    """Swap the global flight-recorder ring (gap/burn markers land
+    there) and restore after."""
+    saved = TRACER.recorder
+    TRACER.recorder = FlightRecorder(8)
+    yield TRACER.recorder
+    TRACER.recorder = saved
+
+
+def _ledger_tracer():
+    tr = Tracer(enabled=True, ring_size=4)
+    tr.trace_dir = ""
+    led = PhaseLedger()
+    tr.add_sink(led.ingest)
+    return tr, led
+
+
+class TestPhaseLedgerMapping:
+    # (span name, attrs, expected bucket) — one row per taxonomy bucket.
+    CASES = [
+        ("engine.hooks", {}, "hooks"),
+        ("provision.batch", {}, "batch"),
+        ("encode.lower", {"cache_hits": 0, "cache_misses": 2},
+         "encode_cold"),
+        ("encode.lower", {"cache_hits": 3, "cache_misses": 0},
+         "encode_cached"),
+        ("encode.affinity", {}, "affinity"),
+        ("solve.spread", {}, "spread"),
+        ("solve.prep", {"groups_padded": 8, "n_max": 64}, "prep"),
+        ("solve.catalog_put", {"h2d_bytes": 256}, "catalog_put"),
+        ("solve.device_put", {"h2d_bytes": 128}, "device_put"),
+        ("solve.compile", {}, "compile"),
+        ("solve.dispatch", {}, "dispatch"),
+        ("solve.readback", {"d2h_bytes": 64}, "readback"),
+        ("solve.decode", {}, "decode"),
+        ("solve.run", {"backend": "host", "groups": 3}, "solve_host"),
+        ("solve.device", {}, "solver_overhead"),
+        ("provision.launch", {}, "launch"),
+        ("provision.bind", {}, "bind"),
+        ("warmpath.admit", {}, "warm_admit"),
+        ("warmpath.commit", {}, "commit"),
+        ("journal.fsync", {"records": 1}, "journal_fsync"),
+        ("cloud.create_fleet", {}, "cloud_api"),
+        ("fleet.submit", {}, "queue_wait"),
+        ("reconcile:provisioner", {}, "reconcile_other"),
+    ]
+
+    def test_every_bucket_reachable(self):
+        """One trace containing a representative span per bucket: every
+        taxonomy name accumulates time, nothing lands outside it."""
+        tr, led = _ledger_tracer()
+        with tr.trace("engine.tick"):
+            for name, attrs, _bucket in self.CASES:
+                with tr.span(name, **attrs):
+                    pass
+        snap = led.snapshot()
+        phases = snap["phases"]["default"]["reconcile"]
+        for name, attrs, bucket in self.CASES:
+            assert bucket in phases, (name, bucket, sorted(phases))
+            assert phases[bucket]["ms"] > 0
+        # host/device sides are stamped
+        assert phases["device_put"]["side"] == "device"
+        assert phases["encode_cold"]["side"] == "host"
+        assert snap["bytes"]["default/device_put"] == 128
+        assert snap["bytes"]["default/catalog_put"] == 256
+        assert snap["bytes"]["default/readback"] == 64
+        assert led.errors == 0
+
+    def test_taxonomy_fully_covered_by_cases(self):
+        """The obs-audit contract: every taxonomy bucket has a mapping
+        row above (and `make obs-audit` greps this file for the names)."""
+        covered = {b for _, _, b in self.CASES}
+        missing = set(PHASES) - covered
+        assert not missing, f"buckets without a mapping row: {missing}"
+        assert covered <= set(PHASES)
+
+    def test_unknown_span_inherits_mapped_ancestor(self):
+        tr, led = _ledger_tracer()
+        with tr.trace("engine.tick"):
+            with tr.span("provision.launch"):
+                with tr.span("totally.unmapped.child"):
+                    time.sleep(0.002)
+        phases = led.snapshot()["phases"]["default"]["reconcile"]
+        assert phases["launch"]["ms"] >= 2.0
+
+    def test_device_phase_set_is_consistent(self):
+        assert DEVICE_PHASES <= set(PHASES)
+        assert "solve_host" not in DEVICE_PHASES
+
+    def test_unrecognized_roots_are_not_ledger_material(self):
+        tr, led = _ledger_tracer()
+        with tr.trace("my-adhoc-trace"):
+            with tr.span("whatever"):
+                pass
+        assert led.traces == 0
+
+
+class TestCoverageInvariant:
+    def test_unattributed_gap_metered_and_flight_recorded(self, ring):
+        """An un-spanned gap at the root: coverage drops below the
+        target, unattributed_ms is metered, and a profile.unattributed
+        marker lands in the flight-recorder ring pointing at the
+        source trace."""
+        tr, led = _ledger_tracer()
+        with tr.trace("engine.tick"):
+            with tr.span("provision.batch"):
+                pass
+            time.sleep(0.02)  # un-spanned root self-time
+        assert led.coverage() < 0.99
+        assert led.unattributed_ms() >= 15.0
+        markers = [t for t in ring.slowest()
+                   if t.root.name == "profile.unattributed"]
+        assert markers, "gap must be flight-recorded"
+        attrs = markers[0].root.attrs
+        assert attrs["coverage"] < 0.99 and attrs["gap_ms"] >= 15.0
+        assert attrs["source_trace"]
+
+    def test_fully_spanned_trace_meets_target(self, ring):
+        tr, led = _ledger_tracer()
+        with tr.trace("engine.tick"):
+            with tr.span("provision.batch"):
+                time.sleep(0.01)
+        assert led.coverage() >= 0.99
+        assert not [t for t in ring.slowest()
+                    if t.root.name == "profile.unattributed"]
+
+    def test_queue_wait_virtual_aggregation(self):
+        tr, led = _ledger_tracer()
+        with tr.trace("fleet.dispatch", tenant="a", wait_ms=7.5):
+            with tr.span("solve.run", backend="host"):
+                pass
+        snap = led.snapshot()
+        assert snap["virtual_queue_wait_ms"]["default"] == 7.5
+
+    def test_signature_class_aggregation(self):
+        tr, led = _ledger_tracer()
+        with tr.trace("solve.device"):
+            with tr.span("solve.prep", groups_padded=8, n_max=128):
+                pass
+            with tr.span("solve.dispatch"):
+                pass
+        sigs = led.snapshot()["signatures"]["default"]
+        assert "g8/n128" in sigs and sigs["g8/n128"]["count"] == 1
+
+    def test_report_formats(self):
+        tr, led = _ledger_tracer()
+        with tr.trace("engine.tick"):
+            with tr.span("solve.device_put", h2d_bytes=64):
+                pass
+            with tr.span("solve.decode"):
+                pass
+        text = format_report(led.snapshot())
+        assert "host total" in text and "device total" in text
+        assert "device_put" in text and "coverage" in text
+
+    def test_live_sim_tick_attributes(self, ring):
+        """End to end on the real engine: a traced busy tick lands in
+        the GLOBAL ledger with high coverage and the expected buckets."""
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.sim import make_sim
+        saved = (TRACER.enabled, TRACER.clock)
+        LEDGER.reset()
+        try:
+            sim = make_sim()
+            for i in range(4):
+                sim.store.add_pod(Pod(name=f"obs-{i}",
+                                      requests=Resources.parse(
+                                          {"cpu": "500m",
+                                           "memory": "1Gi"})))
+            TRACER.configure(enabled=True, clock=sim.clock.now)
+            sim.engine.tick()
+        finally:
+            TRACER.enabled, TRACER.clock = saved
+        snap = LEDGER.snapshot()
+        assert LEDGER.traces >= 1 and LEDGER.errors == 0
+        phases = snap["phases"]["default"]["reconcile"]
+        for expected in ("hooks", "batch", "encode_cold", "solve_host",
+                         "launch"):
+            assert expected in phases, sorted(phases)
+        # the coverage invariant on the real path: nearly everything a
+        # busy tick does happens under an instrumented seam
+        assert LEDGER.coverage() >= 0.8, snap["coverage"]
+        LEDGER.reset()
+
+
+class TestSloEngine:
+    def _engine(self, objective=0.9, fast=10.0, slow=60.0):
+        from karpenter_tpu.obs.slo import SloEngine, SloSpec
+        state = {"good": 0.0, "total": 0.0}
+        spec = SloSpec("probe", objective,
+                       lambda tenant: (state["good"], state["total"]),
+                       "synthetic")
+        clk = FakeClock()
+        eng = SloEngine(clk, slos=[spec], tenants=("a",),
+                        fast_window=fast, slow_window=slow)
+        return eng, clk, state
+
+    def test_healthy_tenant_keeps_budget_no_alerts(self):
+        eng, clk, state = self._engine()
+        for _ in range(20):
+            state["good"] += 5
+            state["total"] += 5
+            eng.tick()
+            clk.step(1.0)
+        assert eng.alerts == []
+        assert eng.budgets()["a"]["probe"] == 1.0
+
+    def test_burn_fires_edge_triggered_alert_and_flight_records(self, ring):
+        from karpenter_tpu.metrics import SLO_BURN_ALERTS, SLO_ERROR_BUDGET
+        eng, clk, state = self._engine()
+        base_alerts = SLO_BURN_ALERTS.value(slo="probe", tenant="a")
+        # healthy warmup
+        for _ in range(5):
+            state["good"] += 5
+            state["total"] += 5
+            eng.tick()
+            clk.step(1.0)
+        # hard burn: every event bad
+        fired_total = 0
+        for _ in range(5):
+            state["total"] += 10
+            fired_total += len(eng.tick())
+            clk.step(1.0)
+        assert fired_total == 1, "alert must be edge-triggered, not per-tick"
+        assert len(eng.alerts) == 1
+        a = eng.alerts[0]
+        assert a["slo"] == "probe" and a["tenant"] == "a"
+        assert a["burn_fast"] >= eng.fast_burn
+        assert SLO_BURN_ALERTS.value(slo="probe", tenant="a") == \
+            base_alerts + 1
+        # budget overdrawn and the gauge agrees
+        assert eng.budgets()["a"]["probe"] < 0
+        assert SLO_ERROR_BUDGET.value(slo="probe", tenant="a") < 0
+        # evidence in the trace ring
+        burns = [t for t in ring.slowest() if t.root.name == "slo.burn"]
+        assert burns and burns[0].root.attrs["tenant"] == "a"
+        # recovery re-arms: long healthy stretch, then burn again
+        for _ in range(30):
+            state["good"] += 20
+            state["total"] += 20
+            eng.tick()
+            clk.step(1.0)
+        for _ in range(5):
+            state["total"] += 100
+            eng.tick()
+            clk.step(1.0)
+        assert len(eng.alerts) == 2
+
+    def test_budget_baseline_ignores_prior_process_history(self):
+        """The registry is process-cumulative; budgets must be per-run
+        (baselined at engine construction)."""
+        from karpenter_tpu.obs.slo import SloEngine, SloSpec
+        state = {"good": 50.0, "total": 100.0}  # ugly history pre-run
+        spec = SloSpec("probe", 0.9,
+                       lambda tenant: (state["good"], state["total"]))
+        clk = FakeClock()
+        eng = SloEngine(clk, slos=[spec], tenants=("a",))
+        state["good"] += 10
+        state["total"] += 10
+        eng.tick()
+        assert eng.budgets()["a"]["probe"] == 1.0
+
+    def test_default_slos_read_registry_families(self):
+        from karpenter_tpu.metrics import FLEET_SOLVES, FLEET_THROTTLED
+        from karpenter_tpu.obs.slo import default_slos
+        slos = {s.name: s for s in default_slos()}
+        assert {"solve_latency", "solve_availability", "warm_hit_rate",
+                "audit_divergence"} <= set(slos)
+        FLEET_SOLVES.inc(tenant="slo-probe")
+        FLEET_THROTTLED.inc(tenant="slo-probe")
+        good, total = slos["solve_availability"].indicator("slo-probe")
+        assert (good, total) == (1.0, 2.0)
+
+    def test_debug_slo_route(self):
+        from karpenter_tpu.obs.exposition import render
+        eng, clk, state = self._engine()
+        status, ctype, body = render("/debug/slo")
+        assert status == 200 and "json" in ctype
+        doc = json.loads(body)
+        assert doc["budgets"]["a"]["probe"] == 1.0
+        assert doc["slos"][0]["name"] == "probe"
+        # dead engine -> inactive (the uniform debug-route contract)
+        import gc
+        del eng
+        gc.collect()
+        assert json.loads(render("/debug/slo")[2]) == {"inactive": True}
+
+
+class TestExplain:
+    def _solver(self):
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        from karpenter_tpu.ops.facade import Solver
+        return Solver(CatalogProvider(lambda: small_catalog()),
+                      backend="host")
+
+    def _pods(self, n=4, cpu="500m", mem="1Gi", prefix="xp"):
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        return [Pod(name=f"{prefix}-{i}", requests=Resources.parse(
+            {"cpu": cpu, "memory": mem})) for i in range(n)]
+
+    def test_placed_pod_has_funnel_chosen_and_runner_up(self):
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.obs.explain import RECORDER, FUNNEL_STAGES
+        RECORDER.reset()
+        solver = self._solver()
+        out = solver.solve(self._pods(4), NodePool(name="default"))
+        assert out.launches
+        rec = RECORDER.explain("default/xp-0")
+        assert rec is not None and rec["outcome"] == "placed_new_node"
+        assert rec["chosen"]["instance_type"] == \
+            out.launches[0].instance_type
+        stages = [s["stage"] for s in rec["funnel"]]
+        assert stages == list(FUNNEL_STAGES)
+        # counts only narrow down the funnel
+        offs = [s["offerings"] for s in rec["funnel"][:-1]]
+        assert offs == sorted(offs, reverse=True)
+        assert rec["funnel"][0]["types"] > 0
+        assert rec["binding_constraint"]
+        if rec["runner_up"] is not None:
+            # the runner-up is a different offering (it may be CHEAPER
+            # per hour — the solver commits the cost-per-SLOT argmin)
+            assert (rec["runner_up"]["instance_type"],
+                    rec["runner_up"]["zone"],
+                    rec["runner_up"]["capacity_type"]) != (
+                rec["chosen"]["instance_type"], rec["chosen"]["zone"],
+                rec["chosen"]["capacity_type"])
+
+    def test_unschedulable_pod_binds_at_eliminating_stage(self):
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.obs.explain import RECORDER
+        RECORDER.reset()
+        solver = self._solver()
+        giant = self._pods(1, cpu="4000", mem="99999Gi", prefix="giant")
+        out = solver.solve(giant, NodePool(name="default"))
+        assert out.unschedulable == ["default/giant-0"]
+        rec = RECORDER.explain("default/giant-0")
+        assert rec["outcome"] == "unschedulable"
+        assert rec["binding_constraint"] == "resource_fit"
+        assert rec["funnel"][-1]["offerings"] == 0
+
+    def test_throttle_trail_survives_later_placement(self):
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.obs.explain import RECORDER
+        RECORDER.reset()
+        solver = self._solver()
+        pods = self._pods(2, prefix="thr")
+        RECORDER.note_throttle("default",
+                               [f"default/{p.name}" for p in pods])
+        rec = RECORDER.explain("default/thr-0")
+        assert rec["outcome"] == "throttled"
+        assert rec["binding_constraint"] == "fleet_inflight_cap"
+        assert rec["throttles"] == 1
+        solver.solve(pods, NodePool(name="default"))
+        rec = RECORDER.explain("default/thr-0")
+        assert rec["outcome"] == "placed_new_node"
+        assert rec["throttles"] == 1  # the trail survives placement
+
+    def test_fleet_client_notes_throttles(self):
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        from karpenter_tpu.fleet.service import (SolverService,
+                                                 SolverServiceBusy)
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.obs.explain import RECORDER
+        RECORDER.reset()
+        svc = SolverService(FakeClock(), inflight_cap=1)
+        client = svc.register("busy", CatalogProvider(
+            lambda: small_catalog()))
+        pool = NodePool(name="default")
+        client.solve(self._pods(2, prefix="ok"), pool)
+        with pytest.raises(SolverServiceBusy):
+            client.solve(self._pods(2, prefix="nope"), pool)
+        rec = RECORDER.explain("default/nope-0", tenant="busy")
+        assert rec["outcome"] == "throttled"
+        assert RECORDER.tenant_pods("busy", outcome="throttled")
+
+    def test_oversize_solves_are_skipped(self):
+        from karpenter_tpu.obs.explain import RECORDER
+        saved = RECORDER.MAX_PODS_PER_SOLVE
+        RECORDER.reset()
+        try:
+            RECORDER.MAX_PODS_PER_SOLVE = 2
+            from karpenter_tpu.models.nodepool import NodePool
+            solver = self._solver()
+            solver.solve(self._pods(5, prefix="big"), NodePool(
+                name="default"))
+            assert RECORDER.stats["skipped"] == 1
+            assert RECORDER.explain("default/big-0") is None
+        finally:
+            RECORDER.MAX_PODS_PER_SOLVE = saved
+
+    def test_debug_explain_route(self):
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.obs.explain import RECORDER
+        from karpenter_tpu.obs.exposition import render
+        RECORDER.reset()
+        solver = self._solver()
+        solver.solve(self._pods(2, prefix="rt"), NodePool(name="default"))
+        _, _, body = render("/debug/explain?pod=default/rt-0")
+        doc = json.loads(body)
+        assert doc["found"] and doc["outcome"] == "placed_new_node"
+        _, _, body = render("/debug/explain?pod=default/ghost")
+        assert json.loads(body) == {"found": False, "pod": "default/ghost"}
+        _, _, body = render("/debug/explain")
+        assert "stages" in json.loads(body)
+
+
+class TestFleetObservatory:
+    def test_debug_fleet_carries_encode_cache_panel(self):
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        from karpenter_tpu.fleet.service import SolverService
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        svc = SolverService(FakeClock())
+        client = svc.register("enc", CatalogProvider(
+            lambda: small_catalog()))
+        pods = [Pod(name=f"ec-{i}", requests=Resources.parse(
+            {"cpu": "500m", "memory": "1Gi"})) for i in range(2)]
+        client.solve(pods, NodePool(name="default"))
+        panel = svc.snapshot()["enc"]["encode_cache"]
+        assert {"hit_rate", "resident_rows", "contexts",
+                "stats"} <= set(panel)
+        assert panel["resident_rows"] >= 1
+
+    def test_traced_dispatch_attributes_to_ticket_tenant(self, ring):
+        """A direct client solve (no outer scope, tracing on) roots at
+        fleet.dispatch — the ledger sink fires on root exit and must
+        still see the ticket's tenant scope (regression: scope exiting
+        before the span attributed everything to 'default')."""
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        from karpenter_tpu.fleet.service import SolverService
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        LEDGER.reset()
+        saved = TRACER.enabled
+        try:
+            svc = SolverService(FakeClock())
+            client = svc.register("tnt", CatalogProvider(
+                lambda: small_catalog()))
+            TRACER.enabled = True
+            client.solve([Pod(name="tp-0", requests=Resources.parse(
+                {"cpu": "500m", "memory": "1Gi"}))], NodePool(
+                name="default"))
+        finally:
+            TRACER.enabled = saved
+        phases = LEDGER.snapshot()["phases"]
+        assert "tnt" in phases, sorted(phases)
+        assert "default" not in phases
+        LEDGER.reset()
+
+    def test_fleet_run_carries_slo_and_determinism_holds(self):
+        """A small fleet run with the observatory on: budgets/alerts in
+        the report, and the repeat contract (per-tenant end-state hashes
+        + fault fingerprints) unchanged across identical seeds."""
+        from karpenter_tpu.fleet.runner import FleetRunner
+        reports = [FleetRunner("fleet_smoke", tenants=3, seed=11).run()
+                   for _ in range(2)]
+        for rep in reports:
+            assert rep.ok, rep.summary()
+            assert set(rep.slo["budgets"]) == {"t000", "t001", "t002"}
+            assert "slo_alerts" in rep.stats
+            for t, budgets in rep.slo["budgets"].items():
+                # a quiet smoke fleet must not burn availability budget
+                assert budgets["solve_availability"] == 1.0
+        assert reports[0].fleet_hash == reports[1].fleet_hash
+        assert reports[0].fleet_fingerprint == reports[1].fleet_fingerprint

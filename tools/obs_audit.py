@@ -1,0 +1,65 @@
+"""Observability drift audit — `make obs-audit`.
+
+Two invariants that otherwise rot silently:
+
+1. every metric family registered at import time appears in
+   docs/reference/metrics.md (the generated page a new family is easy
+   to forget to regenerate — `make docgen` fixes a failure);
+2. every phase bucket in the ledger taxonomy (obs/profile.PHASES) is
+   exercised by the canonical mapping tests — the grep is restricted to
+   tests/test_observatory.py on purpose: common-word buckets ("launch",
+   "commit", "dispatch"...) appear all over tests/ for unrelated
+   reasons, and a repo-wide grep would keep this check green after the
+   actual bucket tests were deleted.
+
+Exit 0 = no drift. Wired into the default verify path (`make test`
+depends on this).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def audit() -> int:
+    from karpenter_tpu import metrics as M
+    from karpenter_tpu.obs.profile import PHASES
+
+    failures = []
+
+    metrics_md = os.path.join(ROOT, "docs", "reference", "metrics.md")
+    doc = open(metrics_md).read() if os.path.exists(metrics_md) else ""
+    for m in M.REGISTRY._metrics:
+        if f"`{m.name}`" not in doc:
+            failures.append(
+                f"metric family `{m.name}` is registered but missing from "
+                f"docs/reference/metrics.md — run `make docgen`")
+
+    canon = os.path.join(ROOT, "tests", "test_observatory.py")
+    tests = open(canon).read() if os.path.exists(canon) else ""
+    if not tests:
+        failures.append("tests/test_observatory.py (the canonical ledger "
+                        "bucket tests) is missing")
+    for phase in PHASES:
+        if f'"{phase}"' not in tests and f"'{phase}'" not in tests:
+            failures.append(
+                f"ledger phase bucket '{phase}' is in the taxonomy but "
+                f"tests/test_observatory.py does not exercise it")
+
+    if failures:
+        print("obs-audit: DRIFT DETECTED")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"obs-audit: ok ({len(M.REGISTRY._metrics)} metric families "
+          f"documented, {len(PHASES)} phase buckets test-covered)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(audit())

@@ -1,0 +1,66 @@
+"""Print the phase-attribution table from a profile_bench.json.
+
+`make profile-report` — the 60-second answer to "where does the 100ms
+go": per tenant, every ledger phase with its host/device side, share of
+the enclosing wall, byte volume, and the per-signature solve rollup —
+the table ROADMAP items 2-3 (solve batching, device-resident state)
+will be judged against. Reads the artifact bench.py writes
+(`$KARPENTER_TPU_TRACE_DIR/profile_bench.json` or a path argument).
+
+Usage:
+    python tools/profile_report.py [path]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from karpenter_tpu.obs.profile import format_report  # noqa: E402
+
+
+def report(path: str) -> str:
+    with open(path) as f:
+        doc = json.load(f)
+    out = [f"profile report: {path}"]
+    prov = doc.get("provenance", {})
+    if prov:
+        out.append(f"backend={prov.get('backend')} "
+                   f"device={prov.get('device_kind')} "
+                   f"x{prov.get('device_count')} "
+                   f"platform={prov.get('platform')}")
+        if not prov.get("comparable", True) or prov.get("cpu_fallback"):
+            out.append("*** CPU-FALLBACK RUN — no tunnel RTT, no real "
+                       "kernel: NOT comparable to TPU baselines ***")
+    cov = doc.get("coverage")
+    if cov is not None:
+        flag = "" if cov >= 0.99 else "  (BELOW the 0.99 invariant)"
+        out.append(f"attribution coverage={cov:.4f} "
+                   f"unattributed={doc.get('unattributed_ms', 0):.3f}ms"
+                   f"{flag}")
+    out.append("")
+    out.append(format_report(doc.get("snapshot", doc)))
+    return "\n".join(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    default = os.path.join(
+        os.environ.get("KARPENTER_TPU_TRACE_DIR", "."),
+        "profile_bench.json")
+    ap.add_argument("path", nargs="?", default=default)
+    args = ap.parse_args()
+    if not os.path.exists(args.path):
+        print(f"no profile artifact at {args.path} — run `make benchmark` "
+              "(writes profile_bench.json) or pass a path",
+              file=sys.stderr)
+        raise SystemExit(1)
+    print(report(args.path))
+
+
+if __name__ == "__main__":
+    main()

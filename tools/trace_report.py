@@ -19,8 +19,8 @@ import sys
 
 
 def load_spans(path: str):
-    """Yield (name, duration_seconds, trace_id) from a tracer JSONL or a
-    Chrome trace-event artifact."""
+    """Yield (name, duration_seconds, trace_id, attrs) from a tracer
+    JSONL or a Chrome trace-event artifact."""
     with open(path) as f:
         first = f.readline()
         f.seek(0)
@@ -35,8 +35,9 @@ def load_spans(path: str):
         if not is_jsonl:  # Chrome artifact: {"traceEvents": [...]}
             for ev in json.load(f).get("traceEvents", []):
                 if ev.get("ph") == "X":
+                    args = ev.get("args", {})
                     yield (ev["name"], ev.get("dur", 0.0) / 1e6,
-                           ev.get("args", {}).get("trace_id", ""))
+                           args.get("trace_id", ""), args)
             return
         for line in f:
             line = line.strip()
@@ -44,22 +45,34 @@ def load_spans(path: str):
                 continue
             trace = json.loads(line)
             for s in trace.get("spans", []):
-                yield s["name"], s.get("duration", 0.0), trace["trace_id"]
+                yield (s["name"], s.get("duration", 0.0),
+                       trace["trace_id"], s.get("attrs", {}))
 
 
 def report(path: str, top: int = 20) -> str:
     agg = {}  # name -> [count, total, max, slowest trace_id]
-    for name, dur, tid in load_spans(path):
+    platforms = set()
+    for name, dur, tid, attrs in load_spans(path):
         row = agg.setdefault(name, [0, 0.0, 0.0, ""])
         row[0] += 1
         row[1] += dur
         if dur > row[2]:
             row[2], row[3] = dur, tid
+        p = attrs.get("platform")
+        if p:
+            platforms.add(p)
     if not agg:
         return f"no spans in {path}"
-    out = [f"trace report: {path}",
-           f"{'span':<28} {'count':>6} {'total_s':>9} {'max_s':>9}  slowest trace",
-           "-" * 76]
+    out = [f"trace report: {path}"]
+    # bench roots stamp their platform label: a CPU-fallback trace has
+    # no tunnel RTT and no real kernel, so its numbers must never be
+    # read against TPU baselines (ROADMAP: r05 was silently fallback)
+    bad = platforms - {"accelerator"}
+    if bad:
+        out.append(f"*** platform={'/'.join(sorted(bad))}: CPU-FALLBACK "
+                   "RUN — timings NOT comparable to TPU baselines ***")
+    out += [f"{'span':<28} {'count':>6} {'total_s':>9} {'max_s':>9}  slowest trace",
+            "-" * 76]
     ranked = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
     for name, (count, total, mx, tid) in ranked:
         out.append(f"{name:<28} {count:>6} {total:>9.3f} {mx:>9.3f}  {tid}")
