@@ -5,13 +5,14 @@ PY ?= python
 
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
         deflake run native trace-report profile-report obs-audit chaos \
-        crash-audit warmpath-audit encode-report fleet fleet-audit clean
+        crash-audit warmpath-audit encode-report fleet fleet-audit \
+        perf-gate clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
 
-test: obs-audit  ## full suite on the 8-device virtual CPU mesh (tests/conftest.py)
-	$(PY) -m pytest tests/ -q
+test: obs-audit perf-gate  ## full suite + verification plane (obs drift audit, perf regression gate, slowest-test report)
+	$(PY) -m pytest tests/ -q --durations=15
 
 e2etests:  ## the e2e slices (sim + subprocess remote cloud)
 	$(PY) -m pytest tests/test_e2e_slice.py tests/test_remote_cloud.py -q
@@ -28,8 +29,11 @@ trace-report:  ## slowest spans from $$KARPENTER_TPU_TRACE_DIR/traces.jsonl (or 
 profile-report:  ## the "where does the 100ms go" phase table from profile_bench.json (or PROFILE=path)
 	$(PY) tools/profile_report.py $(PROFILE)
 
-obs-audit:  ## drift check: every metric family documented, every ledger phase bucket test-covered
+obs-audit:  ## drift check: metric families documented, ledger phase buckets + watchdog invariants test-covered
 	$(PY) tools/obs_audit.py
+
+perf-gate:  ## cross-run perf regression gate over the bench artifact archive (obs/perfarchive.py)
+	$(PY) tools/perf_gate.py
 
 chaos:  ## chaos scenario catalog (incl. slow soaks + restart scenarios) + seed-reproducibility check
 	$(PY) -m pytest tests/test_faults.py tests/test_chaos.py tests/test_restart.py -q

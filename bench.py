@@ -121,6 +121,26 @@ def _pin_cpu_if_unreachable() -> str:
     return _PLATFORM
 
 
+def run_stamp(prov: dict) -> dict:
+    """The uniform artifact stamp (ISSUE 8 satellite): every artifact
+    family this run writes — the result JSON, profile_bench.json,
+    trace_bench.json — carries the SAME schema_version/run_id/seed/
+    provenance block, so the perf archive can key the three artifacts
+    of one run together and auto-exclude CPU-fallback runs from
+    baselines. `seed` is 0 by definition: every bench workload is
+    generated deterministically (formulaic shapes, no RNG) — the field
+    exists so seeded artifact producers (chaos runners, future
+    trace-driven workloads) share one stamp schema, not because this
+    bench is steerable."""
+    import uuid
+    from karpenter_tpu.obs.perfarchive import SCHEMA_VERSION
+    return {"schema_version": SCHEMA_VERSION,
+            "run_id": uuid.uuid4().hex[:12],
+            "seed": 0,
+            "provenance": prov,
+            "comparable": bool(prov.get("comparable"))}
+
+
 def main() -> None:
     platform = _pin_cpu_if_unreachable()
     import os
@@ -134,6 +154,17 @@ def main() -> None:
     from karpenter_tpu.ops.solver import solve_device
 
     detail = {}
+
+    # one run stamp, minted first and written into EVERY artifact this
+    # run produces (result JSON, profile_bench.json, trace_bench.json):
+    # the archive keys the three families to one run_id
+    from karpenter_tpu.ops.solver import provenance
+    prov = provenance()
+    prov["platform"] = platform
+    prov["comparable"] = platform == "accelerator"
+    stamp = run_stamp(prov)
+    progress(f"run_id={stamp['run_id']} platform={platform} "
+             f"comparable={stamp['comparable']}")
 
     # bench manages its own trace windows (cold c2 + warm c7): the
     # KARPENTER_TPU_TRACE_DIR auto-enable would otherwise trace every
@@ -399,7 +430,8 @@ def main() -> None:
     trace_dir = os.environ.get("KARPENTER_TPU_TRACE_DIR") or "."
     os.makedirs(trace_dir, exist_ok=True)
     artifact = os.path.join(trace_dir, "trace_bench.json")
-    write_chrome_trace(TRACER.recorder.slowest(), artifact)
+    write_chrome_trace(TRACER.recorder.slowest(), artifact,
+                       metadata=stamp)
     warm = next(t for t in TRACER.recorder.slowest()
                 if t.root.name == "bench.solve")
     dev = next(s for s in warm.spans if s.name == "solve.device")
@@ -677,10 +709,6 @@ def main() -> None:
     # traced windows above fed the ledger (c7 solve, c8 warm+cold
     # reconciles, c12 per-tenant fleet round), with backend provenance
     # so a CPU-fallback run can never read as a comparable TPU number.
-    from karpenter_tpu.ops.solver import provenance
-    prov = provenance()
-    prov["platform"] = platform
-    prov["comparable"] = platform == "accelerator"
     if not prov["comparable"]:
         progress(f"NON-COMPARABLE RUN: platform={platform} backend="
                  f"{prov.get('backend')} — numbers must not be compared "
@@ -692,7 +720,7 @@ def main() -> None:
     detail["profile_traces"] = LEDGER.traces
     profile_path = os.path.join(trace_dir, "profile_bench.json")
     with open(profile_path, "w") as f:
-        json.dump({"provenance": prov,
+        json.dump({**stamp,
                    "coverage": round(profile_cover, 4),
                    "unattributed_ms": round(LEDGER.unattributed_ms(), 3),
                    "snapshot": snap}, f, indent=1)
@@ -712,11 +740,21 @@ def main() -> None:
         "value": round(tpu_s * 1e3, 1),
         "unit": "ms",
         "vs_baseline": round(host_s / tpu_s, 2),
-        "provenance": prov,
-        "comparable": prov["comparable"],
+        **stamp,
         "detail": detail,
     }
     print(json.dumps(result))
+    # the archive ride-along: every bench run appends its stamped
+    # result to perf_archive.jsonl so `make perf-gate` has a candidate
+    # and a growing baseline — best-effort, the JSON line above is the
+    # contract and must survive an unwritable archive
+    try:
+        from karpenter_tpu.obs.perfarchive import PerfArchive
+        archive = PerfArchive.default()
+        archive.append(archive.ingest_bench_result(result))
+        progress(f"archived run {stamp['run_id']} -> {archive.path}")
+    except Exception as e:  # noqa: BLE001
+        progress(f"perf archive append failed (non-fatal): {e!r}")
 
 
 if __name__ == "__main__":

@@ -102,10 +102,34 @@ def main() -> None:
             base_screen = s
         assert (s == base_screen).all(), f"{nd}-device screen diverged"
 
-    print(json.dumps({
+    # the uniform run stamp (shared with bench.py's artifact families):
+    # mesh runs always execute on the virtual CPU mesh by design, so
+    # they are comparable WITHIN the mesh family — the archive keys
+    # families separately and never mixes mesh numbers into bench
+    # baselines
+    import uuid
+
+    from karpenter_tpu.ops.solver import provenance
+    from karpenter_tpu.obs.perfarchive import SCHEMA_VERSION, PerfArchive
+    prov = provenance()
+    prov["platform"] = "cpu-mesh"
+    prov["comparable"] = True
+    stamp = {"schema_version": SCHEMA_VERSION,
+             "run_id": uuid.uuid4().hex[:12],
+             "seed": 0,  # deterministic workload, no RNG (see bench.py)
+             "provenance": prov, "comparable": True}
+    result = {
         "metric": "mesh scaling: 100k-pod solve + 5k-node screen, 1-8 virtual devices",
         "value": detail["solve_100k_8dev_ms"], "unit": "ms",
-        "detail": detail}))
+        **stamp,
+        "detail": detail}
+    print(json.dumps(result))
+    try:
+        archive = PerfArchive.default()
+        archive.append(archive.ingest_bench_result(
+            result, family="mesh", source="bench_mesh.py"))
+    except Exception:  # noqa: BLE001 — the JSON line is the contract
+        pass
 
 
 if __name__ == "__main__":

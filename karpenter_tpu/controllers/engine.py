@@ -35,6 +35,11 @@ class Engine:
     # while this replica holds the lease (hooks still run — they model the
     # environment, not the controller plane)
     elector: Optional[object] = None
+    # optional obs.watchdog.Watchdog: ticked OUTSIDE the traced window
+    # (it observes the control plane, it is not part of the reconcile
+    # cost the phase ledger decomposes) and on every tick including
+    # non-leader ones — invariants hold whether or not we lead
+    watchdog: Optional[object] = None
     _next_run: Dict[str, float] = field(default_factory=dict)
 
     def add(self, *controllers: Controller) -> "Engine":
@@ -67,6 +72,15 @@ class Engine:
                             for c in self.controllers))
         tick_sp = (TRACER.trace("engine.tick", sim_now=now)
                    if trace_on else NOOP_SPAN)
+        try:
+            self._tick_body(now, trace_on, tick_sp)
+        finally:
+            # the watchdog evaluates even when a controller pass raised —
+            # a crashing reconcile is exactly when invariants need eyes
+            if self.watchdog is not None:
+                self.watchdog.tick(now)
+
+    def _tick_body(self, now: float, trace_on: bool, tick_sp) -> None:
         with tick_sp:
             hooks_sp = (TRACER.span("engine.hooks", hooks=len(self.hooks))
                         if trace_on and self.hooks else NOOP_SPAN)

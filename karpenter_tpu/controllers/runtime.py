@@ -185,7 +185,8 @@ class Runtime:
                         accept = h.split(b":", 1)[1].strip().decode(
                             "latin-1", "replace")
                 status, ctype, body = render(path, accept=accept)
-                reason = {200: "OK", 404: "Not Found"}.get(status, "OK")
+                reason = {200: "OK", 404: "Not Found",
+                          503: "Service Unavailable"}.get(status, "OK")
                 writer.write((f"HTTP/1.1 {status} {reason}\r\n"
                               f"Content-Type: {ctype}\r\n"
                               f"Content-Length: {len(body)}\r\n\r\n"

@@ -185,6 +185,31 @@ def restart_invariants(sim) -> List[str]:
     return v
 
 
+def _watchdog_cross_check(sim, violations: List[str]) -> None:
+    """The end-of-run asserts, reframed: with the online watchdog armed
+    (make_sim default) the runner's job is no longer to DISCOVER a
+    violation but to confirm the watchdog found it first. A final forced
+    evaluation closes the race between the last engine tick and the
+    judgment; any mapped violation the watchdog never fired on is
+    appended as a blind-spot violation of its own. Mutates `violations`
+    in place and stamps the watchdog's finding counts for the report."""
+    wd = getattr(sim, "watchdog", None)
+    if wd is None or not wd.armed:
+        return
+    wd.tick(sim.clock.now(), force=True)
+    violations.extend(wd.cross_check(violations))
+
+
+def _watchdog_stats(sim) -> Dict[str, float]:
+    wd = getattr(sim, "watchdog", None)
+    if wd is None:
+        return {}
+    return {"watchdog_findings": float(wd.stats["findings"]),
+            "watchdog_findings_warning": float(
+                wd.findings_at_least("warning")),
+            "watchdog_evals": float(wd.stats["evals"])}
+
+
 class ScenarioRunner:
     """Run one named scenario (faults/scenarios.py) at a seed."""
 
@@ -248,6 +273,7 @@ class ScenarioRunner:
                  "ice_marks": sim.catalog.unavailable.stats["marks"],
                  "provisioner_ice_errors":
                  sim.provisioner.stats["ice_errors"]}
+        stats.update(_watchdog_stats(sim))
         if sim.warmpath is not None:
             wp = sim.warmpath
             stats.update({
@@ -262,6 +288,7 @@ class ScenarioRunner:
                 violations.append(
                     f"warm-path auditor diverged "
                     f"{wp.stats['divergences']} time(s)")
+        _watchdog_cross_check(sim, violations)
         report = ScenarioReport(
             scenario=sc.name, seed=self.seed, converged=converged,
             violations=violations, end_hash=state_hash(sim),
@@ -405,6 +432,7 @@ class RestartRunner:
                 sim.gc.stats.get("inflight_skipped", 0)),
             "ice_marks": sim.catalog.unavailable.stats["marks"],
         }
+        stats.update(_watchdog_stats(sim))
         if sim.warmpath is not None:
             stats["warm_divergences"] = float(
                 sim.warmpath.stats["divergences"])
@@ -413,6 +441,12 @@ class RestartRunner:
                     f"warm-path auditor diverged "
                     f"{sim.warmpath.stats['divergences']} time(s) "
                     f"post-restart")
+        # only the FINAL boot's watchdog survives — findings from
+        # pre-crash stacks died with their process, so the cross-check
+        # leans on the forced final evaluation (persisting conditions —
+        # leaks, duplicate tokens, open intents — are all re-detectable
+        # from the surviving durable state)
+        _watchdog_cross_check(sim, violations)
         report = ScenarioReport(
             scenario=sc.name, seed=self.seed, converged=converged,
             violations=violations, end_hash=state_hash(sim),

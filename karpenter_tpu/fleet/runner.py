@@ -106,6 +106,10 @@ class FleetRunner:
         self.service: Optional[SolverService] = None
         self.shards: List[TenantShard] = []
         self.slo = None  # obs.slo.SloEngine, built in run()
+        # fleet-level obs.watchdog.Watchdog over the SHARED service
+        # (starvation/backlog); each shard's make_sim stack arms its own
+        # per-tenant watchdog for the cluster-state invariants
+        self.watchdog = None
         self.origin = 0.0
 
     def build(self) -> None:
@@ -148,6 +152,13 @@ class FleetRunner:
         # verdict — reset like the SLO engine baselines
         from ..obs.explain import RECORDER
         RECORDER.reset()
+        # the fleet face of the verification plane: one watchdog over
+        # the SHARED service (starvation/backlog are fleet properties,
+        # not any shard's) alongside the per-shard watchdogs each
+        # make_sim stack already armed
+        from ..obs.watchdog import Watchdog
+        self.watchdog = Watchdog(clock, service=self.service).arm(
+            clock.now())
         deadline = clock.now() + sc.timeout
         plans = {s.name: s.plan for s in self.shards if s.plan is not None}
         converged = False
@@ -156,18 +167,32 @@ class FleetRunner:
                 for shard in self.shards:
                     shard.tick()
                 self.slo.tick()
+                self.watchdog.tick()
                 if all(s.quiet() for s in self.shards):
                     converged = True
                     break
                 clock.step(sc.step)
         self.slo.tick(force=True)  # final evaluation at the end state
+        self.watchdog.tick(force=True)
 
         violations: List[str] = []
         hashes: Dict[str, str] = {}
         fingerprints: Dict[str, str] = {}
         warm_div = 0.0
+        fleet_findings = float(self.watchdog.stats["findings"])
         for shard in self.shards:
-            for v in check_invariants(shard.sim):
+            shard_v = check_invariants(shard.sim)
+            # per-shard found-it-first cross-check under the shard's
+            # tenant scope (findings metered at the final evaluation
+            # land on the tenant's series, like every other sample)
+            wd = getattr(shard.sim, "watchdog", None)
+            if wd is not None and wd.armed:
+                from ..metrics.tenant import tenant_scope
+                with tenant_scope(shard.name):
+                    wd.tick(shard.sim.clock.now(), force=True)
+                shard_v.extend(wd.cross_check(shard_v))
+                fleet_findings += float(wd.stats["findings"])
+            for v in shard_v:
                 violations.append(f"[{shard.name}] {v}")
             hashes[shard.name] = state_hash(shard.sim)
             fingerprints[shard.name] = (shard.plan.fingerprint()
@@ -197,6 +222,7 @@ class FleetRunner:
         if warm_div:
             stats["warm_divergences"] = warm_div
         stats["slo_alerts"] = float(len(self.slo.alerts))
+        stats["watchdog_findings"] = fleet_findings
         report = FleetReport(
             scenario=sc.name, seed=self.seed, tenants=self.tenants,
             converged=converged, violations=violations,

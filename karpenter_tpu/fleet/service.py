@@ -351,6 +351,13 @@ class SolverService:
             FLEET_STARVATION.set(0.0, tenant=tenant)
 
     # --- introspection ----------------------------------------------------
+    def backlog(self) -> int:
+        """Queued-but-undispatched tickets — the fleet watchdog's
+        backlog observable. The serial fleet drains synchronously (call
+        = submit + pump), so a persistently nonzero backlog means a
+        future batched/async dispatcher is falling behind."""
+        return len(self._queue)
+
     def debug_payload(self) -> dict:
         return {"tenants": self.snapshot(),
                 "inflight_cap": self.inflight_cap,

@@ -324,6 +324,22 @@ SLO_BURN_ALERTS = REGISTRY.counter(
     "firing also lands an slo.burn trace in the flight-recorder ring "
     "so the alert arrives with its evidence",
     ("slo", "tenant"), label_defaults=_TENANT)
+WATCHDOG_FINDINGS = REGISTRY.counter(
+    "karpenter_tpu_watchdog_findings_total",
+    "Findings fired by the online invariant watchdog (obs/watchdog.py), "
+    "by invariant and severity. Edge-triggered per (invariant, "
+    "offending object): one firing per excursion. Nonzero critical "
+    "findings mean a chaos-runner end-of-run invariant is being "
+    "violated RIGHT NOW — each firing also lands a watchdog.finding "
+    "marker trace in the flight-recorder ring and flips the readiness "
+    "probe when critical",
+    ("invariant", "severity", "tenant"), label_defaults=_TENANT)
+WATCHDOG_VERDICT = REGISTRY.gauge(
+    "karpenter_tpu_watchdog_verdict",
+    "Worst severity among the watchdog's ACTIVE excursions: 0 = ok, "
+    "1 = warning, 2 = critical. /readyz answers 503 while this reads 2 "
+    "— the readiness face of the verification plane",
+    ("tenant",), label_defaults=_TENANT)
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

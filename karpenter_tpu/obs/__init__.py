@@ -9,7 +9,9 @@ from .tracer import (NOOP_SPAN, TRACER, FlightRecorder, Span, Trace, Tracer,
 # /debug/profile + /debug/explain; both are free while tracing is off
 from .explain import RECORDER
 from .profile import LEDGER, PHASES, PhaseLedger
+from .watchdog import INVARIANTS, Finding, Watchdog
 
 __all__ = ["TRACER", "Tracer", "Span", "Trace", "FlightRecorder",
            "NOOP_SPAN", "to_chrome_events", "write_chrome_trace",
-           "summarize", "LEDGER", "PHASES", "PhaseLedger", "RECORDER"]
+           "summarize", "LEDGER", "PHASES", "PhaseLedger", "RECORDER",
+           "Watchdog", "Finding", "INVARIANTS"]

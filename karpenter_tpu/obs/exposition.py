@@ -22,7 +22,20 @@ stores `payload` (a plain callable taking `(owner, query)`) plus a
 weak reference; once the owner dies the route answers
 `{"inactive": true}` instead of pinning a dead subsystem (or serving
 its corpse). Ownerless routes take `(query)`. Last registration wins —
-a rebuilt subsystem replaces its predecessor.
+a rebuilt subsystem replaces its predecessor. `/debug` (no suffix)
+enumerates every route with its owner-liveness status, so discovering
+the observatory surface never means guessing at 404s.
+
+Health probes are SPLIT, kubelet-style:
+- `/healthz` is LIVENESS: the process is serving — always 200 "ok".
+  Restarting on anything weaker than process death just loses state.
+- `/readyz` is READINESS: consults every live registered readiness
+  probe (`register_readiness`, weakref like the debug routes — the
+  armed invariant watchdog registers one: a critical verdict means the
+  control plane is violating its own invariants RIGHT NOW) plus the
+  `degraded_mode` gauges. Any failing probe → 503; degraded components
+  are reported in the body but do not flip readiness (degradation is
+  designed-for operation: the fallback path is serving).
 """
 
 from __future__ import annotations
@@ -36,6 +49,11 @@ from .tracer import TRACER, Tracer, to_chrome_events
 
 # route -> (payload, owner_weakref | None); see module docstring
 DEBUG_ROUTES: dict = {}
+
+# name -> (probe, owner_weakref | None): readiness probes consulted by
+# /readyz. A probe returns (ready: bool, detail: dict); dead owners are
+# pruned lazily — a vanished subsystem stops gating readiness
+READINESS_PROBES: dict = {}
 
 OPENMETRICS_CTYPE = ("application/openmetrics-text; version=1.0.0; "
                      "charset=utf-8")
@@ -56,6 +74,56 @@ def register_debug_route(route: str, payload, owner=None) -> None:
     DEBUG_ROUTES[route] = (payload, ref)
 
 
+def register_readiness(name: str, probe, owner=None) -> None:
+    """Gate /readyz on `probe` — called as `probe(owner)` (live owner)
+    or `probe()` when ownerless; must return (ready, detail). Weakref
+    semantics match the debug routes: a dead owner's probe is dropped,
+    never failed — readiness reflects subsystems that EXIST."""
+    ref = weakref.ref(owner) if owner is not None else None
+    READINESS_PROBES[name] = (probe, ref)
+
+
+def _readiness() -> Tuple[bool, dict]:
+    """Aggregate readiness: every live probe must pass. The
+    `degraded_mode` gauge rides along in the body (the operator-facing
+    'why is this replica slow' answer) without flipping the verdict."""
+    from ..metrics import DEGRADED_MODE
+    ready = True
+    probes: dict = {}
+    for name, (probe, ref) in list(READINESS_PROBES.items()):
+        if ref is not None:
+            owner = ref()
+            if owner is None:
+                READINESS_PROBES.pop(name, None)
+                continue
+            ok, detail = probe(owner)
+        else:
+            ok, detail = probe()
+        ready = ready and bool(ok)
+        probes[name] = {"ready": bool(ok), **detail}
+    with DEGRADED_MODE._lock:
+        items = list(DEGRADED_MODE._values.items())
+    degraded = {"/".join(k): v for k, v in items if v}
+    return ready, {"ready": ready, "probes": probes, "degraded": degraded}
+
+
+def _debug_index() -> dict:
+    """The /debug index: every registered route with owner liveness —
+    dead-weakref routes are listed as inactive instead of 404-guessed."""
+    routes = [{"route": "/metrics", "builtin": True, "active": True},
+              {"route": "/healthz", "builtin": True, "active": True,
+               "probe": "liveness"},
+              {"route": "/readyz", "builtin": True, "active": True,
+               "probe": "readiness"},
+              {"route": "/debug", "builtin": True, "active": True},
+              {"route": "/debug/traces", "builtin": True, "active": True}]
+    for route, (_payload, ref) in sorted(DEBUG_ROUTES.items()):
+        routes.append({"route": route, "builtin": False,
+                       "active": ref is None or ref() is not None})
+    return {"routes": routes,
+            "readiness_probes": sorted(READINESS_PROBES)}
+
+
 def render(path: str, tracer: Optional[Tracer] = None,
            accept: str = "") -> Tuple[int, str, bytes]:
     """(status, content_type, body) for an exposition route. Unknown
@@ -71,6 +139,12 @@ def render(path: str, tracer: Optional[Tracer] = None,
         return 200, TEXT_CTYPE, REGISTRY.expose(exemplars=False).encode()
     if route == "/healthz":
         return 200, "text/plain", b"ok\n"
+    if route == "/readyz":
+        ready, body = _readiness()
+        return (200 if ready else 503, "application/json",
+                json.dumps(body).encode())
+    if route == "/debug":
+        return 200, "application/json", json.dumps(_debug_index()).encode()
     if route == "/debug/traces":
         traces = tracer.recorder.slowest()
         if "format=chrome" in query:
@@ -79,6 +153,7 @@ def render(path: str, tracer: Optional[Tracer] = None,
         else:
             body = json.dumps({"enabled": tracer.enabled,
                                "ring_size": tracer.recorder.size,
+                               "dropped": tracer.recorder.dropped,
                                "count": len(traces),
                                "traces": [t.to_dict() for t in traces]})
         return 200, "application/json", body.encode()

@@ -109,6 +109,10 @@ class FlightRecorder:
         self.size = max(1, size)
         self._traces: List[Trace] = []
         self._lock = threading.Lock()
+        # lifetime count of rejected offers — the ring-overflow meter the
+        # invariant watchdog reads (a hot ring too small to retain
+        # evidence is an observability failure worth a finding)
+        self.dropped = 0
 
     def offer(self, trace: Trace) -> bool:
         with self._lock:
@@ -120,6 +124,7 @@ class FlightRecorder:
             if trace.duration > self._traces[fastest].duration:
                 self._traces[fastest] = trace
                 return True
+            self.dropped += 1
             return False
 
     def slowest(self, n: Optional[int] = None) -> List[Trace]:
@@ -318,11 +323,17 @@ def to_chrome_events(traces: List[Trace]) -> List[dict]:
     return events
 
 
-def write_chrome_trace(traces: List[Trace], path: str) -> str:
+def write_chrome_trace(traces: List[Trace], path: str,
+                       metadata: Optional[dict] = None) -> str:
     """Write {"traceEvents": [...]} — the schema both chrome://tracing
-    and Perfetto ingest directly."""
+    and Perfetto ingest directly. `metadata` lands under the standard
+    top-level "metadata" key (both viewers ignore it): the run stamp —
+    schema_version/run_id/seed/provenance — that lets the perf archive
+    key this artifact to the bench run that produced it."""
     payload = {"traceEvents": to_chrome_events(traces),
                "displayTimeUnit": "ms"}
+    if metadata:
+        payload["metadata"] = dict(metadata)
     with open(path, "w") as f:
         json.dump(payload, f)
     return path

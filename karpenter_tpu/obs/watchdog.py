@@ -1,0 +1,587 @@
+"""Online invariant watchdog: the chaos invariants, while they happen.
+
+Every correctness invariant this framework enforces — no leaked claims,
+store<->cloud consistency, a fully resolved intent journal, warm-path
+audit discipline, fleet fairness — has lived as an END-OF-RUN assert in
+the chaos/restart/fleet runners: a violation is only visible after the
+run, with no timestamp, no severity, and no way to observe it in a
+deployed process at all. The watchdog reframes those asserts as
+INCREMENTAL monitors evaluated on a sim-clock cadence:
+
+- **claim_leak** — claims stuck launching (no provider id), stuck in a
+  pre-Initialized phase, or draining forever, past a sim-time grace
+  window; plus the idempotency-token ledger checks (one token minting
+  two live instances, one claim backed by two live instances — never
+  legitimate, so no grace).
+- **store_cloud_drift** — store nodes backing dead/missing cloud
+  instances, and karpenter-tagged live instances no claim tracks
+  (shielded by open launch intents exactly like the GC sweep).
+- **intent_age** — open launch intents older than `INTENT_GRACE`: a
+  wedged crash-window launch the restart replay never resolved.
+- **warm_audit_lag** — warm admissions recorded but unaudited for
+  longer than the lag grace (audit coverage silently drifting behind).
+- **warm_divergence** — the auditor's divergence counter moved: the
+  incremental admitter disagreed with the full solver (self-repairing,
+  but every occurrence must be visible the moment it happens).
+- **fleet_starvation** — a tenant's worst virtual queueing delay
+  crossed the starvation threshold, or the shared service's queue
+  backlog crossed the backlog threshold.
+- **profile_unattributed** — the phase ledger's unattributed gap grew:
+  an un-spanned seam appeared on a traced hot path.
+- **trace_ring_overflow** — the flight recorder rejected traces since
+  arming faster than the overflow threshold: the ring is too small to
+  retain the evidence the other monitors point at.
+
+Cost discipline: the claim watchlist is maintained from the store's
+watch feed (O(delta) per event, settled claims leave the list), the
+meters are counter deltas, and the cloud sweep is bounded by live
+instances on a slower cadence — one rate-limited `tick()` per engine
+tick is a single float compare when nothing is due. Findings are
+severity-ranked and EDGE-TRIGGERED per (invariant, key): one finding
+per excursion, re-armed when the condition clears. Each firing meters
+`watchdog_findings_total{invariant,severity}`, lands a
+`watchdog.finding` marker trace in the flight-recorder ring (works with
+tracing disabled — the ring accepts direct offers), and is readable at
+`/debug/watchdog` (weakref route). The watchdog also registers a
+readiness probe: a critical verdict flips `/readyz` to 503.
+
+Sim-clock jumps (chaos `ClockJump` rules) are absorbed: a tick that
+observes time advancing far beyond the tick cadence shifts every
+tracked timestamp by the jump, so skew cannot age a healthy launch into
+a fake leak — the zero-false-positive contract over the existing chaos
+catalogs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .exposition import register_debug_route, register_readiness
+from .tracer import TRACER, Span, Trace
+
+# the taxonomy `make obs-audit` enforces negative coverage for: every
+# name here must be tripped by a seeded fault in tests/test_watchdog.py
+INVARIANTS: Tuple[str, ...] = (
+    "claim_leak",
+    "store_cloud_drift",
+    "intent_age",
+    "warm_audit_lag",
+    "warm_divergence",
+    "fleet_starvation",
+    "profile_unattributed",
+    "trace_ring_overflow",
+)
+
+SEVERITIES = ("info", "warning", "critical")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+# substrings of end-of-run violation texts -> the invariant that should
+# have seen the condition live (the runners' "watchdog found it first"
+# cross-check); unmapped violations have no online monitor (yet)
+_VIOLATION_MAP: Tuple[Tuple[str, str], ...] = (
+    ("leaked", "claim_leak"),
+    ("stuck in phase", "claim_leak"),
+    ("still draining", "claim_leak"),
+    ("duplicate launch", "claim_leak"),
+    ("backs a dead instance", "store_cloud_drift"),
+    ("orphaned", "store_cloud_drift"),
+    ("intent(s) still open", "intent_age"),
+    ("auditor diverged", "warm_divergence"),
+)
+
+
+@dataclass
+class Finding:
+    invariant: str
+    severity: str
+    key: str                  # the offending object (claim/tenant/...)
+    message: str
+    at: float                 # sim time of first detection
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"invariant": self.invariant, "severity": self.severity,
+                "key": self.key, "message": self.message,
+                "at": round(self.at, 3), "attrs": dict(self.attrs)}
+
+
+class Watchdog:
+    """One watchdog per control plane (or per shared fleet service).
+
+    Pass whichever subsystems exist: `store`+`cloud` enable the leak and
+    drift monitors, `journal` the intent-age monitor, `warmpath` the
+    audit-lag/divergence monitors, `service` the fleet monitors. The
+    profile/trace meters are process-global and always on (baselined at
+    `arm()` so another run's residue never counts against this one).
+    """
+
+    INTERVAL = 5.0            # sim seconds between evaluations
+    CLOUD_SWEEP = 30.0        # sim seconds between cloud drift sweeps
+    CLAIM_GRACE = 900.0       # launching/draining age before a leak fires
+    DRIFT_GRACE = 300.0       # store<->cloud disagreement age
+    ORPHAN_GRACE = 900.0      # untracked tagged instance age (> GC sweep)
+    AUDIT_LAG_GRACE = 120.0   # recorded-but-unaudited warm batch age
+    STARVATION_S = 1.0        # virtual queueing delay (seconds)
+    BACKLOG_MAX = 64          # queued tickets in the shared service
+    UNATTRIBUTED_MS = 5.0     # ledger gap growth per excursion
+    RING_DROPS = 64           # recorder rejections since arm
+    JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
+    MAX_FINDINGS = 256        # bounded finding log
+
+    def __init__(self, clock, store=None, cloud=None, journal=None,
+                 warmpath=None, service=None,
+                 interval: Optional[float] = None,
+                 claim_grace: Optional[float] = None,
+                 drift_grace: Optional[float] = None,
+                 audit_lag_grace: Optional[float] = None,
+                 starvation_s: Optional[float] = None,
+                 backlog_max: Optional[int] = None):
+        self.clock = clock
+        self.store = store
+        self.cloud = cloud
+        self.journal = journal
+        self.warmpath = warmpath
+        self.service = service
+        self.interval = self.INTERVAL if interval is None else interval
+        self.claim_grace = (self.CLAIM_GRACE if claim_grace is None
+                            else claim_grace)
+        self.drift_grace = (self.DRIFT_GRACE if drift_grace is None
+                            else drift_grace)
+        self.audit_lag_grace = (self.AUDIT_LAG_GRACE
+                                if audit_lag_grace is None
+                                else audit_lag_grace)
+        self.starvation_s = (self.STARVATION_S if starvation_s is None
+                             else starvation_s)
+        self.backlog_max = (self.BACKLOG_MAX if backlog_max is None
+                            else int(backlog_max))
+        self._lock = threading.Lock()
+        self.findings: List[Finding] = []
+        # ACTIVE excursions: (invariant, key) -> severity. The verdict
+        # derives from this map, never from the bounded findings log —
+        # trimming old log entries must not amnesty a live violation
+        self._active: Dict[Tuple[str, str], str] = {}
+        self._fired: Dict[str, int] = {}     # invariant -> lifetime count
+        self._claims: Dict[str, float] = {}  # in-transition claim -> since
+        self._drift: Dict[str, float] = {}   # drift key -> first seen
+        # (auditor.pending_since value, watchdog clock when first seen):
+        # the lag is measured on the WATCHDOG's observation clock so a
+        # chaos ClockJump can be absorbed like every other stamp
+        self._audit_pending: Optional[Tuple[float, float]] = None
+        self._last_tick: Optional[float] = None
+        self._last_sweep: Optional[float] = None
+        self.armed = False
+        self.stats = {"ticks": 0, "evals": 0, "findings": 0,
+                      "jump_absorbed": 0}
+        # meter baselines (set at arm): deltas, never process totals
+        self._base_dropped = 0
+        self._base_unattr = 0.0
+        self._base_div = 0.0
+
+    # --- arming -----------------------------------------------------------
+    def arm(self, now: Optional[float] = None) -> "Watchdog":
+        """Subscribe to the store watch feed, baseline the meters, and
+        register the debug route + readiness probe. Idempotent."""
+        if self.armed:
+            return self
+        self.armed = True
+        now = float(self.clock.now()) if now is None else float(now)
+        self._last_tick = now
+        if self.store is not None:
+            self.store.watch("nodeclaim", self._on_claim_event)
+            # seed the watchlist with claims that predate arming (a
+            # restarted watchdog must still see the adopted fleet)
+            for nc in self.store.nodeclaims.values():
+                if not self._settled(nc):
+                    self._claims[nc.name] = now
+        from .profile import LEDGER
+        self._base_dropped = getattr(TRACER.recorder, "dropped", 0)
+        self._base_unattr = LEDGER.unattributed_ms()
+        self._base_div = (float(self.warmpath.stats.get("divergences", 0))
+                          if self.warmpath is not None else 0.0)
+        register_debug_route("/debug/watchdog",
+                             lambda wd, query: wd.payload(query),
+                             owner=self)
+        # unique probe name per watchdog: a fleet arms one per shard and
+        # /readyz must aggregate every LIVE one (dead refs prune lazily)
+        register_readiness(f"watchdog-{id(self):x}",
+                           lambda wd: wd.readiness(), owner=self)
+        return self
+
+    # --- store feed (O(1) per event) --------------------------------------
+    @staticmethod
+    def _settled(nc) -> bool:
+        from ..models.nodeclaim import Phase
+        return (bool(nc.provider_id) and nc.phase == Phase.INITIALIZED
+                and not nc.is_deleting())
+
+    def _on_claim_event(self, action: str, nc) -> None:
+        if action == "delete":
+            self._claims.pop(nc.name, None)
+            self._clear("claim_leak", nc.name)  # resolved: re-arm edge
+            return
+        # add/update/delete-mark: (re)open the transition window — age is
+        # measured from the LAST observed transition, not claim birth
+        self._claims[nc.name] = float(self.clock.now())
+
+    # --- evaluation -------------------------------------------------------
+    def tick(self, now: Optional[float] = None,
+             force: bool = False) -> List[Finding]:
+        """Rate-limited evaluation; returns the findings fired by THIS
+        call. The engine calls this every tick — the common case is one
+        float compare and out."""
+        if not self.armed:
+            return []
+        now = float(self.clock.now()) if now is None else float(now)
+        self.stats["ticks"] += 1
+        last = self._last_tick
+        if not force and last is not None and now - last < self.interval:
+            return []
+        if last is not None and now - last > self.JUMP_THRESHOLD:
+            self._absorb_jump(now - last)
+        self._last_tick = now
+        self.stats["evals"] += 1
+        fired: List[Finding] = []
+        self._check_claims(now, fired)
+        self._check_journal(now, fired)
+        self._check_warmpath(now, fired)
+        self._check_fleet(now, fired)
+        self._check_meters(now, fired)
+        if self._last_sweep is None or force \
+                or now - self._last_sweep >= self.CLOUD_SWEEP:
+            self._last_sweep = now
+            self._check_cloud(now, fired)
+        self._publish_verdict()
+        return fired
+
+    def _absorb_jump(self, dt: float) -> None:
+        """A clock jump (or a long untick'd stretch) must not age every
+        tracked window at once: shift the stamps forward so observed
+        ages stay continuous with the tick cadence."""
+        shift = dt - self.interval
+        self.stats["jump_absorbed"] += 1
+        self._claims = {k: v + shift for k, v in self._claims.items()}
+        self._drift = {k: v + shift for k, v in self._drift.items()}
+        if self._audit_pending is not None:
+            ps, seen = self._audit_pending
+            self._audit_pending = (ps, seen + shift)
+
+    # --- monitors ---------------------------------------------------------
+    def _check_claims(self, now: float, fired: List[Finding]) -> None:
+        if self.store is None:
+            return
+        for name in list(self._claims):
+            nc = self.store.nodeclaims.get(name)
+            if nc is None:
+                self._claims.pop(name, None)
+                continue
+            if self._settled(nc):
+                self._claims.pop(name, None)
+                self._clear("claim_leak", name)
+                continue
+            age = now - self._claims[name]
+            if age < self.claim_grace:
+                continue
+            if nc.is_deleting():
+                what = "draining"
+            elif not nc.provider_id:
+                what = "unlaunched"
+            else:
+                what = f"stuck in phase {nc.phase}"
+            self._fire(fired, "claim_leak", "critical", name,
+                       f"claim {name} {what} for {age:.0f}s "
+                       f"(grace {self.claim_grace:g}s)",
+                       now, age_s=round(age, 1))
+
+    def _check_cloud(self, now: float, fired: List[Finding]) -> None:
+        """The store<->cloud sweep: bounded by live instances + store
+        nodes, run on the slow cadence. Also the token-ledger duplicate
+        checks — graceless, a duplicate is never in flight."""
+        if self.cloud is None or self.store is None:
+            return
+        from ..models import labels as L
+        insts = getattr(self.cloud, "instances", None)
+        if insts is not None:  # in-process cloud: the full state map
+            live = {iid: inst for iid, inst in insts.items()
+                    if inst.state != "terminated"}
+        else:  # wire client (RemoteCloud): one describe per slow sweep;
+            # a throttled/unreachable cloud skips this sweep, it never
+            # takes the watchdog (or the control plane) down
+            from ..cloud.provider import CloudError
+            try:
+                live = {i.id: i for i in self.cloud.describe()
+                        if i.state != "terminated"}
+            except CloudError:
+                return
+        claim_iids = {c.provider_id.rsplit("/", 1)[-1]
+                      for c in self.store.nodeclaims.values()
+                      if c.provider_id}
+        open_tokens: frozenset = frozenset()
+        open_claims: frozenset = frozenset()
+        if self.journal is not None:
+            open_tokens = self.journal.open_tokens()
+            open_claims = self.journal.open_claim_names()
+        seen: set = set()
+        by_token: Dict[str, list] = {}
+        by_claim: Dict[str, list] = {}
+        for iid, inst in live.items():
+            tags = getattr(inst, "tags", None) or {}
+            tok = tags.get(L.TAG_LAUNCH_TOKEN)
+            claim = tags.get(L.TAG_NODECLAIM)
+            if tok:
+                by_token.setdefault(tok, []).append(iid)
+            if claim:
+                by_claim.setdefault(claim, []).append(iid)
+            if claim and iid not in claim_iids:
+                if tok in open_tokens or claim in open_claims:
+                    continue  # an open intent owns this instance's fate
+                key = f"orphan/{iid}"
+                seen.add(key)
+                first = self._drift.setdefault(key, now)
+                if now - first >= self.ORPHAN_GRACE:
+                    self._fire(fired, "store_cloud_drift", "critical", key,
+                               f"instance {iid} karpenter-tagged but no "
+                               f"claim tracks it for {now - first:.0f}s",
+                               now, age_s=round(now - first, 1))
+        dup_seen: set = set()
+        for tok, iids in by_token.items():
+            if len(iids) > 1:
+                key = f"token/{tok[:12]}"
+                dup_seen.add(key)
+                self._fire(fired, "claim_leak", "critical", key,
+                           f"idempotency token minted {len(iids)} live "
+                           f"instances: {sorted(iids)[:3]}", now)
+        for claim, iids in by_claim.items():
+            if len(iids) > 1:
+                key = f"dup-claim/{claim}"
+                dup_seen.add(key)
+                self._fire(fired, "claim_leak", "critical", key,
+                           f"claim {claim} backed by {len(iids)} live "
+                           f"instances: {sorted(iids)[:3]}", now)
+        # a resolved duplicate (one copy terminated) clears its
+        # excursion — the verdict must not read critical forever
+        for inv, key in list(self._active):
+            if inv == "claim_leak" and (key.startswith("token/")
+                                        or key.startswith("dup-claim/")) \
+                    and key not in dup_seen:
+                self._clear(inv, key)
+        for node in self.store.nodes.values():
+            iid = node.provider_id.rsplit("/", 1)[-1]
+            if iid in live:
+                continue
+            key = f"deadnode/{node.name}"
+            seen.add(key)
+            first = self._drift.setdefault(key, now)
+            if now - first >= self.drift_grace:
+                self._fire(fired, "store_cloud_drift", "critical", key,
+                           f"store node {node.name} backs dead instance "
+                           f"{iid} for {now - first:.0f}s", now,
+                           age_s=round(now - first, 1))
+        for key in list(self._drift):
+            if key not in seen:   # condition cleared: re-arm the edge
+                self._drift.pop(key, None)
+                self._clear("store_cloud_drift", key)
+
+    def _check_journal(self, now: float, fired: List[Finding]) -> None:
+        if self.journal is None:
+            return
+        from ..controllers.gc import INTENT_GRACE
+        open_names = set()
+        for intent in self.journal.open_intents():
+            age = now - intent.created_at
+            if age < INTENT_GRACE:
+                continue
+            key = f"intent/{intent.claim_name}#{intent.seq}"
+            open_names.add(key)
+            self._fire(fired, "intent_age", "critical", key,
+                       f"launch intent for {intent.claim_name} open "
+                       f"{age:.0f}s (INTENT_GRACE {INTENT_GRACE:g}s) — "
+                       f"wedged past the GC shield", now,
+                       age_s=round(age, 1))
+        for inv, key in list(self._active):
+            if inv == "intent_age" and key not in open_names:
+                self._clear("intent_age", key)
+
+    def _check_warmpath(self, now: float, fired: List[Finding]) -> None:
+        wp = self.warmpath
+        if wp is None:
+            return
+        pending_since = getattr(wp.auditor, "pending_since", None)
+        if pending_since is not None:
+            # lag on the watchdog's observation clock (first tick that
+            # saw THIS pending window), so _absorb_jump covers it —
+            # `now - pending_since` would let a ClockJump age a
+            # seconds-old batch into a fake finding
+            if (self._audit_pending is None
+                    or self._audit_pending[0] != pending_since):
+                self._audit_pending = (pending_since, now)
+            lag = now - self._audit_pending[1]
+            if lag >= self.audit_lag_grace:
+                self._fire(fired, "warm_audit_lag", "warning", "auditor",
+                           f"warm admissions unaudited for {lag:.0f}s "
+                           f"(grace {self.audit_lag_grace:g}s)", now,
+                           lag_s=round(lag, 1))
+        else:
+            self._audit_pending = None
+            self._clear("warm_audit_lag", "auditor")
+        div = float(wp.stats.get("divergences", 0))
+        if div > self._base_div:
+            latency = (now - self._audit_pending[1]) \
+                if self._audit_pending is not None else 0.0
+            self._fire(fired, "warm_divergence", "warning",
+                       f"div/{int(div)}",
+                       f"warm-path audit divergence #{int(div)} "
+                       f"(detection latency {latency:.1f}s) — path forced "
+                       f"cold", now, divergences=div)
+            self._base_div = div
+
+    def _check_fleet(self, now: float, fired: List[Finding]) -> None:
+        svc = self.service
+        if svc is None:
+            return
+        backlog = svc.backlog()
+        if backlog > self.backlog_max:
+            self._fire(fired, "fleet_starvation", "warning", "backlog",
+                       f"solver service backlog {backlog} tickets "
+                       f"(max {self.backlog_max})", now, backlog=backlog)
+        else:
+            self._clear("fleet_starvation", "backlog")
+        for tenant, state in svc.tenants.items():
+            if state.max_wait >= self.starvation_s:
+                self._fire(fired, "fleet_starvation", "warning", tenant,
+                           f"tenant {tenant} worst virtual queueing delay "
+                           f"{state.max_wait * 1e3:.0f}ms this window "
+                           f"(threshold {self.starvation_s * 1e3:g}ms)",
+                           now, max_wait_ms=round(state.max_wait * 1e3, 1))
+            else:
+                self._clear("fleet_starvation", tenant)
+
+    def _check_meters(self, now: float, fired: List[Finding]) -> None:
+        from .profile import LEDGER
+        unattr = LEDGER.unattributed_ms()
+        if unattr - self._base_unattr >= self.UNATTRIBUTED_MS:
+            self._fire(fired, "profile_unattributed", "info", "ledger",
+                       f"phase ledger unattributed gap grew "
+                       f"{unattr - self._base_unattr:.1f}ms since last "
+                       f"excursion", now,
+                       gap_ms=round(unattr - self._base_unattr, 3))
+            self._base_unattr = unattr
+        dropped = getattr(TRACER.recorder, "dropped", 0)
+        if dropped - self._base_dropped >= self.RING_DROPS:
+            self._fire(fired, "trace_ring_overflow", "info", "ring",
+                       f"flight recorder rejected "
+                       f"{dropped - self._base_dropped} traces since last "
+                       f"excursion (ring size {TRACER.recorder.size})",
+                       now, dropped=dropped - self._base_dropped)
+            self._base_dropped = dropped
+
+    # --- firing / clearing ------------------------------------------------
+    def _fire(self, fired: List[Finding], invariant: str, severity: str,
+              key: str, message: str, now: float, **attrs) -> None:
+        edge = (invariant, key)
+        with self._lock:
+            if edge in self._active:
+                return
+            self._active[edge] = severity
+            f = Finding(invariant=invariant, severity=severity, key=key,
+                        message=message, at=now, attrs=attrs)
+            self.findings.append(f)
+            if len(self.findings) > self.MAX_FINDINGS:
+                del self.findings[:len(self.findings) - self.MAX_FINDINGS]
+            self._fired[invariant] = self._fired.get(invariant, 0) + 1
+            self.stats["findings"] += 1
+        fired.append(f)
+        from ..metrics import WATCHDOG_FINDINGS
+        WATCHDOG_FINDINGS.inc(invariant=invariant, severity=severity)
+        self._flight_record(f)
+
+    def _clear(self, invariant: str, key: str) -> None:
+        with self._lock:
+            self._active.pop((invariant, key), None)
+
+    def _flight_record(self, f: Finding) -> None:
+        marker = Span(name="watchdog.finding",
+                      trace_id=f"watchdog-{f.invariant}-{f.key}-"
+                               f"{int(f.at)}",
+                      span_id=0, parent_id=None, t0=0.0, t1=1e-6,
+                      ts=f.at, attrs=f.to_dict())
+        accepted = TRACER.recorder.offer(Trace(trace_id=marker.trace_id,
+                                               spans=[marker]))
+        if not accepted:
+            # the slowest-N ring legitimately rejects a near-zero-
+            # duration marker when full of real traces; that rejection
+            # must not count toward the overflow meter the watchdog
+            # itself reads, or findings would manufacture findings
+            self._base_dropped += 1
+
+    # --- read side --------------------------------------------------------
+    def fired(self, invariant: str) -> int:
+        """Lifetime finding count for one invariant (the runners'
+        found-it-first cross-check reads this)."""
+        return self._fired.get(invariant, 0)
+
+    def findings_at_least(self, severity: str = "warning") -> int:
+        rank = _SEV_RANK[severity]
+        with self._lock:
+            return sum(1 for f in self.findings
+                       if _SEV_RANK[f.severity] >= rank)
+
+    def verdict(self) -> str:
+        """Worst severity among ACTIVE excursions: 'ok', 'warning', or
+        'critical' — the readiness signal. Reads the excursion map, not
+        the bounded findings log: trimming old log entries must never
+        amnesty a live violation."""
+        with self._lock:
+            worst = max((_SEV_RANK[s] for s in self._active.values()),
+                        default=-1)
+        if worst < 0:
+            return "ok"
+        return SEVERITIES[worst]
+
+    def readiness(self) -> Tuple[bool, dict]:
+        v = self.verdict()
+        return v != "critical", {"verdict": v,
+                                 "active": len(self._active),
+                                 "findings": self.stats["findings"]}
+
+    def _publish_verdict(self) -> None:
+        from ..metrics import WATCHDOG_VERDICT
+        WATCHDOG_VERDICT.set(float(_SEV_RANK.get(self.verdict(), 0)))
+
+    def cross_check(self, violations: List[str]) -> List[str]:
+        """The end-of-run asserts as 'watchdog found it first' checks:
+        every violation with an online monitor must have fired a finding
+        during (or at the end of) the run; a miss is a watchdog blind
+        spot — itself a violation of the verification plane."""
+        blind: List[str] = []
+        missed: set = set()
+        for v in violations:
+            for needle, invariant in _VIOLATION_MAP:
+                if needle in v and not self.fired(invariant):
+                    missed.add((invariant, needle))
+        for invariant, needle in sorted(missed):
+            blind.append(f"watchdog blind spot: end-of-run '{needle}' "
+                         f"violation but the {invariant} monitor never "
+                         f"fired")
+        return blind
+
+    def payload(self, query: str = "") -> dict:
+        with self._lock:
+            findings = [f.to_dict() for f in self.findings]
+        findings.sort(key=lambda f: (-_SEV_RANK[f["severity"]], -f["at"]))
+        return {"armed": self.armed,
+                "verdict": self.verdict(),
+                "invariants": list(INVARIANTS),
+                "interval_s": self.interval,
+                "graces": {"claim_s": self.claim_grace,
+                           "drift_s": self.drift_grace,
+                           "orphan_s": self.ORPHAN_GRACE,
+                           "audit_lag_s": self.audit_lag_grace,
+                           "starvation_s": self.starvation_s,
+                           "backlog_max": self.backlog_max},
+                "stats": dict(self.stats),
+                "fired": dict(self._fired),
+                "watchlist": {"claims": len(self._claims),
+                              "drift": len(self._drift)},
+                "findings": findings}

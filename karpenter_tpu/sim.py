@@ -55,6 +55,11 @@ class SimEnvironment:
     # (with its cloud) to simulate a crash-restart — open intents replay
     # during rehydration
     journal: Optional[object] = None
+    # obs.watchdog.Watchdog: the online invariant monitor, armed on this
+    # stack's store/cloud/journal/warmpath and ticked by the engine.
+    # Read-only over everything it watches, so end-state hashes and
+    # fault fingerprints are identical with it armed
+    watchdog: Optional[object] = None
 
     def start_chaos(self, interval: float = 60.0, seed: int = 0) -> None:
         """kwok kill-node-thread analog (kwok/ec2/ec2.go:253-282): kill a
@@ -92,7 +97,8 @@ def make_sim(types: Optional[List[InstanceType]] = None,
              warmpath: bool = False,
              warm_audit_every: int = 1,
              journal: Optional[object] = None,
-             solver_factory: Optional[object] = None) -> SimEnvironment:
+             solver_factory: Optional[object] = None,
+             watchdog: bool = True) -> SimEnvironment:
     """Passing an existing `cloud` (+ its clock) simulates an operator
     restart: the new stack rehydrates its fresh Store from the cloud's
     durable state instead of starting empty-world. Passing the previous
@@ -201,6 +207,17 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                                      interruption, gc, metrics_c, repair,
                                      tagging, discovered, refresh, res_exp,
                                      spot_pricing)
+    # the verification plane's online monitor: armed BEFORE the workload
+    # so the store watch feed sees every claim from birth; the engine
+    # ticks it outside the traced window. Arming is read-only over the
+    # whole stack — chaos end-state hashes and fault fingerprints are
+    # byte-identical with it on (tests/test_watchdog.py asserts so)
+    wd = None
+    if watchdog:
+        from .obs.watchdog import Watchdog
+        wd = Watchdog(clock, store=store, cloud=cloud, journal=journal,
+                      warmpath=warm_engine).arm(clock.now())
+        engine.watchdog = wd
 
     # cloud → store node materialization (kubelet joining the cluster).
     # The in-process fake pushes node events through a callback; a cloud
@@ -268,4 +285,5 @@ def make_sim(types: Optional[List[InstanceType]] = None,
                           binding=binding, termination=termination,
                           disruption=disruption, interruption=interruption,
                           gc=gc, fault_plan=fault_plan,
-                          warmpath=warm_engine, journal=journal)
+                          warmpath=warm_engine, journal=journal,
+                          watchdog=wd)

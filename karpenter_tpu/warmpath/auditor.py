@@ -61,6 +61,10 @@ class Auditor:
         self._baselines: Dict[str, _Baseline] = {}
         self._batches: Dict[str, _Batches] = {}
         self._since_audit = 0
+        # sim time of the OLDEST recorded-but-unaudited admission — the
+        # audit-lag observable the invariant watchdog monitors (warm
+        # coverage silently drifting behind is a finding, not a log line)
+        self.pending_since: Optional[float] = None
         self.stats = {"audits": 0, "divergences": 0, "audited_pods": 0}
 
     def reset(self) -> None:
@@ -73,6 +77,7 @@ class Auditor:
         self._baselines = {}
         self._batches = {}
         self._since_audit = 0
+        self.pending_since = None
 
     # --- commit-time snapshot ---
     def on_commit(self, ledgers: Dict[str, PoolLedger],
@@ -87,13 +92,17 @@ class Auditor:
             for name, led in ledgers.items() if led.ready}
         self._batches = {}
         self._since_audit = 0
+        self.pending_since = None
 
     # --- per-admission record ---
     def record(self, pool_name: str, pods: List[Pod],
-               want: Dict[str, str]) -> None:
+               want: Dict[str, str],
+               now: Optional[float] = None) -> None:
         b = self._batches.setdefault(pool_name, _Batches())
         b.pods.extend(pods)
         b.want.update(want)
+        if self.pending_since is None and now is not None:
+            self.pending_since = now
 
     def close_window(self) -> None:
         """One warm RECONCILE recorded admissions (possibly across
@@ -114,6 +123,7 @@ class Auditor:
         Batches are consumed; the engine rebases the baseline after a
         clean audit and forces cold (which recommits) on divergence."""
         self._since_audit = 0
+        self.pending_since = None
         batches, self._batches = self._batches, {}
         divergences: List[str] = []
         for pool_name, b in batches.items():

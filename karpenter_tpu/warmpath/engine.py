@@ -132,7 +132,7 @@ class WarmPathEngine:
                     self.auditor.record(
                         pool.name,
                         [p for ps in adm.placements.values() for p in ps],
-                        adm.want)
+                        adm.want, now=now)
                 escalated.extend(adm.escalated)
                 remaining = adm.passthrough
             # groups every pool's taint filter dropped end up exactly

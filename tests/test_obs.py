@@ -595,3 +595,170 @@ class TestEngineSmoke:
                    sim.store.pods.values()) or True  # engine still works
         # and the fast path really is the no-op singleton
         assert tr.span("anything") is NOOP_SPAN
+
+
+class TestHealthSplit:
+    """Liveness vs readiness (ISSUE 8 satellite): /healthz stays a bare
+    liveness probe, /readyz consults the registered readiness probes +
+    degraded_mode gauges — on BOTH servers."""
+
+    def _iso(self):
+        """Snapshot-and-clear the probe registry (module-global; other
+        tests' armed watchdogs must not gate this one)."""
+        from karpenter_tpu.obs import exposition
+        saved = dict(exposition.READINESS_PROBES)
+        exposition.READINESS_PROBES.clear()
+        return exposition, saved
+
+    def test_liveness_unchanged_readiness_split(self):
+        from karpenter_tpu.obs.exposition import render
+        exposition, saved = self._iso()
+        try:
+            status, _, body = render("/healthz")
+            assert (status, body) == (200, b"ok\n")
+            status, ctype, body = render("/readyz")
+            assert status == 200 and ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["ready"] is True and doc["probes"] == {}
+        finally:
+            exposition.READINESS_PROBES.update(saved)
+
+    def test_failing_probe_503_with_detail(self):
+        from karpenter_tpu.obs.exposition import register_readiness, render
+        exposition, saved = self._iso()
+        try:
+            register_readiness(
+                "broken", lambda: (False, {"why": "solver wedged"}))
+            status, _, body = render("/readyz")
+            doc = json.loads(body)
+            assert status == 503 and doc["ready"] is False
+            assert doc["probes"]["broken"]["why"] == "solver wedged"
+        finally:
+            exposition.READINESS_PROBES.clear()
+            exposition.READINESS_PROBES.update(saved)
+
+    def test_dead_owner_probe_pruned_not_failed(self):
+        from karpenter_tpu.obs.exposition import register_readiness, render
+        exposition, saved = self._iso()
+        try:
+            class Owner:
+                pass
+            o = Owner()
+            register_readiness("ephemeral",
+                               lambda owner: (False, {}), owner=o)
+            del o
+            import gc
+            gc.collect()
+            status, _, body = render("/readyz")
+            assert status == 200
+            assert "ephemeral" not in json.loads(body)["probes"]
+        finally:
+            exposition.READINESS_PROBES.clear()
+            exposition.READINESS_PROBES.update(saved)
+
+    def test_degraded_mode_reported_without_flipping(self):
+        from karpenter_tpu.metrics import DEGRADED_MODE
+        from karpenter_tpu.obs.exposition import render
+        exposition, saved = self._iso()
+        try:
+            DEGRADED_MODE.set(1, component="solver", tenant="probe-test")
+            status, _, body = render("/readyz")
+            doc = json.loads(body)
+            assert status == 200 and doc["ready"] is True
+            assert any("solver" in k for k in doc["degraded"])
+        finally:
+            from karpenter_tpu.metrics import DEGRADED_MODE as D
+            D.set(0, component="solver", tenant="probe-test")
+            exposition.READINESS_PROBES.update(saved)
+
+    def test_both_servers_serve_readyz(self, tracer):
+        """The stdlib server and the async runtime answer /readyz (and
+        a 503 carries the right reason line on the runtime path)."""
+        import asyncio
+        import socket
+        import urllib.error
+
+        from karpenter_tpu.controllers.runtime import Runtime
+        from karpenter_tpu.obs.exposition import (ExpositionServer,
+                                                  register_readiness)
+        exposition, saved = self._iso()
+        server = ExpositionServer(port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            doc = json.loads(urllib.request.urlopen(f"{base}/readyz").read())
+            assert doc["ready"] is True
+            register_readiness("down", lambda: (False, {}))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/readyz")
+            assert ei.value.code == 503
+
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+
+            async def scenario():
+                rt = Runtime(metrics_port=port)
+                task = asyncio.create_task(rt.start())
+                await asyncio.sleep(0.05)
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(b"GET /readyz HTTP/1.1\r\n\r\n")
+                await writer.drain()
+                out = await reader.read()
+                writer.close()
+                rt.stop()
+                await task
+                return out
+
+            out = asyncio.run(scenario())
+            assert b"503 Service Unavailable" in out
+        finally:
+            server.stop()
+            exposition.READINESS_PROBES.clear()
+            exposition.READINESS_PROBES.update(saved)
+
+
+class TestDebugIndex:
+    """/debug enumerates every registered route with owner liveness —
+    the discovery answer that replaces 404-guessing."""
+
+    def test_index_lists_builtins_and_registered(self):
+        from karpenter_tpu.obs.exposition import (register_debug_route,
+                                                  render)
+
+        class Owner:
+            pass
+
+        o = Owner()
+        register_debug_route("/debug/idx-live", lambda q: {"ok": 1})
+        register_debug_route("/debug/idx-owned",
+                             lambda owner, q: {"ok": 1}, owner=o)
+        status, ctype, body = render("/debug")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        routes = {r["route"]: r for r in doc["routes"]}
+        for builtin in ("/metrics", "/healthz", "/readyz",
+                        "/debug/traces"):
+            assert routes[builtin]["builtin"] and routes[builtin]["active"]
+        assert routes["/debug/idx-live"]["active"] is True
+        assert routes["/debug/idx-owned"]["active"] is True
+        # the owner dying flips the listing to inactive, not 404
+        del o
+        import gc
+        gc.collect()
+        doc = json.loads(render("/debug")[2])
+        routes = {r["route"]: r for r in doc["routes"]}
+        assert routes["/debug/idx-owned"]["active"] is False
+        assert routes["/debug/idx-live"]["active"] is True
+
+    def test_index_served_over_http(self, tracer):
+        from karpenter_tpu.obs.exposition import ExpositionServer
+        server = ExpositionServer(port=0).start()
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug").read())
+            assert any(r["route"] == "/debug/traces"
+                       for r in doc["routes"])
+        finally:
+            server.stop()

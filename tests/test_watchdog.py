@@ -1,0 +1,510 @@
+"""The online invariant watchdog (obs/watchdog.py) — the verification
+plane's first layer.
+
+Two contracts, both load-bearing:
+
+1. **Mutation-style negative coverage**: every invariant in
+   `INVARIANTS` is TRIPPED by a seeded fault scenario here
+   (`test_trip_<invariant>` — `make obs-audit` enforces the naming),
+   so a monitor that can no longer fire fails the audit, not a
+   production incident.
+2. **Zero false positives**: the existing chaos/restart/fleet catalogs
+   run with the watchdog armed (make_sim default) and must produce no
+   warning/critical findings, identical end-state hashes, and
+   identical fault fingerprints — observation must never perturb or
+   cry wolf.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from karpenter_tpu.models.nodeclaim import Node, NodeClaim
+from karpenter_tpu.obs.tracer import TRACER, FlightRecorder, Span, Trace
+from karpenter_tpu.obs.watchdog import INVARIANTS, Watchdog
+from karpenter_tpu.sim import make_sim
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _age(sim, seconds: float, step: float = 5.0) -> None:
+    """Advance sim time in watchdog-cadence steps, ticking the watchdog
+    each step — continuous aging, the way the engine drives it (a
+    single giant step would be absorbed as a clock jump, by design)."""
+    wd = sim.watchdog if hasattr(sim, "watchdog") else sim
+    clock = wd.clock
+    end = clock.now() + seconds
+    while clock.now() < end:
+        clock.step(step)
+        wd.tick()
+
+
+def _findings(wd, invariant):
+    return [f for f in wd.findings if f.invariant == invariant]
+
+
+class TestArming:
+    def test_make_sim_arms_by_default(self):
+        sim = make_sim()
+        assert sim.watchdog is not None and sim.watchdog.armed
+        assert sim.engine.watchdog is sim.watchdog
+        assert sim.watchdog.verdict() == "ok"
+
+    def test_opt_out(self):
+        sim = make_sim(watchdog=False)
+        assert sim.watchdog is None and sim.engine.watchdog is None
+
+    def test_invariant_taxonomy_frozen(self):
+        # the obs-audit contract greps for these exact names
+        assert INVARIANTS == (
+            "claim_leak", "store_cloud_drift", "intent_age",
+            "warm_audit_lag", "warm_divergence", "fleet_starvation",
+            "profile_unattributed", "trace_ring_overflow")
+
+
+class TestTrips:
+    """One seeded fault per invariant; each asserts the no-fault side
+    too (the finding fires because of the fault, not despite it)."""
+
+    def test_trip_claim_leak(self):
+        sim = make_sim()
+        wd = sim.watchdog
+        wd.claim_grace = 50.0
+        sim.store.add_nodeclaim(NodeClaim(name="leak-1",
+                                          nodepool="default"))
+        _age(sim, 30)
+        assert not _findings(wd, "claim_leak")  # inside grace: quiet
+        _age(sim, 40)
+        found = _findings(wd, "claim_leak")
+        assert found and found[0].severity == "critical"
+        assert "unlaunched" in found[0].message
+        assert wd.verdict() == "critical"
+        from karpenter_tpu.metrics import WATCHDOG_FINDINGS
+        assert WATCHDOG_FINDINGS.value(invariant="claim_leak",
+                                       severity="critical") >= 1
+        # edge-triggered: the excursion fires once, not per tick
+        _age(sim, 100)
+        assert len(_findings(wd, "claim_leak")) == 1
+        # the claim resolving clears the excursion and the verdict
+        sim.store.delete_nodeclaim("leak-1")
+        wd.tick(force=True)
+        assert wd.verdict() == "ok"
+
+    def test_trip_claim_leak_duplicate_token(self):
+        """Two LIVE instances under one idempotency token — never
+        legitimate, fires with no grace at the next cloud sweep. The
+        cloud's own ledger dedupes honest replays, so the fault is
+        seeded the only way it can occur: tag corruption (a cloud-side
+        double-provision the ledger missed)."""
+        from karpenter_tpu.cloud.provider import (LaunchOverride,
+                                                  LaunchRequest)
+        from karpenter_tpu.models import labels as L
+        sim = make_sim()
+        wd = sim.watchdog
+        ov = [LaunchOverride(instance_type="c5.large", zone="zone-a",
+                             capacity_type="on-demand", price=0.1)]
+        insts = sim.cloud.create_fleet(
+            [LaunchRequest(nodeclaim_name=f"dup-{i}", overrides=ov)
+             for i in range(2)])
+        live = [i for i in insts if getattr(i, "id", None)]
+        assert len(live) == 2
+        for inst in live:
+            inst.tags[L.TAG_LAUNCH_TOKEN] = "tok-dup"
+        wd.tick(force=True)
+        found = _findings(wd, "claim_leak")
+        assert found and "token" in found[0].message
+
+    def test_trip_store_cloud_drift(self):
+        sim = make_sim()
+        wd = sim.watchdog
+        wd.drift_grace = 40.0
+        wd.CLOUD_SWEEP = 5.0
+        sim.store.add_node(Node(name="ghost",
+                                provider_id="tpu:///zone-a/i-nope"))
+        wd.tick(force=True)
+        assert not _findings(wd, "store_cloud_drift")  # first sighting
+        _age(sim, 60)
+        found = _findings(wd, "store_cloud_drift")
+        assert found and found[0].severity == "critical"
+        assert "ghost" in found[0].message
+        # repairing the store clears the excursion
+        sim.store.delete_node("ghost")
+        _age(sim, 20)
+        assert wd.verdict() == "ok"
+
+    def test_trip_intent_age(self):
+        from karpenter_tpu.controllers.gc import INTENT_GRACE
+        sim = make_sim()
+        wd = sim.watchdog
+        sim.journal.open_launch("wedged-claim", "default", "default",
+                                token="tok-wedge", now=sim.clock.now())
+        _age(sim, INTENT_GRACE * 0.8, step=20.0)
+        assert not _findings(wd, "intent_age")  # the GC shield window
+        _age(sim, INTENT_GRACE * 0.4, step=20.0)
+        found = _findings(wd, "intent_age")
+        assert found and found[0].severity == "critical"
+        assert "wedged-claim" in found[0].message
+
+    def test_trip_warm_audit_lag(self):
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        sim = make_sim(warmpath=True, warm_audit_every=999)
+        wd = sim.watchdog
+        pod = Pod(name="lagged",
+                  requests=Resources.parse({"cpu": "100m",
+                                            "memory": "64Mi"}))
+        # a recorded warm admission the lazy auditor never replays
+        sim.warmpath.auditor.record("default", [pod],
+                                    {"default/lagged": "claim-x"},
+                                    now=sim.clock.now())
+        _age(sim, 60)
+        assert not _findings(wd, "warm_audit_lag")
+        _age(sim, 100)
+        found = _findings(wd, "warm_audit_lag")
+        assert found and found[0].severity == "warning"
+        # the audit running clears the lag
+        sim.warmpath.auditor.audit()
+        wd.tick(force=True)
+        assert wd.verdict() == "ok"
+
+    def test_trip_warm_divergence(self):
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        sim = make_sim(warmpath=True)
+        wd = sim.watchdog
+        pod = Pod(name="div",
+                  requests=Resources.parse({"cpu": "100m",
+                                            "memory": "64Mi"}))
+        # a recorded batch with no committed baseline: the replay cannot
+        # vouch for it — a genuine divergence, metered and forced cold
+        sim.warmpath.auditor.record("default", [pod],
+                                    {"default/div": "claim-x"},
+                                    now=sim.clock.now())
+        sim.warmpath._run_audit()
+        assert sim.warmpath.stats["divergences"] >= 1
+        wd.tick(force=True)
+        found = _findings(wd, "warm_divergence")
+        assert found and found[0].severity == "warning"
+        assert "forced cold" in found[0].message
+
+    def test_trip_fleet_starvation(self):
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        from karpenter_tpu.fleet.service import SolverService
+        clock = FakeClock()
+        svc = SolverService(clock, backend="host")
+        svc.register("hog", CatalogProvider(lambda: small_catalog()))
+        svc.register("victim", CatalogProvider(lambda: small_catalog()))
+        wd = Watchdog(clock, service=svc).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "fleet_starvation")
+        # the hog queues seconds of virtual device time; its own later
+        # tickets wait behind its backlog past the starvation threshold
+        for _ in range(4):
+            svc.submit("hog", "solve", lambda: 1, cost=2.0)
+        svc.pump()
+        assert svc.tenants["hog"].max_wait >= wd.starvation_s
+        wd.tick(force=True)
+        found = _findings(wd, "fleet_starvation")
+        assert found and found[0].severity == "warning"
+        # backlog flavor: queued-but-undispatched tickets over the max
+        wd2 = Watchdog(clock, service=svc, backlog_max=2).arm()
+        for _ in range(4):
+            svc.submit("victim", "solve", lambda: 1, cost=0.001)
+        wd2.tick(force=True)
+        assert any(f.key == "backlog"
+                   for f in _findings(wd2, "fleet_starvation"))
+        svc.pump()
+
+    def test_trip_profile_unattributed(self):
+        from karpenter_tpu.obs.profile import LEDGER
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "profile_unattributed")
+        # a traced hot-path root whose wall time no bucket claims: the
+        # un-spanned-seam regression the coverage invariant exists for
+        root = Span(name="bench.gap", trace_id="gap1", span_id=1,
+                    parent_id=None, t0=0.0, t1=0.050, ts=0.0)
+        LEDGER.ingest(Trace(trace_id="gap1", spans=[root]))
+        clock.step(wd.interval + 1)
+        wd.tick(force=True)
+        found = _findings(wd, "profile_unattributed")
+        assert found and found[0].severity == "info"
+        assert found[0].attrs["gap_ms"] >= wd.UNATTRIBUTED_MS
+
+    def test_trip_trace_ring_overflow(self):
+        clock = FakeClock()
+        saved = TRACER.recorder
+        try:
+            TRACER.recorder = FlightRecorder(1)
+            wd = Watchdog(clock).arm()
+            slow = Trace(trace_id="slow", spans=[
+                Span(name="s", trace_id="slow", span_id=1,
+                     parent_id=None, t0=0.0, t1=1.0)])
+            TRACER.recorder.offer(slow)
+            wd.tick(force=True)
+            assert not _findings(wd, "trace_ring_overflow")
+            for i in range(wd.RING_DROPS + 5):
+                TRACER.recorder.offer(Trace(trace_id=f"f{i}", spans=[
+                    Span(name="s", trace_id=f"f{i}", span_id=1,
+                         parent_id=None, t0=0.0, t1=1e-6)]))
+            assert TRACER.recorder.dropped >= wd.RING_DROPS
+            clock.step(wd.interval + 1)
+            wd.tick(force=True)
+            found = _findings(wd, "trace_ring_overflow")
+            assert found and found[0].severity == "info"
+        finally:
+            TRACER.recorder = saved
+
+
+class TestClockJumpAbsorption:
+    def test_jump_does_not_age_claims(self):
+        """A +300s chaos ClockJump must not turn a healthy launch into
+        a fake leak — the stamp shift keeps observed ages continuous."""
+        sim = make_sim()
+        wd = sim.watchdog
+        wd.claim_grace = 200.0
+        sim.store.add_nodeclaim(NodeClaim(name="young",
+                                          nodepool="default"))
+        _age(sim, 20)
+        sim.clock.step(300.0)  # the skew event
+        wd.tick()
+        assert wd.stats["jump_absorbed"] >= 1
+        assert not _findings(wd, "claim_leak")
+        # and aging still works afterwards
+        _age(sim, 300)
+        assert _findings(wd, "claim_leak")
+
+
+class TestZeroFalsePositives:
+    """The existing catalogs with the watchdog armed: no warning or
+    critical findings, and the determinism contract intact."""
+
+    def test_chaos_smoke_clean_and_deterministic(self):
+        from karpenter_tpu.faults.runner import ScenarioRunner
+        reports = [ScenarioRunner("smoke", seed=7).run() for _ in range(2)]
+        for rep in reports:
+            assert rep.ok, rep.summary()
+            assert rep.stats["watchdog_findings_warning"] == 0
+            assert rep.stats["watchdog_evals"] > 0
+        assert reports[0].end_hash == reports[1].end_hash
+        assert (reports[0].fault_fingerprint
+                == reports[1].fault_fingerprint)
+
+    def test_restart_smoke_clean(self):
+        from karpenter_tpu.faults.runner import RestartRunner
+        rep = RestartRunner("restart_smoke", seed=1).run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["watchdog_findings_warning"] == 0
+
+    def test_fleet_smoke_clean(self):
+        from karpenter_tpu.fleet.runner import FleetRunner
+        runner = FleetRunner("fleet_smoke", tenants=3, seed=0)
+        rep = runner.run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["watchdog_findings"] == 0
+        assert runner.watchdog.verdict() == "ok"
+
+
+class TestCrossCheck:
+    def test_blind_spot_reported(self):
+        sim = make_sim()
+        wd = sim.watchdog
+        v = ["claim foo leaked: never launched (phase=Unknown)"]
+        blind = wd.cross_check(v)
+        assert blind and "blind spot" in blind[0]
+        assert "claim_leak" in blind[0]
+
+    def test_found_it_first_suppresses_blind_spot(self):
+        sim = make_sim()
+        wd = sim.watchdog
+        wd.claim_grace = 10.0
+        sim.store.add_nodeclaim(NodeClaim(name="leak-2",
+                                          nodepool="default"))
+        _age(sim, 30)
+        assert wd.fired("claim_leak")
+        blind = wd.cross_check(
+            ["claim leak-2 leaked: never launched (phase=Unknown)"])
+        assert blind == []
+
+    def test_unmapped_violations_ignored(self):
+        sim = make_sim()
+        assert sim.watchdog.cross_check(
+            ["7 interruption messages never consumed"]) == []
+
+
+class TestExpositionIntegration:
+    def test_debug_watchdog_route(self):
+        from karpenter_tpu.obs.exposition import render
+        sim = make_sim()
+        sim.watchdog.tick(force=True)
+        status, ctype, body = render("/debug/watchdog")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["armed"] and doc["verdict"] == "ok"
+        assert doc["invariants"] == list(INVARIANTS)
+        # the sim dying flips the route inactive (weakref contract)
+        del sim
+        import gc
+        gc.collect()
+        _, _, body = render("/debug/watchdog")
+        assert json.loads(body).get("inactive") is True
+
+    def test_readyz_reflects_verdict(self):
+        from karpenter_tpu.obs import exposition
+        from karpenter_tpu.obs.exposition import render
+        saved = dict(exposition.READINESS_PROBES)
+        exposition.READINESS_PROBES.clear()
+        try:
+            sim = make_sim()
+            wd = sim.watchdog
+            status, _, body = render("/readyz")
+            assert status == 200 and json.loads(body)["ready"] is True
+            wd.claim_grace = 10.0
+            sim.store.add_nodeclaim(NodeClaim(name="leak-3",
+                                              nodepool="default"))
+            _age(sim, 30)
+            assert wd.verdict() == "critical"
+            status, _, body = render("/readyz")
+            doc = json.loads(body)
+            assert status == 503 and doc["ready"] is False
+            assert any(p["verdict"] == "critical"
+                       for p in doc["probes"].values())
+            # the condition clearing restores readiness
+            sim.store.delete_nodeclaim("leak-3")
+            wd.tick(force=True)
+            status, _, _ = render("/readyz")
+            assert status == 200
+        finally:
+            exposition.READINESS_PROBES.clear()
+            exposition.READINESS_PROBES.update(saved)
+
+    def test_finding_lands_in_flight_recorder(self):
+        sim = make_sim()
+        wd = sim.watchdog
+        wd.claim_grace = 10.0
+        sim.store.add_nodeclaim(NodeClaim(name="leak-4",
+                                          nodepool="default"))
+        _age(sim, 30)
+        assert any(t.trace_id.startswith("watchdog-claim_leak")
+                   for t in TRACER.recorder.slowest())
+
+
+class TestOverhead:
+    def test_rate_limited_tick_is_cheap(self):
+        """The engine calls tick() every engine tick; between
+        evaluations it must be one compare-and-return — the <1%-of-c7
+        overhead budget depends on it."""
+        import time
+        sim = make_sim()
+        wd = sim.watchdog
+        wd.tick(force=True)
+        now = sim.clock.now()  # frozen: every call rate-limits out
+        t0 = time.perf_counter()
+        for _ in range(10_000):
+            wd.tick(now)
+        per_call = (time.perf_counter() - t0) / 10_000
+        assert per_call < 50e-6, f"rate-limited tick {per_call * 1e6:.1f}us"
+
+    def test_full_evaluation_bounded(self):
+        import time
+        sim = make_sim()
+        for i in range(50):
+            sim.store.add_nodeclaim(NodeClaim(name=f"w-{i}",
+                                              nodepool="default"))
+        t0 = time.perf_counter()
+        for _ in range(20):
+            sim.clock.step(sim.watchdog.interval + 1)
+            sim.watchdog.tick()
+        per_eval = (time.perf_counter() - t0) / 20
+        assert per_eval < 5e-3, f"evaluation {per_eval * 1e3:.2f}ms"
+
+
+@pytest.mark.slow
+class TestCatalogSoak:
+    def test_ice_storm_clean(self):
+        from karpenter_tpu.faults.runner import ScenarioRunner
+        rep = ScenarioRunner("ice_storm", seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.stats["watchdog_findings_warning"] == 0
+
+
+class TestReviewFixes:
+    """Regression guards for the review findings on the first cut."""
+
+    def test_duplicate_token_excursion_clears_on_termination(self):
+        from karpenter_tpu.cloud.provider import (LaunchOverride,
+                                                  LaunchRequest)
+        from karpenter_tpu.models import labels as L
+        sim = make_sim()
+        wd = sim.watchdog
+        ov = [LaunchOverride(instance_type="c5.large", zone="zone-a",
+                             capacity_type="on-demand", price=0.1)]
+        live = [i for i in sim.cloud.create_fleet(
+            [LaunchRequest(nodeclaim_name=f"dupfix-{i}", overrides=ov)
+             for i in range(2)]) if getattr(i, "id", None)]
+        for inst in live:
+            inst.tags[L.TAG_LAUNCH_TOKEN] = "tok-fix"
+        wd.tick(force=True)
+        assert wd.verdict() == "critical"
+        # the operator terminates one copy: the excursion must clear —
+        # a resolved duplicate cannot hold /readyz at 503 forever
+        sim.cloud.terminate([live[0].id])
+        sim.clock.step(wd.interval + 1)
+        wd.tick(force=True)
+        assert wd.verdict() == "ok"
+
+    def test_verdict_survives_findings_log_trim(self):
+        """A live critical excursion must keep the verdict critical
+        even after MAX_FINDINGS of newer churn evicted its log entry."""
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        wd._fire([], "claim_leak", "critical", "pinned", "live leak",
+                 clock.now())
+        for i in range(wd.MAX_FINDINGS + 10):
+            wd._fire([], "profile_unattributed", "info", f"churn-{i}",
+                     "meter churn", clock.now())
+        assert not any(f.key == "pinned" for f in wd.findings)  # evicted
+        assert wd.verdict() == "critical"                       # not amnestied
+
+    def test_jump_does_not_fake_warm_audit_lag(self):
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        sim = make_sim(warmpath=True, warm_audit_every=999)
+        wd = sim.watchdog
+        pod = Pod(name="j", requests=Resources.parse(
+            {"cpu": "100m", "memory": "64Mi"}))
+        sim.warmpath.auditor.record("default", [pod], {"default/j": "c"},
+                                    now=sim.clock.now())
+        _age(sim, 20)  # watchdog observes the pending window
+        sim.clock.step(3600.0)  # the skew event
+        wd.tick()
+        assert not _findings(wd, "warm_audit_lag"), \
+            "a clock jump aged a seconds-old batch into a finding"
+        # genuine lag afterwards still fires
+        _age(sim, 200)
+        assert _findings(wd, "warm_audit_lag")
+
+    def test_marker_rejection_does_not_self_trip_overflow(self):
+        """Findings whose marker traces the slowest-N ring rejects must
+        not count toward the trace_ring_overflow meter."""
+        clock = FakeClock()
+        saved = TRACER.recorder
+        try:
+            TRACER.recorder = FlightRecorder(1)
+            # fill the ring with a slow real trace: every near-zero-
+            # duration marker will be rejected
+            TRACER.recorder.offer(Trace(trace_id="slow", spans=[
+                Span(name="s", trace_id="slow", span_id=1,
+                     parent_id=None, t0=0.0, t1=1.0)]))
+            wd = Watchdog(clock).arm()
+            for i in range(wd.RING_DROPS + 5):
+                wd._fire([], "claim_leak", "critical", f"m-{i}", "x",
+                         clock.now())
+            clock.step(wd.interval + 1)
+            wd.tick(force=True)
+            assert not _findings(wd, "trace_ring_overflow")
+        finally:
+            TRACER.recorder = saved

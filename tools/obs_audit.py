@@ -1,6 +1,6 @@
 """Observability drift audit — `make obs-audit`.
 
-Two invariants that otherwise rot silently:
+Three invariants that otherwise rot silently:
 
 1. every metric family registered at import time appears in
    docs/reference/metrics.md (the generated page a new family is easy
@@ -10,7 +10,11 @@ Two invariants that otherwise rot silently:
    tests/test_observatory.py on purpose: common-word buckets ("launch",
    "commit", "dispatch"...) appear all over tests/ for unrelated
    reasons, and a repo-wide grep would keep this check green after the
-   actual bucket tests were deleted.
+   actual bucket tests were deleted;
+3. every watchdog invariant (obs/watchdog.INVARIANTS) has MUTATION-
+   STYLE negative coverage in tests/test_watchdog.py: a seeded fault
+   scenario that TRIPS it (`def test_trip_<invariant>`) — a monitor
+   nothing can trip is dead code wearing a green badge.
 
 Exit 0 = no drift. Wired into the default verify path (`make test`
 depends on this).
@@ -29,6 +33,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def audit() -> int:
     from karpenter_tpu import metrics as M
     from karpenter_tpu.obs.profile import PHASES
+    from karpenter_tpu.obs.watchdog import INVARIANTS
 
     failures = []
 
@@ -51,13 +56,26 @@ def audit() -> int:
                 f"ledger phase bucket '{phase}' is in the taxonomy but "
                 f"tests/test_observatory.py does not exercise it")
 
+    wd_canon = os.path.join(ROOT, "tests", "test_watchdog.py")
+    wd_tests = open(wd_canon).read() if os.path.exists(wd_canon) else ""
+    if not wd_tests:
+        failures.append("tests/test_watchdog.py (the canonical watchdog "
+                        "trip tests) is missing")
+    for inv in INVARIANTS:
+        if f"def test_trip_{inv}" not in wd_tests:
+            failures.append(
+                f"watchdog invariant '{inv}' has no seeded fault scenario "
+                f"tripping it — tests/test_watchdog.py needs a "
+                f"`def test_trip_{inv}` (mutation-style negative coverage)")
+
     if failures:
         print("obs-audit: DRIFT DETECTED")
         for f in failures:
             print(f"  - {f}")
         return 1
     print(f"obs-audit: ok ({len(M.REGISTRY._metrics)} metric families "
-          f"documented, {len(PHASES)} phase buckets test-covered)")
+          f"documented, {len(PHASES)} phase buckets test-covered, "
+          f"{len(INVARIANTS)} watchdog invariants trip-covered)")
     return 0
 
 

@@ -1,0 +1,70 @@
+"""Cross-run perf regression gate — `make perf-gate`.
+
+Loads the perf archive (the `perf_archive.jsonl` ledger plus the
+checked-in legacy `BENCH_r*.json`/`MULTICHIP_r*.json` wrappers), prints
+the run trajectory, and gates the newest STAMPED comparable run against
+the robust (median/MAD) baselines of every other comparable run.
+Non-comparable runs (CPU fallback — the r05 pollution) are excluded
+from baselines by construction and are never selected as candidates.
+
+Exit 0 = no regression verdicts (including "nothing stamped to gate
+yet"); exit 1 = at least one metric regressed past both the relative
+and the dispersion threshold (obs/perfarchive.py documents the rule).
+
+Usage:
+    python tools/perf_gate.py [--archive PATH] [--root DIR]
+                              [--candidate RUN_ID] [--family bench|mesh]
+                              [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from karpenter_tpu.obs.perfarchive import PerfArchive
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--archive", default=None,
+                    help="archive JSONL path (default: repo root "
+                         "perf_archive.jsonl or $KARPENTER_TPU_PERF_ARCHIVE)")
+    ap.add_argument("--root", default=None,
+                    help="directory scanned for legacy BENCH_r*/MULTICHIP_r* "
+                         "wrappers (default: the archive's directory)")
+    ap.add_argument("--candidate", default=None,
+                    help="gate a specific run_id instead of the newest "
+                         "stamped comparable run")
+    ap.add_argument("--family", default="bench", choices=("bench", "mesh"))
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    if args.archive is not None:
+        archive = PerfArchive(args.archive, root=args.root)
+    elif args.root is not None:
+        archive = PerfArchive(root=args.root)
+    else:
+        archive = PerfArchive.default()
+    runs = archive.load()
+    report = archive.gate(runs, candidate=args.candidate,
+                          family=args.family)
+    if args.json:
+        print(json.dumps({
+            "candidate": report.candidate, "reason": report.reason,
+            "ok": report.ok,
+            "verdicts": [vars(v) for v in report.verdicts]}))
+    else:
+        print(archive.trajectory(runs, family=args.family))
+        print()
+        print(report.summary())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
