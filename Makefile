@@ -49,12 +49,15 @@ warmpath-audit:  ## warm-path auditor in always-on mode over the chaos smoke + s
 encode-report:  ## columnar encode pipeline: cold vs cached cost + hit rate (PODS=n TICKS=n)
 	$(PY) tools/encode_report.py --pods $(or $(PODS),10000) --ticks $(or $(TICKS),5)
 
-fleet:  ## drive TENANTS (default 50) tenant control planes through one process + one SolverService
+fleet:  ## drive TENANTS (default 50) tenant control planes through one process + one SolverService (serial, then batched dispatch)
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --tenants $(or $(TENANTS),50)
+	$(PY) -m karpenter_tpu.fleet fleet_smoke --tenants $(or $(TENANTS),50) --batch
 	$(PY) -m karpenter_tpu.fleet fleet_noisy_neighbor
+	$(PY) -m karpenter_tpu.fleet fleet_noisy_neighbor --batch
 
-fleet-audit:  ## fleet reproducibility: fleet_smoke at 2 seeds x --repeat 2, identical per-tenant end-state hashes required
+fleet-audit:  ## fleet reproducibility: fleet_smoke at 2 seeds x --repeat 2, identical per-tenant end-state hashes required (batched dispatch must repeat too)
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --seeds 2 --repeat 2
+	$(PY) -m karpenter_tpu.fleet fleet_smoke --seeds 1 --repeat 2 --batch
 
 docgen:  ## regenerate docs/reference/* from the live registry + catalog
 	$(PY) tools/gen_docs.py

@@ -678,6 +678,50 @@ def main() -> None:
             assert out.launches
     fleet_s = time.perf_counter() - t0
 
+    # regime 4 — serial DEVICE dispatch through the service: the same
+    # queue, every solve its own kernel call — the apples-to-apples
+    # baseline the batched regime's gain is measured against at EQUAL
+    # backend (the host-backend regimes above can't show dispatch/RTT
+    # amortization because they never pay it).
+    progress("c12: batched + pipelined dispatch (device backend)")
+    service12d = SolverService(_Clock12(), backend="device")
+    clients12d = [service12d.register(f"d{t:03d}",
+                                      CatalogProvider(lambda: types12))
+                  for t in range(N12)]
+    for t in range(N12):  # warm: compile the serial executable
+        clients12d[t].solve(bursts12[t], pool12)
+    t0 = time.perf_counter()
+    for _ in range(R12):
+        for t in range(N12):
+            out = clients12d[t].solve(bursts12[t], pool12)
+            assert out.launches
+    device_serial_s = time.perf_counter() - t0
+
+    # regime 5 — BATCHED + PIPELINED dispatch (ROADMAP item 2): the same
+    # 16 tenants submit each round ASYNC, so the round's compatible
+    # solves share ONE vmapped device call along a leading request axis
+    # (shape-class bucketing + the shared catalog make them one bucket),
+    # and encode/decode for batch k+1 overlaps device work for batch k.
+    service12b = SolverService(_Clock12(), backend="device", batch=True)
+    clients12b = [service12b.register(f"x{t:03d}",
+                                      CatalogProvider(lambda: types12))
+                  for t in range(N12)]
+    warm12b = [clients12b[t].solve_async(bursts12[t], pool12)
+               for t in range(N12)]
+    service12b.pump()  # warm: compiles the batched executable
+    for tk in warm12b:
+        assert tk.result().launches
+    round_walls = []
+    for _ in range(R12):
+        r0 = time.perf_counter()
+        tickets12b = [clients12b[t].solve_async(bursts12[t], pool12)
+                      for t in range(N12)]
+        service12b.pump()
+        for tk in tickets12b:
+            assert tk.result().launches
+        round_walls.append(time.perf_counter() - r0)
+    batched_s = sum(round_walls)
+
     # one traced extra round through the service (untimed): the ledger's
     # per-TENANT solve attribution — pump() scopes each dispatch to its
     # ticket's tenant, so phases land on b000..b015 series, which is
@@ -685,6 +729,15 @@ def main() -> None:
     TRACER.configure(enabled=True)
     for t in range(N12):
         clients12[t].solve(bursts12[t], pool12)
+    # ...and one traced BATCHED round so batch_pack/pipeline_wait land
+    # in the ledger (the taxonomy buckets this engine answers to). No
+    # explicit wrapper: `fleet.pump` roots the trace and is itself a
+    # mapped span, so even the pump's own glue attributes (coverage 1.0)
+    traced12b = [clients12b[t].solve_async(bursts12[t], pool12)
+                 for t in range(N12)]
+    service12b.pump()
+    for tk in traced12b:
+        tk.result()
     TRACER.configure(enabled=False)
 
     solves12 = N12 * R12
@@ -703,6 +756,41 @@ def main() -> None:
     if serial_s < 5 * fleet_s:
         progress(f"FLEET BELOW 5x: fleet {solves12 / fleet_s:.0f}/s vs "
                  f"serial {solves12 / serial_s:.0f}/s")
+    # batched-dispatch keys (ISSUE 9 acceptance: >=10x aggregate
+    # solves/sec vs the serial-facade baseline on a comparable TPU run;
+    # stamped through the run-stamp machinery so `make perf-gate`
+    # baselines them from this run forward)
+    sb = service12b.stats
+    detail["c12_device_serial_solves_per_sec"] = round(
+        solves12 / device_serial_s, 1)
+    detail["c12_fleet_batched_solves_per_sec"] = round(
+        solves12 / batched_s, 1)
+    detail["c12_batched_vs_serial"] = round(serial_s / batched_s, 1)
+    detail["c12_batched_vs_device_serial"] = round(
+        device_serial_s / batched_s, 2)
+    detail["c12_batches"] = int(sb["batches"])
+    detail["c12_batch_size_mean"] = round(
+        sb["batched_tickets"] / max(sb["batches"], 1), 2)
+    detail["c12_batch_size_max"] = int(sb["max_batch_size"])
+    # occupancy: real requests / padded request-axis slots (1.0 = no
+    # padding waste) — the batch-axis analog of the node-bucket waste
+    detail["c12_batch_occupancy"] = round(
+        sb["batched_tickets"] / max(sb["padded_slots"], 1), 3)
+    detail["c12_pipeline_overlap_ratio"] = round(
+        service12b.pipeline_overlap_ratio(), 3)
+    # per-request latency bound under the 16-tenant burst: every ticket
+    # in a round resolves when its pump drains, so the worst round wall
+    # upper-bounds every request's submit->result latency (the ISSUE 9
+    # p99 < 150ms acceptance reads this key on a comparable TPU run)
+    detail["c12_batched_request_p99_ms"] = round(
+        max(round_walls) * 1e3, 1)
+    # the headline batched key (ISSUE 9 acceptance):
+    detail["fleet_batched_solves_per_sec"] = \
+        detail["c12_fleet_batched_solves_per_sec"]
+    if serial_s < 10 * batched_s:
+        progress(f"BATCHED FLEET BELOW 10x: batched "
+                 f"{solves12 / batched_s:.0f}/s vs serial "
+                 f"{solves12 / serial_s:.0f}/s")
 
     progress("profile: writing profile_bench.json (phase attribution)")
     # --- the phase-attribution artifact (obs/profile.py): everything the

@@ -70,6 +70,11 @@ def main(argv=None) -> int:
     ap.add_argument("--backend", default="host",
                     help="shared solver backend (host | native | device "
                          "| hybrid | mesh)")
+    ap.add_argument("--batch", action="store_true",
+                    help="arm the service's batched+pipelined dispatch "
+                         "engine (per-tenant hashes and fingerprints are "
+                         "identical with it on or off — rerun a scenario "
+                         "both ways to audit that contract)")
     ap.add_argument("--journal-dir", default="",
                     help="directory for per-tenant intent-journal WAL "
                          "files (empty: in-memory journals)")
@@ -84,6 +89,7 @@ def main(argv=None) -> int:
     failed = run_matrix(args.scenario, seeds, repeat=args.repeat,
                         tenants=args.tenants or None,
                         backend=args.backend,
+                        batch=args.batch or None,
                         inflight_cap=args.inflight_cap or None,
                         journal_dir=args.journal_dir or None)
     return 1 if failed else 0

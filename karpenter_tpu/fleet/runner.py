@@ -90,7 +90,8 @@ class FleetRunner:
                  seed: int = 0, backend: str = "host",
                  inflight_cap: Optional[int] = None,
                  journal_dir: Optional[str] = None,
-                 warmpath: Optional[bool] = None):
+                 warmpath: Optional[bool] = None,
+                 batch: Optional[bool] = None):
         self.scenario: FleetScenario = (
             scenario if isinstance(scenario, FleetScenario)
             else get_fleet_scenario(scenario))
@@ -102,6 +103,11 @@ class FleetRunner:
         self.journal_dir = journal_dir
         self.warmpath = (self.scenario.warmpath if warmpath is None
                          else warmpath)
+        # batched dispatch is an EXECUTION detail of the shared service:
+        # per-tenant end-state hashes and fault fingerprints must be
+        # identical armed or not (the chaos parity contract —
+        # tests/test_fleet.py compares a run each way)
+        self.batch = self.scenario.batch if batch is None else bool(batch)
         self.clock: Optional[FakeClock] = None
         self.service: Optional[SolverService] = None
         self.shards: List[TenantShard] = []
@@ -118,7 +124,8 @@ class FleetRunner:
         self.origin = self.clock.now()
         self.service = SolverService(self.clock, backend=self.backend,
                                      inflight_cap=self.inflight_cap,
-                                     quantum=sc.quantum, window=sc.window)
+                                     quantum=sc.quantum, window=sc.window,
+                                     batch=self.batch)
         self.shards = []
         for i in range(self.tenants):
             name = f"t{i:03d}"
@@ -219,6 +226,11 @@ class FleetRunner:
         if wall > 0:
             stats["aggregate_solves_per_wall_sec"] = round(
                 svc.stats["dispatched"] / wall, 1)
+        if svc.batch:
+            stats["solve_batches"] = float(svc.stats["batches"])
+            stats["batched_tickets"] = float(svc.stats["batched_tickets"])
+            stats["pipeline_overlap_ratio"] = round(
+                svc.pipeline_overlap_ratio(), 4)
         if warm_div:
             stats["warm_divergences"] = warm_div
         stats["slo_alerts"] = float(len(self.slo.alerts))

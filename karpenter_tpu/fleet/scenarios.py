@@ -35,6 +35,9 @@ class FleetScenario:
     timeout: float = 300.0           # sim-seconds deadline
     step: float = 0.5
     warmpath: bool = False
+    # arm the service's batched+pipelined dispatcher (--batch overrides);
+    # hashes/fingerprints are identical either way — the chaos contract
+    batch: bool = False
     inflight_cap: Optional[int] = None   # SolverService override
     window: Optional[float] = None
     quantum: Optional[float] = None

@@ -37,6 +37,21 @@ to meter waits and starvation reproducibly — identical seeds produce
 identical wait histograms AND identical cluster end states. Throttling
 is count-based (submissions per window), so it is seed-deterministic
 too.
+
+Batched dispatch (`batch=True`): pump() becomes a pipelined dispatcher.
+Every queued ticket is STAGED first (the facade's prepare_solve — all
+host-side work: catalog view, encode, spread, backend choice), then
+tickets whose padded shape class AND device catalog agree pack into ONE
+vmapped device call (ops/solver.dispatch_batch) along a leading request
+axis; while that batch executes on the device, the pump stages/uploads
+the next bucket and runs non-batchable tickets' host solves — the
+encode→upload→dispatch→decode double-buffer (ROADMAP item 2). Results
+are byte-identical to serial dispatch (tests/test_batch_parity.py), the
+DRR order still decides staging AND bucket order (a bucket dispatches at
+its earliest member's rank, so a lone odd-shaped tenant is never pushed
+behind the big class), and the virtual timeline is untouched — batching
+is an execution detail, not a scheduling one, so waits, hashes, and
+fault fingerprints repeat exactly as the serial pump produces them.
 """
 
 from __future__ import annotations
@@ -46,8 +61,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..cloud.provider import CloudError
-from ..metrics import (FLEET_SOLVE_WAIT, FLEET_SOLVES, FLEET_STARVATION,
-                       FLEET_THROTTLED)
+from ..metrics import (FLEET_BATCH_SIZE, FLEET_SHAPE_CLASS,
+                       FLEET_SOLVE_WAIT, FLEET_SOLVES, FLEET_STARVATION,
+                       FLEET_THROTTLED, PIPELINE_INFLIGHT)
 from ..obs.tracer import NOOP_SPAN, TRACER
 
 
@@ -72,6 +88,10 @@ class SolveTicket:
     value: object = None
     error: Optional[BaseException] = None
     wait: float = 0.0         # virtual queueing delay, seconds
+    # batched-dispatch provenance (0/-1/"" on the serial pump):
+    batch_size: int = 0       # requests in the device call that served it
+    shape_class: str = ""     # padded solve signature ("g<Gp>/n<n_max>")
+    dispatch_rank: int = -1   # DRR drain position within its pump
 
     def result(self):
         """Block on the future. The fleet is single-threaded, so by the
@@ -98,12 +118,8 @@ class TenantSolverClient:
         self.facade = facade
 
     def solve(self, pods, *args, **kwargs):
-        cost = self._service.cost_model(len(pods))
         try:
-            return self._service.call(
-                self.tenant, "solve",
-                lambda: self.facade.solve(pods, *args, **kwargs),
-                cost=cost, pods=len(pods))
+            ticket = self._submit(pods, args, kwargs)
         except SolverServiceBusy:
             # decision provenance for the refusal: the solve never ran,
             # so the solver can't explain these pods — the throttle
@@ -116,6 +132,29 @@ class TenantSolverClient:
                     self.tenant,
                     [f"{p.namespace}/{p.name}" for p in pods])
             raise
+        self._service.pump()
+        return ticket.result()
+
+    def solve_async(self, pods, *args, **kwargs) -> SolveTicket:
+        """Submit without pumping: the ticket resolves at the service's
+        next pump(), co-batching with whatever else is queued by then —
+        the API drivers that CAN defer (bench c12's burst rounds, batch
+        tests) use to actually fill the request axis. Throttles exactly
+        like solve()."""
+        try:
+            return self._submit(pods, args, kwargs)
+        except SolverServiceBusy:
+            from ..obs.explain import RECORDER
+            if RECORDER.enabled:
+                RECORDER.note_throttle(
+                    self.tenant,
+                    [f"{p.namespace}/{p.name}" for p in pods])
+            raise
+
+    def _submit(self, pods, args, kwargs) -> SolveTicket:
+        cost = self._service.cost_model(len(pods))
+        return self._service.submit_solve(self.tenant, pods, args, kwargs,
+                                          cost=cost)
 
     def __getattr__(self, name):
         return getattr(self.facade, name)
@@ -126,6 +165,11 @@ class _TenantState:
     # jobs dispatched this window, in arrival order: (seq, cost)
     window_jobs: List[Tuple[int, float]] = field(default_factory=list)
     window_cost: float = 0.0
+    # tickets submitted but not yet picked by a pump: counted against
+    # the in-flight cap alongside window_jobs, or solve_async could
+    # queue an unbounded storm between pumps (the cap only ever grew on
+    # DISPATCH, which synchronous callers could never outrun)
+    queued: int = 0
     max_wait: float = 0.0          # worst wait this window (starvation)
     solves: int = 0                # lifetime dispatches
     throttled: int = 0             # lifetime cap rejections
@@ -152,12 +196,18 @@ class SolverService:
     WINDOW = 5.0
     # per-tenant dispatch cap per window (--fleet-inflight-cap)
     INFLIGHT_CAP = 16
+    # most requests one batched device call may pack (the leading axis
+    # is padded to {1,2,3,4,6,8,12,16,...} buckets, so this also bounds
+    # the executable population per shape class)
+    MAX_BATCH = 16
 
     def __init__(self, clock, backend: str = "host",
                  inflight_cap: Optional[int] = None,
                  quantum: Optional[float] = None,
                  window: Optional[float] = None,
-                 shared_catalog=None):
+                 shared_catalog=None,
+                 batch: bool = False,
+                 max_batch: Optional[int] = None):
         from ..ops.facade import SharedCatalogCache
         self.clock = clock
         self.backend = backend
@@ -167,13 +217,30 @@ class SolverService:
         self.window = self.WINDOW if window is None else float(window)
         self.shared_catalog = (shared_catalog if shared_catalog is not None
                                else SharedCatalogCache())
+        # batched+pipelined dispatch (module docstring): results and the
+        # virtual timeline are identical either way — the flag swaps the
+        # execution engine, not the scheduler
+        self.batch = bool(batch)
+        self.max_batch = (self.MAX_BATCH if max_batch is None
+                          else int(max_batch))
         self.tenants: Dict[str, _TenantState] = {}
         self.clients: Dict[str, TenantSolverClient] = {}
         self._queue: List[SolveTicket] = []
         self._window_start = float(clock.now())
         self._seq = 0
         self.stats: Dict[str, float] = {"dispatched": 0, "throttled": 0,
-                                        "windows": 0}
+                                        "windows": 0, "batches": 0,
+                                        "batched_tickets": 0,
+                                        "padded_slots": 0,
+                                        "pipeline_wait_s": 0.0,
+                                        "pipeline_span_s": 0.0,
+                                        "max_batch_size": 0}
+        # batched-pipeline observables (the watchdog's pipeline_stall
+        # invariant reads these): sim time the current in-flight batch
+        # was dispatched at (None = pipeline drained), and per-shape-
+        # class co-batching effectiveness counters
+        self._inflight_since: Optional[float] = None
+        self.class_stats: Dict[str, Dict[str, int]] = {}
         # /debug/fleet on both exposition servers: the live per-tenant
         # queue/throttle/starvation view (last-built service wins). The
         # route table holds the service by WEAKREF — the uniform debug-
@@ -224,7 +291,7 @@ class SolverService:
         now = float(self.clock.now())
         self._roll_window(now)
         state = self.tenants[tenant]
-        if len(state.window_jobs) >= self.inflight_cap:
+        if len(state.window_jobs) + state.queued >= self.inflight_cap:
             state.throttled += 1
             self.stats["throttled"] += 1
             FLEET_THROTTLED.inc(tenant=tenant)
@@ -240,13 +307,38 @@ class SolverService:
                              pods=pods, seq=ticket.seq):
                 pass
         self._queue.append(ticket)
+        state.queued += 1
+        return ticket
+
+    def submit_solve(self, tenant: str, pods, args=(), kwargs=None,
+                     cost: Optional[float] = None) -> SolveTicket:
+        """Queue a STRUCTURED solve request: unlike an opaque thunk, the
+        batched pump can stage it (facade.prepare_solve), read its
+        padded shape class, and pack it into a shared device call. The
+        thunk fallback keeps the serial pump and any legacy path
+        byte-equivalent."""
+        kwargs = kwargs or {}
+        if cost is None:
+            cost = self.cost_model(len(pods))
+        facade = self.clients[tenant].facade
+        ticket = self.submit(
+            tenant, "solve",
+            lambda: facade.solve(pods, *args, **kwargs),
+            cost=cost, pods=len(pods))
+        ticket._request = (pods, tuple(args), dict(kwargs))
         return ticket
 
     def pump(self) -> None:
         """Dispatch every queued ticket in deficit-round-robin order.
         Execution is synchronous (the fleet is one thread); the DRR
         replay decides each ticket's VIRTUAL start on the shared device
-        timeline, which is what the wait/starvation metrics expose."""
+        timeline, which is what the wait/starvation metrics expose.
+        With `batch=True` the batched pipeline below serves the same
+        contract (every queued ticket done on return) while packing
+        compatible requests into shared device calls."""
+        if self.batch:
+            self._pump_batched()
+            return
         import time as _time
         while self._queue:
             ticket = self._pick_next()
@@ -274,18 +366,358 @@ class SolverService:
             except BaseException as e:  # noqa: BLE001 — the future carries it
                 ticket.error = e
             finally:
-                ticket.done = True
-                del ticket._thunk
-                state.wall_seconds += _time.perf_counter() - t0
-                state.solves += 1
-                self.stats["dispatched"] += 1
-                now = float(self.clock.now())
-                state.max_wait = max(state.max_wait, ticket.wait)
-                state.samples.append((now, ticket.wait, ticket.cost))
-                FLEET_SOLVES.inc(tenant=ticket.tenant)
-                FLEET_SOLVE_WAIT.observe(ticket.wait * 1e3,
-                                         tenant=ticket.tenant)
-                FLEET_STARVATION.set(state.max_wait, tenant=ticket.tenant)
+                self._complete(ticket, _time.perf_counter() - t0)
+
+    def _complete(self, ticket: SolveTicket, host_s: float) -> None:
+        """Per-ticket completion bookkeeping — the ONE place both pumps
+        settle a future, so samples/metrics cannot drift between the
+        serial and batched engines."""
+        state = self.tenants[ticket.tenant]
+        ticket.done = True
+        for attr in ("_thunk", "_request"):
+            if hasattr(ticket, attr):
+                delattr(ticket, attr)
+        state.wall_seconds += host_s
+        state.solves += 1
+        self.stats["dispatched"] += 1
+        now = float(self.clock.now())
+        state.max_wait = max(state.max_wait, ticket.wait)
+        state.samples.append((now, ticket.wait, ticket.cost))
+        FLEET_SOLVES.inc(tenant=ticket.tenant)
+        FLEET_SOLVE_WAIT.observe(ticket.wait * 1e3, tenant=ticket.tenant)
+        FLEET_STARVATION.set(state.max_wait, tenant=ticket.tenant)
+
+    # --- the batched, pipelined pump --------------------------------------
+    def _pump_batched(self) -> None:
+        """Stage -> bucket -> pipelined dispatch.
+
+        1. Drain the queue in EXACTLY the serial pump's DRR order (same
+           window bookkeeping, same virtual waits).
+        2. Stage each structured ticket through its facade's
+           prepare_solve (host work) and classify it: terminal (prepare
+           produced the output), batchable (device backend, fresh
+           solve), or serial (host/native, existing nodes, thunks).
+        3. Bucket batchable tickets by (shape class, device catalog) in
+           rank order — a bucket dispatches at its EARLIEST member's
+           rank, so the big class can never push a lone odd-shaped
+           tenant to the back.
+        4. Pipeline: dispatch bucket k+1's device call before draining
+           bucket k; serial tickets run on the host while a batch is in
+           flight. One batch in flight at a time (double buffering)."""
+        # one enclosing span so the pump's own glue (DRR replay,
+        # bucketing, completion bookkeeping) attributes to queue_wait —
+        # the ledger's >=99% coverage invariant must stay green with
+        # batching armed
+        sp = (TRACER.span("fleet.pump", queued=len(self._queue))
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            self._pump_batched_inner()
+
+    def _pump_batched_inner(self) -> None:
+        ordered: List[SolveTicket] = []
+        while self._queue:
+            ticket = self._pick_next()
+            state = self.tenants[ticket.tenant]
+            state.window_jobs.append((ticket.seq, ticket.cost))
+            state.window_cost += ticket.cost
+            ticket.wait = self._virtual_wait(ticket)
+            ticket.dispatch_rank = len(ordered)
+            ordered.append(ticket)
+        if not ordered:
+            return
+        # LEASE the encode arena of every facade staging MORE THAN ONE
+        # ticket this pump: an EncodedPods staged for batching holds
+        # views into its facade's staging arena, valid only "until the
+        # NEXT encode leases it" — and this pump interleaves encodes
+        # before any dispatch. Pre-leasing makes those facades' staged
+        # encodes take the arena's nested-encode path (fresh allocations
+        # the enc owns), so ticket k's tensors cannot be overwritten by
+        # ticket k+1's stage. Arenas are PER FACADE, so a facade staging
+        # exactly one encode (the dominant case: one ticket per tenant
+        # per pump) cannot self-clobber — it keeps the zero-copy fast
+        # path, exactly like the serial pump.
+        from collections import Counter
+        per_tenant = Counter(t.tenant for t in ordered)
+        leases: List[object] = []
+        try:
+            for tenant, n in per_tenant.items():
+                if n < 2:
+                    continue
+                client = self.clients.get(tenant)
+                arena = getattr(getattr(client, "facade", None), "_arena",
+                                None)
+                if arena is not None and arena.acquire():
+                    leases.append(arena)
+            self._stage_and_dispatch(ordered)
+        finally:
+            for arena in leases:
+                arena.release()
+
+    def _stage_and_dispatch(self, ordered: List[SolveTicket]) -> None:
+        import time as _time
+
+        from ..metrics.tenant import tenant_scope
+        # --- stage ---------------------------------------------------
+        staged: List[dict] = []
+        for ticket in ordered:
+            entry = {"ticket": ticket, "prep": None, "batchable": None,
+                     "mode": "thunk", "host_s": 0.0}
+            req = getattr(ticket, "_request", None)
+            client = self.clients.get(ticket.tenant)
+            if req is not None and client is not None:
+                pods, args, kwargs = req
+                sp = (TRACER.span("fleet.batch_stage", tenant=ticket.tenant,
+                                  seq=ticket.seq, pods=len(pods))
+                      if TRACER.enabled else NOOP_SPAN)
+                t0 = _time.perf_counter()
+                try:
+                    with tenant_scope(ticket.tenant), sp:
+                        prep = client.facade.prepare_solve(pods, *args,
+                                                           **kwargs)
+                        entry["prep"] = prep
+                        if prep.output is not None:
+                            entry["mode"] = "done"
+                        else:
+                            b = client.facade.stage_batchable(prep)
+                            entry["batchable"] = b
+                            entry["mode"] = "batch" if b is not None \
+                                else "serial"
+                except BaseException as e:  # noqa: BLE001 — future carries it
+                    ticket.error = e
+                    entry["mode"] = "done"
+                entry["host_s"] = _time.perf_counter() - t0
+                if entry["mode"] == "done":
+                    if ticket.error is None:
+                        ticket.value = prep.output
+                    # the serial pump wraps EVERY ticket in a
+                    # fleet.dispatch span carrying wait_ms, which the
+                    # phase ledger sums into virtual_queue_wait_ms —
+                    # prepare-terminal tickets (empty catalog,
+                    # colocation-only, zero groups) must not vanish
+                    # from that series under batching
+                    if TRACER.enabled:
+                        with TRACER.span(
+                                "fleet.dispatch", tenant=ticket.tenant,
+                                kind=ticket.kind, seq=ticket.seq,
+                                batched=True, terminal=True,
+                                wait_ms=round(ticket.wait * 1e3, 3)):
+                            pass
+                    self._complete(ticket, entry["host_s"])
+            staged.append(entry)
+        # --- bucket in rank order -------------------------------------
+        buckets: List[List[dict]] = []
+        open_by_sig: Dict[tuple, List[dict]] = {}
+        for e in staged:
+            if e["mode"] == "batch":
+                sig = e["batchable"].signature
+                b = open_by_sig.get(sig)
+                if b is None or len(b) >= self.max_batch:
+                    b = []
+                    open_by_sig[sig] = b
+                    buckets.append(b)
+                b.append(e)
+            elif e["mode"] in ("serial", "thunk"):
+                buckets.append([e])
+        self._note_copending(staged, buckets)
+        # --- pipelined dispatch ---------------------------------------
+        inflight: Optional[tuple] = None   # (entries, InFlightBatch)
+        for b in buckets:
+            if b[0]["mode"] != "batch":
+                # host-side work runs WHILE the in-flight batch executes
+                # on the device — this is the overlap half of the
+                # pipeline (the serial pump would idle here)
+                self._run_serial(b[0])
+                continue
+            ifb = self._dispatch_bucket(b)
+            if ifb is None:       # device fault: bucket already settled
+                continue
+            if inflight is not None:
+                self._drain(*inflight)
+            inflight = (b, ifb)
+            self._inflight_since = float(self.clock.now())
+            PIPELINE_INFLIGHT.set(1.0)
+        if inflight is not None:
+            self._drain(*inflight)
+
+    def _note_copending(self, staged: List[dict],
+                        buckets: List[List[dict]]) -> None:
+        """Per-shape-class co-batching effectiveness, counted on the
+        FULL signature (shape class + device catalog): >=2 tickets with
+        the same signature queued in one pump should co-batch — that
+        failing repeatedly is the watchdog's bucket-stall signal. Two
+        tenants with equal shapes but DIVERGED catalog views carry
+        different signatures, so their legitimate never-co-batching can
+        never count as co-pending (no false positive by construction)."""
+        from collections import Counter
+        batchable = [e["batchable"] for e in staged if e["mode"] == "batch"]
+        pend = Counter(b.signature for b in batchable)
+        shape_of = {b.signature: b.shape_class for b in batchable}
+        cob = {b[0]["batchable"].signature for b in buckets
+               if len(b) >= 2 and b[0]["mode"] == "batch"}
+        for sig, n in pend.items():
+            cs = self.class_stats.setdefault(
+                shape_of[sig], {"tickets": 0, "batches": 0,
+                                "copending_pumps": 0,
+                                "cobatched_pumps": 0})
+            cs["tickets"] += n
+            if n >= 2:
+                cs["copending_pumps"] += 1
+                if sig in cob:
+                    cs["cobatched_pumps"] += 1
+
+    def _dispatch_bucket(self, entries: List[dict]):
+        """One bucket -> one async device call. A device fault here
+        aborts the WHOLE call, so exactly the tickets in this batch
+        degrade: each re-runs through its own facade, whose fallback
+        machinery reroutes to host/native and meters the event — later
+        buckets (same shape class included) still try the device."""
+        from ..metrics.tenant import tenant_scope
+        from ..ops import solver as ops_solver
+        try:
+            # probe the injected device-fault seam once per DISTINCT
+            # tenant in the bucket, each under that tenant's scope: the
+            # fleet's fault router consults current_tenant(), and the
+            # serial pump probes inside the ticket's scoped thunk — an
+            # unscoped probe would miss a targeted tenant's fault (or
+            # fire for a tenant that isn't even in this batch)
+            for tenant in dict.fromkeys(e["ticket"].tenant
+                                        for e in entries):
+                with tenant_scope(tenant):
+                    ops_solver.probe_dispatch_fault("device")
+            ifb = ops_solver.dispatch_batch(
+                [e["batchable"] for e in entries])
+        except BaseException:  # noqa: BLE001 — degrade only this batch
+            for e in entries:
+                self._run_serial(e, fault_fallback=True)
+            return None
+        cs = self.class_stats.setdefault(
+            entries[0]["batchable"].shape_class,
+            {"tickets": 0, "batches": 0, "copending_pumps": 0,
+             "cobatched_pumps": 0})
+        cs["batches"] += 1
+        return ifb
+
+    def _run_serial(self, entry: dict, fault_fallback: bool = False) -> None:
+        """Execute one non-batchable (or fault-degraded) ticket on the
+        host, under its tenant scope — the serial pump's semantics for
+        exactly this ticket."""
+        import time as _time
+
+        from ..metrics.tenant import tenant_scope
+        ticket = entry["ticket"]
+        sp = (TRACER.span("fleet.dispatch", tenant=ticket.tenant,
+                          kind=ticket.kind, seq=ticket.seq, batched=False,
+                          wait_ms=round(ticket.wait * 1e3, 3))
+              if TRACER.enabled else NOOP_SPAN)
+        t0 = _time.perf_counter()
+        try:
+            with tenant_scope(ticket.tenant), sp:
+                if entry["mode"] == "thunk":
+                    ticket.value = ticket._thunk()
+                else:
+                    client = self.clients[ticket.tenant]
+                    result, backend = client.facade.run_prepared(
+                        entry["prep"])
+                    # this solve's OWN cost: its stage + its run —
+                    # prep.t0 would span every ticket staged after it
+                    ticket.value = client.facade.finish_solve(
+                        entry["prep"], result, backend,
+                        duration_s=(entry["host_s"]
+                                    + _time.perf_counter() - t0))
+        except BaseException as e:  # noqa: BLE001 — the future carries it
+            ticket.error = e
+        finally:
+            ticket.batch_size = 1
+            event = "fault_fallback" if fault_fallback else "serial"
+            FLEET_SHAPE_CLASS.inc(event=event, tenant=ticket.tenant)
+            self._complete(ticket,
+                           entry["host_s"] + _time.perf_counter() - t0)
+
+    def _drain(self, entries: List[dict], ifb) -> None:
+        """Block on an in-flight batch, decode each request
+        independently, and finish its ticket under its tenant scope."""
+        import time as _time
+
+        from ..metrics.tenant import tenant_scope
+        self._inflight_since = None
+        PIPELINE_INFLIGHT.set(0.0)
+        sp = (TRACER.span("fleet.pipeline_wait", batch=ifb.size)
+              if TRACER.enabled else NOOP_SPAN)
+        try:
+            with sp:
+                waited = ifb.block()
+                sp.set(wait_ms=round(waited * 1e3, 3),
+                       span_ms=round(ifb.span_s * 1e3, 3))
+        except BaseException:  # noqa: BLE001 — degrade only this batch:
+            # real device errors surface at block/readback (the dispatch
+            # itself is async) — the containment contract is the same as
+            # a dispatch-time fault: exactly these tickets re-run
+            # through their facades, every other queued ticket proceeds
+            for e in entries:
+                self._run_serial(e, fault_fallback=True)
+            return
+        self.stats["pipeline_wait_s"] += waited
+        self.stats["pipeline_span_s"] += max(ifb.span_s, waited)
+        self.stats["batches"] += 1
+        self.stats["batched_tickets"] += ifb.size
+        self.stats["padded_slots"] += ifb.padded_size
+        self.stats["max_batch_size"] = max(self.stats["max_batch_size"],
+                                           ifb.size)
+        B = len(entries)
+        for i, e in enumerate(entries):
+            ticket = e["ticket"]
+            shape = e["batchable"].shape_class
+            sp = (TRACER.span("fleet.dispatch", tenant=ticket.tenant,
+                              kind=ticket.kind, seq=ticket.seq,
+                              batched=True, batch=B, shape_class=shape,
+                              wait_ms=round(ticket.wait * 1e3, 3))
+                  if TRACER.enabled else NOOP_SPAN)
+            t0 = _time.perf_counter()
+            try:
+                with tenant_scope(ticket.tenant), sp:
+                    client = self.clients[ticket.tenant]
+                    result = ifb.decode(i)
+                    # this ticket's OWN cost: its stage, its 1/B share
+                    # of the batch's device span, and its decode —
+                    # prep.t0 would charge it the whole pump wall
+                    ticket.value = client.facade.finish_solve(
+                        e["prep"], result, "device",
+                        duration_s=(e["host_s"] + ifb.span_s / B
+                                    + _time.perf_counter() - t0))
+            except BaseException:  # noqa: BLE001 — a row that fails to
+                # decode (device error surfacing late, fallback re-solve
+                # raising) degrades like a faulted batch member: its own
+                # facade re-runs it, its peers' rows are untouched
+                self._run_serial(e, fault_fallback=True)
+                continue
+            ticket.batch_size = B
+            ticket.shape_class = shape
+            FLEET_BATCH_SIZE.observe(float(B), tenant=ticket.tenant)
+            FLEET_SHAPE_CLASS.inc(
+                event="cobatched" if B > 1 else "solo",
+                tenant=ticket.tenant)
+            self._complete(ticket,
+                           e["host_s"] + _time.perf_counter() - t0)
+
+    def pipeline_overlap_ratio(self) -> float:
+        """1 - blocked-wait / in-flight span over every drained batch:
+        0 = the pump blocked for the device's whole execution (no
+        overlap), ->1 = host work fully hid the device time."""
+        span = self.stats["pipeline_span_s"]
+        if span <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.stats["pipeline_wait_s"] / span)
+
+    def pipeline_state(self) -> dict:
+        """The watchdog's pipeline_stall observables."""
+        now = float(self.clock.now())
+        return {
+            "batch": self.batch,
+            "inflight_age": (None if self._inflight_since is None
+                             else now - self._inflight_since),
+            "classes": {sc: dict(cs)
+                        for sc, cs in self.class_stats.items()},
+        }
 
     # --- fair scheduling --------------------------------------------------
     def _pick_next(self) -> SolveTicket:
@@ -299,7 +731,9 @@ class SolverService:
             key = (self.tenants[t.tenant].window_cost, t.seq)
             if best_key is None or key < best_key:
                 best_i, best_key = i, key
-        return self._queue.pop(best_i)
+        ticket = self._queue.pop(best_i)
+        self.tenants[ticket.tenant].queued -= 1
+        return ticket
 
     def _virtual_wait(self, ticket: SolveTicket) -> float:
         """Deficit-round-robin replay of the current window's job list:
@@ -364,6 +798,11 @@ class SolverService:
                 "window_seconds": self.window,
                 "quantum_seconds": self.quantum,
                 "stats": dict(self.stats),
+                "batch": {"armed": self.batch,
+                          "max_batch": self.max_batch,
+                          "overlap_ratio": round(
+                              self.pipeline_overlap_ratio(), 4),
+                          **self.pipeline_state()},
                 "catalog_shared": dict(self.shared_catalog.stats)}
 
     def snapshot(self) -> Dict[str, dict]:

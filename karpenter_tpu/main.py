@@ -188,7 +188,8 @@ def run_fleet(opts: Options) -> int:
     runner = FleetRunner("fleet_smoke", tenants=opts.fleet_tenants,
                          backend=opts.solver_backend,
                          inflight_cap=opts.fleet_inflight_cap,
-                         journal_dir=journal_dir)
+                         journal_dir=journal_dir,
+                         batch=opts.fleet_batch or None)
     report = runner.run()
     print(report.summary())
     return 0 if report.ok else 1

@@ -270,6 +270,32 @@ FLEET_THROTTLED = REGISTRY.counter(
     "backs off and retries, exactly like a cloud 429, while other "
     "tenants' solves proceed)",
     ("tenant",), label_defaults=_TENANT)
+FLEET_BATCH_SIZE = REGISTRY.histogram(
+    "karpenter_tpu_fleet_batch_size",
+    "Solve requests packed into the device dispatch that served this "
+    "tenant's ticket (fleet/service.py batched pump): 1 = the ticket "
+    "dispatched alone, N = it amortized one kernel call (and one tunnel "
+    "round-trip) across N tenants' solves — the occupancy face of the "
+    "shape-class bucketing",
+    ("tenant",), buckets=(1, 2, 4, 8, 16, 32, 64), label_defaults=_TENANT)
+FLEET_SHAPE_CLASS = REGISTRY.counter(
+    "karpenter_tpu_fleet_shape_class_total",
+    "Tickets through the batched dispatcher by outcome: 'cobatched' = "
+    "shared one device call with peers of its padded shape class, "
+    "'solo' = dispatched as a batch of one (no compatible peer queued), "
+    "'serial' = not batchable (host/native backend, existing-node "
+    "resume, legacy thunk), 'fault_fallback' = its batch's device "
+    "dispatch faulted and the ticket re-ran through its facade's "
+    "degradation path",
+    ("event", "tenant"), label_defaults=_TENANT)
+PIPELINE_INFLIGHT = REGISTRY.gauge(
+    "karpenter_tpu_pipeline_inflight",
+    "Batched device dispatches currently in flight (dispatched, not yet "
+    "drained) in the solver service's async pipeline: 1 while host work "
+    "for the next bucket overlaps device work for the current one, 0 "
+    "when the pipeline is drained. Stuck at 1 across scheduling windows "
+    "is the watchdog's pipeline_stall invariant",
+    ("tenant",), label_defaults=_TENANT)
 FLEET_CATALOG_SHARED = REGISTRY.counter(
     "karpenter_tpu_fleet_catalog_shared_total",
     "Catalog-tensor lookups served across tenant facades, by outcome: a "

@@ -40,6 +40,8 @@ from .tracer import TRACER, Span, Trace
 # exercised by at least one test (tests/test_observatory.py).
 PHASES: Tuple[str, ...] = (
     "queue_wait",       # fleet service submit/dispatch bookkeeping
+    "batch_pack",       # batched dispatch: request packing + batch upload
+    "pipeline_wait",    # batched dispatch: blocked on an in-flight batch
     "hooks",            # engine per-tick hooks (cloud tick, arrivals)
     "batch",            # pending-group collection (store index)
     "encode_cold",      # pod->tensor lowering, rows not in the encode cache
@@ -64,9 +66,12 @@ PHASES: Tuple[str, ...] = (
     "reconcile_other",  # controller pass glue outside the seams above
 )
 
-# buckets on the DEVICE side of the host/device split profile-report prints
+# buckets on the DEVICE side of the host/device split profile-report
+# prints (batch_pack is the batched upload — tunnel traffic like
+# device_put; pipeline_wait is device execution the host could not hide)
 DEVICE_PHASES = frozenset(
-    {"catalog_put", "device_put", "compile", "dispatch", "readback"})
+    {"catalog_put", "device_put", "compile", "dispatch", "readback",
+     "batch_pack", "pipeline_wait"})
 
 # static span-name -> bucket map; names absent here inherit their nearest
 # mapped ancestor's bucket (and the root's own self-time is the gap)
@@ -90,8 +95,12 @@ _SPAN_PHASE: Dict[str, str] = {
     "solve.readback": "readback",
     "solve.decode": "decode",
     "solve.device": "solver_overhead",
+    "solve.batch_pack": "batch_pack",
+    "fleet.pipeline_wait": "pipeline_wait",
     "fleet.submit": "queue_wait",
     "fleet.dispatch": "queue_wait",
+    "fleet.batch_stage": "queue_wait",
+    "fleet.pump": "queue_wait",
     "cloud.create_fleet": "cloud_api",
     "cloud.terminate": "cloud_api",
     "cloud.describe": "cloud_api",
@@ -195,12 +204,29 @@ class PhaseLedger:
                 return "reconcile_other"
             return _SPAN_PHASE.get(span.name)
 
+        def tenant_of(span: Span) -> str:
+            """Per-span tenant: the span's own `tenant` attr, else the
+            nearest ancestor's, else the trace-level scope tenant. A
+            BATCHED fleet pump serves many tenants inside ONE trace
+            (the per-ticket stage/dispatch spans carry tenant attrs),
+            and their phases must land on their own series — a single
+            trace-level read would lump every co-batched tenant's work
+            onto whoever happened to trigger the pump."""
+            node = span
+            while node is not None:
+                t = node.attrs.get("tenant")
+                if t:
+                    return str(t)
+                node = (by_id.get(node.parent_id)
+                        if node.parent_id is not None else None)
+            return tenant
+
         attributed = 0.0
-        sig: Optional[str] = None
-        solve_ms = 0.0
-        phase_acc: Dict[str, List[float]] = {}
-        bytes_acc: Dict[str, int] = {}
-        vwait = 0.0
+        sig_by: Dict[str, Optional[str]] = {}
+        solve_by: Dict[str, float] = {}
+        phase_acc: Dict[Tuple[str, str], List[float]] = {}
+        bytes_acc: Dict[Tuple[str, str], int] = {}
+        vwait: Dict[str, float] = {}
         for s in trace.spans:
             self_ms = max(0.0, s.duration - child_dur.get(s.span_id, 0.0)) \
                 * 1e3
@@ -215,58 +241,63 @@ class PhaseLedger:
                 # reaches here only for the root's own self-time (or an
                 # orphaned parent chain): the unattributed gap
                 continue
-            row = phase_acc.setdefault(b, [0.0, 0.0])
+            st = tenant_of(s)
+            row = phase_acc.setdefault((st, b), [0.0, 0.0])
             row[0] += self_ms
             row[1] += 1.0
             attributed += self_ms
-            if s.name in ("solve.device_put", "solve.catalog_put"):
-                bytes_acc[b] = bytes_acc.get(b, 0) \
+            if s.name in ("solve.device_put", "solve.catalog_put",
+                          "solve.batch_pack"):
+                bytes_acc[(st, b)] = bytes_acc.get((st, b), 0) \
                     + int(s.attrs.get("h2d_bytes", 0) or 0)
             elif s.name == "solve.readback":
-                bytes_acc[b] = bytes_acc.get(b, 0) \
+                bytes_acc[(st, b)] = bytes_acc.get((st, b), 0) \
                     + int(s.attrs.get("d2h_bytes", 0) or 0)
             if s.name == "fleet.dispatch":
-                vwait += float(s.attrs.get("wait_ms", 0.0) or 0.0)
-            if s.name == "solve.prep" and sig is None:
+                vwait[st] = vwait.get(st, 0.0) \
+                    + float(s.attrs.get("wait_ms", 0.0) or 0.0)
+            if s.name == "solve.prep" and sig_by.get(st) is None:
                 g = s.attrs.get("groups_padded")
                 n = s.attrs.get("n_max")
                 if g is not None and n is not None:
-                    sig = f"g{g}/n{n}"
+                    sig_by[st] = f"g{g}/n{n}"
             if s.name in ("solve.device", "solve.run"):
-                solve_ms = max(solve_ms, s.duration * 1e3)
-                if sig is None and s.name == "solve.run" \
+                solve_by[st] = max(solve_by.get(st, 0.0),
+                                   s.duration * 1e3)
+                if sig_by.get(st) is None and s.name == "solve.run" \
                         and s.attrs.get("backend") in ("host", "native"):
-                    sig = f"host/g{s.attrs.get('groups', '?')}"
+                    sig_by[st] = f"host/g{s.attrs.get('groups', '?')}"
 
         wall_ms = root.duration * 1e3
         unattr_ms = max(0.0, wall_ms - attributed)
         coverage = 1.0 - (unattr_ms / wall_ms if wall_ms > 0 else 0.0)
         with self._lock:
             self.traces += 1
-            for b, (ms, n) in phase_acc.items():
-                row = self._phases.setdefault((tenant, kind, b), [0.0, 0.0])
+            for (st, b), (ms, n) in phase_acc.items():
+                row = self._phases.setdefault((st, kind, b), [0.0, 0.0])
                 row[0] += ms
                 row[1] += n
-            for b, by in bytes_acc.items():
-                self._bytes[(tenant, b)] = self._bytes.get((tenant, b), 0) \
-                    + by
+            for (st, b), by in bytes_acc.items():
+                self._bytes[(st, b)] = self._bytes.get((st, b), 0) + by
             wrow = self._walls.setdefault((tenant, kind), [0.0, 0.0, 0.0])
             wrow[0] += wall_ms
             wrow[1] += unattr_ms
             wrow[2] += 1.0
-            if solve_ms > 0.0:
-                srow = self._sigs.setdefault((tenant, sig or "-"),
-                                             [0.0, 0.0])
-                srow[0] += solve_ms
-                srow[1] += 1.0
-            if vwait:
-                self._virtual_wait[tenant] = (
-                    self._virtual_wait.get(tenant, 0.0) + vwait)
+            for st, ms in solve_by.items():
+                if ms > 0.0:
+                    srow = self._sigs.setdefault(
+                        (st, sig_by.get(st) or "-"), [0.0, 0.0])
+                    srow[0] += ms
+                    srow[1] += 1.0
+            for st, v in vwait.items():
+                if v:
+                    self._virtual_wait[st] = (
+                        self._virtual_wait.get(st, 0.0) + v)
 
         from ..metrics import (PROFILE_COVERAGE, PROFILE_PHASE_MS,
                                PROFILE_UNATTRIBUTED_MS)
-        for b, (ms, _n) in phase_acc.items():
-            PROFILE_PHASE_MS.inc(ms, phase=b, kind=kind, tenant=tenant)
+        for (st, b), (ms, _n) in phase_acc.items():
+            PROFILE_PHASE_MS.inc(ms, phase=b, kind=kind, tenant=st)
         if unattr_ms:
             PROFILE_UNATTRIBUTED_MS.inc(unattr_ms, kind=kind, tenant=tenant)
         PROFILE_COVERAGE.set(self.coverage(tenant=tenant, kind=kind),

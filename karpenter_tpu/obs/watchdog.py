@@ -26,6 +26,12 @@ INCREMENTAL monitors evaluated on a sim-clock cadence:
 - **fleet_starvation** — a tenant's worst virtual queueing delay
   crossed the starvation threshold, or the shared service's queue
   backlog crossed the backlog threshold.
+- **pipeline_stall** — the batched dispatcher's async pipeline wedged:
+  a device batch has been in flight longer than the pipeline grace
+  (dispatched, never drained — a hung tunnel the synchronous pump
+  cannot hang on), or a padded shape class keeps co-pending >=2
+  tickets per pump without EVER co-batching them (the bucketing that
+  justifies the batching's existence is silently not happening).
 - **profile_unattributed** — the phase ledger's unattributed gap grew:
   an un-spanned seam appeared on a traced hot path.
 - **trace_ring_overflow** — the flight recorder rejected traces since
@@ -70,6 +76,7 @@ INVARIANTS: Tuple[str, ...] = (
     "warm_audit_lag",
     "warm_divergence",
     "fleet_starvation",
+    "pipeline_stall",
     "profile_unattributed",
     "trace_ring_overflow",
 )
@@ -125,6 +132,9 @@ class Watchdog:
     AUDIT_LAG_GRACE = 120.0   # recorded-but-unaudited warm batch age
     STARVATION_S = 1.0        # virtual queueing delay (seconds)
     BACKLOG_MAX = 64          # queued tickets in the shared service
+    PIPELINE_GRACE = 30.0     # sim seconds a batch may stay in flight
+    COBATCH_MIN_PUMPS = 3     # co-pending pumps before a never-co-batched
+    #                           shape class counts as a stall
     UNATTRIBUTED_MS = 5.0     # ledger gap growth per excursion
     RING_DROPS = 64           # recorder rejections since arm
     JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
@@ -137,7 +147,8 @@ class Watchdog:
                  drift_grace: Optional[float] = None,
                  audit_lag_grace: Optional[float] = None,
                  starvation_s: Optional[float] = None,
-                 backlog_max: Optional[int] = None):
+                 backlog_max: Optional[int] = None,
+                 pipeline_grace: Optional[float] = None):
         self.clock = clock
         self.store = store
         self.cloud = cloud
@@ -156,6 +167,8 @@ class Watchdog:
                              else starvation_s)
         self.backlog_max = (self.BACKLOG_MAX if backlog_max is None
                             else int(backlog_max))
+        self.pipeline_grace = (self.PIPELINE_GRACE if pipeline_grace is None
+                               else float(pipeline_grace))
         self._lock = threading.Lock()
         self.findings: List[Finding] = []
         # ACTIVE excursions: (invariant, key) -> severity. The verdict
@@ -456,6 +469,36 @@ class Watchdog:
                            now, max_wait_ms=round(state.max_wait * 1e3, 1))
             else:
                 self._clear("fleet_starvation", tenant)
+        self._check_pipeline(now, fired)
+
+    def _check_pipeline(self, now: float, fired: List[Finding]) -> None:
+        """The batched dispatcher's pipeline invariants (no-op on a
+        serial service): a wedged in-flight batch, and a shape class
+        whose co-pending tickets never co-batch."""
+        svc = self.service
+        state_fn = getattr(svc, "pipeline_state", None)
+        if state_fn is None or not getattr(svc, "batch", False):
+            return
+        st = state_fn()
+        age = st.get("inflight_age")
+        if age is not None and age >= self.pipeline_grace:
+            self._fire(fired, "pipeline_stall", "warning", "inflight",
+                       f"device batch in flight for {age:.0f}s without a "
+                       f"drain (grace {self.pipeline_grace:g}s)", now,
+                       age_s=round(age, 1))
+        else:
+            self._clear("pipeline_stall", "inflight")
+        for sc, cs in st.get("classes", {}).items():
+            key = f"class/{sc}"
+            if (cs.get("copending_pumps", 0) >= self.COBATCH_MIN_PUMPS
+                    and cs.get("cobatched_pumps", 0) == 0):
+                self._fire(fired, "pipeline_stall", "warning", key,
+                           f"shape class {sc} co-pended >=2 tickets in "
+                           f"{cs['copending_pumps']} pumps but never "
+                           f"co-batched them", now,
+                           copending=cs["copending_pumps"])
+            else:
+                self._clear("pipeline_stall", key)
 
     def _check_meters(self, now: float, fired: List[Finding]) -> None:
         from .profile import LEDGER
@@ -579,7 +622,8 @@ class Watchdog:
                            "orphan_s": self.ORPHAN_GRACE,
                            "audit_lag_s": self.audit_lag_grace,
                            "starvation_s": self.starvation_s,
-                           "backlog_max": self.backlog_max},
+                           "backlog_max": self.backlog_max,
+                           "pipeline_s": self.pipeline_grace},
                 "stats": dict(self.stats),
                 "fired": dict(self._fired),
                 "watchlist": {"claims": len(self._claims),

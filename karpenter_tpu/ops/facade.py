@@ -271,6 +271,38 @@ class SolveOutput:
     stats: Dict[str, float] = field(default_factory=dict)
 
 
+@dataclass
+class PreparedSolve:
+    """A solve() staged up to (but not including) its backend run — the
+    seam the fleet's batched dispatcher works through: prepare_solve()
+    does every host-side step (catalog view, gates, colocation, encode,
+    spread, backend choice), run_prepared()/a batched device call
+    produces the SolveResult, finish_solve() decodes and applies the
+    post-passes. solve() composes the three, so the serial path and the
+    batched path are the same program by construction.
+
+    `output` non-None means the solve terminated during preparation
+    (empty catalog, colocation-only, zero groups) — the value is FINAL
+    (merge + reserved-retry already applied)."""
+
+    output: Optional[SolveOutput] = None
+    cat: Optional[CatalogTensors] = None
+    cat_key: tuple = ()              # facade catalog-LRU key AT prepare time
+    enc: Optional[EncodedPods] = None
+    existing: Optional[List[VirtualNode]] = None
+    plan: Optional[ColocationPlan] = None
+    dropped: List[str] = field(default_factory=list)
+    blocks_gated: bool = False
+    ds_fp: int = 0
+    all_pods: Sequence[Pod] = ()
+    nodepool: Optional[NodePool] = None
+    node_class: Optional[NodeClassSpec] = None
+    spread_occupancy: Optional[list] = None
+    daemonsets: Optional[list] = None
+    backend: str = ""
+    t0: float = 0.0
+
+
 def _pod_key(p: Pod) -> str:
     return f"{p.namespace}/{p.name}"
 
@@ -495,9 +527,34 @@ class Solver:
         spread domain counts. Defaults to deriving from `existing` (this
         solve's nodes only), which under-counts in multi-pool clusters;
         the provisioner passes the full view."""
+        prep = self.prepare_solve(
+            pods, nodepool, node_class, existing, capacity_cap,
+            existing_pods, spread_occupancy, pregrouped, daemonsets,
+            _gate_blocks)
+        if prep.output is not None:
+            return prep.output
+        result, backend = self.run_prepared(prep)
+        return self.finish_solve(prep, result, backend)
+
+    def prepare_solve(self, pods: Sequence[Pod], nodepool: NodePool,
+                      node_class: Optional[NodeClassSpec] = None,
+                      existing: Optional[List[VirtualNode]] = None,
+                      capacity_cap: Optional[Resources] = None,
+                      existing_pods: Optional[Dict[str, List[Pod]]] = None,
+                      spread_occupancy: Optional[
+                          List[Tuple[Optional[str], List[Pod]]]] = None,
+                      pregrouped: Optional[List[List[Pod]]] = None,
+                      daemonsets: Optional[list] = None,
+                      _gate_blocks: bool = True) -> PreparedSolve:
+        """Everything solve() does BEFORE the backend run: catalog view +
+        gates, colocation planning, encode, spread split, backend choice.
+        Host-side work only — safe to interleave across many requests
+        (the batched dispatcher stages every queued solve through here
+        before a single device call serves them all)."""
         cat = self.tensors(node_class)
         if cat.T == 0 or not pods:
-            return SolveOutput([], {}, [_pod_key(p) for p in pods])
+            return PreparedSolve(
+                output=SolveOutput([], {}, [_pod_key(p) for p in pods]))
         # capacity-block gate (reference filter.go:163-228): unless the
         # pool explicitly targets reserved capacity, block offerings are
         # removed from the availability tensor BEFORE the solve — the
@@ -588,9 +645,9 @@ class Solver:
             if not pods:
                 out = self._merge_plan(SolveOutput([], {}, []), plan,
                                        cat, nodepool)
-                return self._retry_reserved_unschedulable(
+                return PreparedSolve(output=self._retry_reserved_unschedulable(
                     out, blocks_gated, all_pods, nodepool, node_class,
-                    spread_occupancy, daemonsets)
+                    spread_occupancy, daemonsets))
         taints = nodepool.taints + nodepool.startup_taints
         enc_ctx = (self._encode_cache.context_for(
                        cat, nodepool.requirements, taints, template)
@@ -647,23 +704,89 @@ class Solver:
         if enc.G == 0:
             out = self._merge_plan(SolveOutput([], {}, dropped), plan,
                                    cat, nodepool)
-            return self._retry_reserved_unschedulable(
+            return PreparedSolve(output=self._retry_reserved_unschedulable(
                 out, blocks_gated, all_pods, nodepool, node_class,
-                spread_occupancy, daemonsets)
+                spread_occupancy, daemonsets))
         self._relax_infeasible_preferences(enc, cat)
 
         self.attach_existing_context(enc, existing, existing_pods)
 
         import time as _time
-
-        from ..metrics import SOLVE_DURATION, SOLVE_PODS
-        from ..utils.profiling import maybe_trace
         t0 = _time.perf_counter()
         backend = self._resolve_backend(int(enc.counts.sum()))
         if backend == "native" and cat.zone_overhead is not None:
             # the C++ FFD takes a flat [T, R] allocatable; zone-varying
             # reservations need the masked-max path — host oracle instead
             backend = "host"
+        return PreparedSolve(
+            cat=cat, cat_key=self._last_cat_key, enc=enc,
+            existing=existing, plan=plan, dropped=dropped,
+            blocks_gated=blocks_gated, ds_fp=ds_fp, all_pods=all_pods,
+            nodepool=nodepool, node_class=node_class,
+            spread_occupancy=spread_occupancy, daemonsets=daemonsets,
+            backend=backend, t0=t0)
+
+    def _device_dcat(self, prep: PreparedSolve, mesh):
+        """Device-resident catalog tensors for a prepared solve — the ONE
+        residency-cache policy the serial run and the batched stage
+        share. Keys on prep.cat_key (captured at prepare time), so
+        interleaved prepares of different views cannot cross-wire."""
+        from .solver import _auto_dcat, device_catalog
+        cat = prep.cat
+        R = prep.enc.requests.shape[1]
+        if (self._shared_catalog is not None
+                and cat.cache_token is not None
+                and cat.cache_token[0] == "shared"):
+            # fleet: device residency keys on the content token in the
+            # PROCESS-global cache (ops/solver._auto_dcat), so tenant
+            # facades sharing this view — and its gated/daemonset-
+            # derived tokens — share one upload and one compiled
+            # executable
+            return _auto_dcat(cat, R, mesh=mesh)
+        # keyed on (nodeclass hash, catalog epoch, R, placement, block
+        # gating) — NOT id(cat): a freed CatalogTensors' address can be
+        # reused by its successor
+        dkey = prep.cat_key + (R, mesh is not None, prep.blocks_gated,
+                               prep.ds_fp)
+        dcat = self._dcat_cache.get(dkey)
+        if dcat is None:
+            # device residency follows the host LRU: every variant
+            # (block-gating states, mesh vs single) of any CACHED
+            # catalog view may stay — mixed pools and alternating
+            # NodeClasses must not thrash a full host→device transfer
+            # per solve
+            n = len(prep.cat_key)
+            for k in [k for k in self._dcat_cache
+                      if k[:n] not in self._cat_cache]:
+                del self._dcat_cache[k]
+            dcat = device_catalog(cat, R, mesh=mesh)
+            self._dcat_cache[dkey] = dcat
+        return dcat
+
+    def stage_batchable(self, prep: PreparedSolve):
+        """ops.solver.BatchableSolve for a prepared solve, or None when
+        it must run serially (host/native/mesh backends, existing-node
+        resume, per-solve jax profiling). Staging performs the device
+        UPLOAD (catalog residency + nothing else) so a pipelined caller
+        overlaps it with in-flight device work."""
+        if (prep.output is not None or prep.backend != "device"
+                or prep.existing or self.profile_dir):
+            return None
+        from .solver import prepare_batchable
+        try:
+            return prepare_batchable(prep.cat, prep.enc,
+                                     dcat=self._device_dcat(prep, None))
+        except Exception:  # noqa: BLE001 — staging is an optimization;
+            # any surprise falls back to the serial path, never crashes
+            return None
+
+    def run_prepared(self, prep: PreparedSolve):
+        """The backend run of a prepared solve, with the device-fault
+        degradation machinery. Returns (SolveResult, backend actually
+        used)."""
+        from ..utils.profiling import maybe_trace
+        cat, enc, existing = prep.cat, prep.enc, prep.existing
+        backend = prep.backend
         run_sp = (TRACER.span("solve.run", backend=backend,
                               pods=int(enc.counts.sum()), groups=int(enc.G))
                   if TRACER.enabled else NOOP_SPAN)
@@ -675,40 +798,9 @@ class Solver:
                 result = solve_native(cat, enc, existing)
             else:
                 try:
-                    from .solver import (_auto_dcat, device_catalog,
-                                         solve_device)
-                    R = enc.requests.shape[1]
+                    from .solver import solve_device
                     mesh = self.mesh() if backend == "mesh" else None
-                    if (self._shared_catalog is not None
-                            and cat.cache_token is not None
-                            and cat.cache_token[0] == "shared"):
-                        # fleet: device residency keys on the content
-                        # token in the PROCESS-global cache
-                        # (ops/solver._auto_dcat), so tenant facades
-                        # sharing this view — and its gated/daemonset-
-                        # derived tokens — share one upload and one
-                        # compiled executable
-                        dcat = _auto_dcat(cat, R, mesh=mesh)
-                    else:
-                        # keyed on (nodeclass hash, catalog epoch, R,
-                        # placement, block gating) — NOT id(cat): a freed
-                        # CatalogTensors' address can be reused by its
-                        # successor
-                        dkey = self._last_cat_key + (R, backend == "mesh",
-                                                     blocks_gated, ds_fp)
-                        dcat = self._dcat_cache.get(dkey)
-                        if dcat is None:
-                            # device residency follows the host LRU: every
-                            # variant (block-gating states, mesh vs single)
-                            # of any CACHED catalog view may stay — mixed
-                            # pools and alternating NodeClasses must not
-                            # thrash a full host→device transfer per solve
-                            n = len(self._last_cat_key)
-                            for k in [k for k in self._dcat_cache
-                                      if k[:n] not in self._cat_cache]:
-                                del self._dcat_cache[k]
-                            dcat = device_catalog(cat, R, mesh=mesh)
-                            self._dcat_cache[dkey] = dcat
+                    dcat = self._device_dcat(prep, mesh)
                     result = solve_device(cat, enc, existing, dcat=dcat,
                                           mesh=mesh)
                 except Exception as e:  # noqa: BLE001 — graceful degradation:
@@ -721,25 +813,43 @@ class Solver:
                         result = solve_native(cat, enc, existing)
                     else:
                         result = solve_host(cat, enc, existing)
+        return result, backend
+
+    def finish_solve(self, prep: PreparedSolve, result: SolveResult,
+                     backend: str,
+                     duration_s: Optional[float] = None) -> SolveOutput:
+        """Decode + post-passes of a prepared solve whose SolveResult is
+        in hand (serial run or a batched device call).
+
+        duration_s: this solve's OWN cost, supplied by a pipelined
+        caller — under batched dispatch, `now - prep.t0` spans other
+        tickets' staging and other buckets' device work, which would
+        inflate the histogram by up to the whole pump wall."""
+        import time as _time
+
+        from ..metrics import SOLVE_DURATION, SOLVE_PODS
+        cat, enc = prep.cat, prep.enc
         # exemplar: a fat solve-duration bucket points at the captured
         # trace in the flight recorder (None when tracing is off)
-        SOLVE_DURATION.observe(_time.perf_counter() - t0, backend=backend,
+        SOLVE_DURATION.observe(duration_s if duration_s is not None
+                               else _time.perf_counter() - prep.t0,
+                               backend=backend,
                                exemplar=TRACER.current_trace_id())
         SOLVE_PODS.observe(float(enc.counts.sum()))
 
-        out = self._decode(cat, enc, result, nodepool, dropped)
-        out = self._merge_plan(out, plan, cat, nodepool)
+        out = self._decode(cat, enc, result, prep.nodepool, prep.dropped)
+        out = self._merge_plan(out, prep.plan, cat, prep.nodepool)
         # decision provenance: per-pod placement records + the constraint
         # elimination funnel, bounded and read-only (obs/explain.py) —
         # solves above the recorder's pod cap are skipped, and the
-        # colocation-only early return above is not recorded (bundle
-        # placement is the planner's, not the funnel's)
+        # colocation-only early return in prepare_solve is not recorded
+        # (bundle placement is the planner's, not the funnel's)
         from ..obs.explain import RECORDER
         if RECORDER.enabled:
             RECORDER.record_solve(cat, enc, out)
         return self._retry_reserved_unschedulable(
-            out, blocks_gated, all_pods, nodepool, node_class,
-            spread_occupancy, daemonsets)
+            out, prep.blocks_gated, prep.all_pods, prep.nodepool,
+            prep.node_class, prep.spread_occupancy, prep.daemonsets)
 
     def _retry_reserved_unschedulable(
             self, out: SolveOutput, blocks_gated: bool, all_pods: List[Pod],

@@ -531,6 +531,275 @@ _solve_onebuf = partial(
 )(_solve_onebuf_impl)
 
 
+# ---------------------------------------------------------------------------
+# batched dispatch: one device call, many solve requests
+# ---------------------------------------------------------------------------
+# The fleet funnels every tenant's solve through one queue (ROADMAP item
+# 2), and the kernel is ~2-3ms inside a ~100ms reconcile — so dispatching
+# queued requests ONE AT A TIME leaves the mesh idle between kernels and
+# pays the tunnel RTT per request. This engine packs compatible requests
+# (same padded shape class: Gp/n_max/k_max/cols/flags + one shared device
+# catalog) into a single vmapped kernel call along a new leading request
+# axis. Each request keeps its own padding masks (padded groups have
+# count 0; padded batch rows have ALL counts zeroed), so results decode
+# independently and are byte-identical to serial per-request solves — the
+# parity fuzz in tests/test_batch_parity.py is the gate.
+
+
+def _solve_batched_impl(alloc, price, avail, gbuf, conflict, zovh,
+                        n_max: int, k_max: int, cols: tuple,
+                        track_conflicts: bool, zone_ovh: bool):
+    """vmap of the onebuf kernel over a leading request axis. Catalog
+    tensors (and zovh) are closed over — one bucket shares ONE device
+    catalog, so they broadcast instead of stacking B copies."""
+    def one(gb, cf):
+        return _solve_onebuf_impl(alloc, price, avail, gb, None, None, cf,
+                                  zovh, None, n_max=n_max, k_max=k_max,
+                                  cols=cols, track_conflicts=track_conflicts,
+                                  zone_ovh=zone_ovh)
+    if track_conflicts:
+        return jax.vmap(one)(gbuf, conflict)
+    return jax.vmap(lambda gb: one(gb, None))(gbuf)
+
+
+_solve_batched = partial(
+    jax.jit, static_argnames=("n_max", "k_max", "cols", "track_conflicts",
+                              "zone_ovh")
+)(_solve_batched_impl)
+
+# donate the resident batch buffer (gbuf, arg 3): each batch uploads a
+# fresh stacked request matrix and never reads it back, so XLA may
+# reuse its device allocation for the packed output instead of growing
+# the working set per in-flight batch (SNIPPETS.md [1] donate_argnums).
+# CPU backends warn on donation, so the non-donating jit serves there.
+_solve_batched_donate = partial(
+    jax.jit, static_argnames=("n_max", "k_max", "cols", "track_conflicts",
+                              "zone_ovh"), donate_argnums=(3,)
+)(_solve_batched_impl)
+
+
+def _batched_fn():
+    try:
+        cpu = jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 — backend probing must not crash a solve
+        cpu = True
+    return _solve_batched if cpu else _solve_batched_donate
+
+
+@dataclass
+class BatchableSolve:
+    """One solve request staged for batched dispatch: the encoded
+    problem plus the padded shape class that decides which requests may
+    share a device call (and hence a compiled executable)."""
+
+    cat: CatalogTensors
+    enc: EncodedPods
+    dcat: "DeviceCatalog"
+    Gp: int
+    statics: dict          # n_max / k_max / cols / track_conflicts / zone_ovh
+    signature: tuple       # full co-batch key (shape class + device catalog)
+    shape_class: str       # "g<Gp>/n<n_max>" — the ledger's signature class
+
+
+def prepare_batchable(cat: CatalogTensors, enc: EncodedPods,
+                      dcat: Optional["DeviceCatalog"] = None,
+                      ) -> Optional[BatchableSolve]:
+    """Stage a FRESH solve (no existing nodes, no priors/bans — the
+    dominant fleet case) for batched dispatch. Returns None when the
+    request cannot batch. The shape class mirrors solve_device's prep
+    exactly (same _bucket/_auto_node_budget/_request_cols), so a staged
+    request and a serial dispatch of the same enc are the same program."""
+    assert not enc.spread_zone.any(), "run split_spread_groups before solve"
+    if enc.G == 0:
+        return None
+    R = enc.requests.shape[1]
+    if dcat is not None and (
+            dcat.alloc.shape[1] < R
+            or (dcat.ovh_z is not None) != (cat.zone_overhead is not None)):
+        dcat = None
+    if dcat is None:
+        dcat = _auto_dcat(cat, R)
+    Gp = _bucket(enc.G, 8)
+    n_max = _auto_node_budget(cat, enc, 0)
+    k_max = _bucket(2 * n_max)
+    cols = _request_cols(enc, cat)
+    track = enc.conflict is not None
+    zone_ovh = dcat.ovh_z is not None
+    statics = dict(n_max=n_max, k_max=k_max, cols=cols,
+                   track_conflicts=track, zone_ovh=zone_ovh)
+    # the device catalog is part of the co-batch key (requests in one
+    # call share ONE resident catalog); two buckets with equal shapes
+    # but different catalogs still share the compiled executable — the
+    # catalog is a runtime argument, not a static
+    signature = ("batch", Gp, n_max, k_max, cols, track, zone_ovh,
+                 tuple(dcat.alloc.shape), tuple(dcat.price.shape),
+                 id(dcat))
+    return BatchableSolve(cat=cat, enc=enc, dcat=dcat, Gp=Gp,
+                          statics=statics, signature=signature,
+                          shape_class=f"g{Gp}/n{n_max}")
+
+
+class InFlightBatch:
+    """A dispatched batch whose device work may still be running: the
+    async half of the encode→upload→dispatch→decode pipeline. The caller
+    overlaps host work with the device by delaying block()/decode() —
+    fleet/service.py keeps one of these in flight while staging the
+    next bucket."""
+
+    def __init__(self, reqs: List[BatchableSolve], packed,
+                 dispatched_at: float):
+        self.reqs = reqs
+        self._packed = packed       # device int32 [Bp, L]
+        self.dispatched_at = dispatched_at
+        self._buf: Optional[np.ndarray] = None
+        self.wait_s = 0.0           # host time spent blocked on the device
+        self.span_s = 0.0           # dispatch-return -> results ready
+        self.fallbacks = 0          # rows re-run serially (budget regrow)
+
+    @property
+    def size(self) -> int:
+        return len(self.reqs)
+
+    @property
+    def padded_size(self) -> int:
+        return int(self._packed.shape[0]) if self._buf is None \
+            else int(self._buf.shape[0])
+
+    def block(self) -> float:
+        """Wait for the device and read the packed result back (the ONE
+        d2h of the whole batch). Returns the blocked-wait seconds —
+        ~zero when host work fully overlapped the device."""
+        if self._buf is not None:
+            return 0.0
+        import time as _time
+        t0 = _time.perf_counter()
+        self._packed.block_until_ready()
+        self.wait_s = _time.perf_counter() - t0
+        sp = (TRACER.span("solve.readback", batch=self.size)
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            self._buf = _read(self._packed)
+            sp.set(d2h_bytes=int(self._buf.nbytes))
+        self._packed = None
+        self.span_s = _time.perf_counter() - self.dispatched_at
+        return self.wait_s
+
+    def decode(self, i: int) -> SolveResult:
+        """Decode request i's row independently of its batch peers —
+        the same host-side reconstruction as the serial path. A row
+        whose sparse/node budget proved too small re-runs serially
+        (solve_device's regrow loop), exactly what a serial dispatch of
+        that request would have done."""
+        self.block()
+        req = self.reqs[i]
+        st = req.statics
+        Gp, n_max, k_max = req.Gp, st["n_max"], st["k_max"]
+        (nused, overflowed, nnz, unsched, ntype, idx,
+         vals) = _parse_packed(self._buf[i], Gp, n_max, k_max)
+        total_pods = int(req.enc.counts.sum())
+        if nnz > k_max or (overflowed and n_max < total_pods):
+            self.fallbacks += 1
+            return solve_device(req.cat, req.enc, dcat=req.dcat)
+        sp = (TRACER.span("solve.decode", batch_index=i)
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            R = req.enc.requests.shape[1]
+            result = _decode_solution(
+                req.cat, req.enc, [], np.zeros((0, R), np.float32),
+                np.zeros((0, req.cat.Z), bool),
+                np.zeros((0, req.cat.C), bool),
+                nused, ntype, idx, vals, nnz, unsched, n_max)
+            sp.set(nodes=len(result.nodes), nnz=int(nnz))
+        return result
+
+    def results(self) -> List[SolveResult]:
+        return [self.decode(i) for i in range(self.size)]
+
+
+# batch-axis padding buckets: {1, 2, 3, 4, 6, 8, 12, 16, ...} so
+# executables converge per shape class instead of recompiling per fleet
+# occupancy (same {2^k, 3*2^(k-1)} ladder as the node axis)
+def _batch_bucket(b: int) -> int:
+    return _bucket(b, 1)
+
+
+def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
+    """Pack one bucket of same-signature requests into a single device
+    call and return without blocking (the device executes while the
+    caller stages the next bucket). Padded batch rows replicate request
+    0 with every group count zeroed — pure no-ops in the scan."""
+    import time as _time
+    assert reqs, "empty batch"
+    first = reqs[0]
+    assert all(r.signature == first.signature for r in reqs), \
+        "batched requests must share one shape-class signature"
+    st = first.statics
+    Gp, cols = first.Gp, list(st["cols"])
+    track, zone_ovh = st["track_conflicts"], st["zone_ovh"]
+    dcat = first.dcat
+    B, Bp = len(reqs), _batch_bucket(len(reqs))
+    sp = (TRACER.span("solve.batch_pack", requests=B, padded=Bp,
+                      shape_class=first.shape_class)
+          if TRACER.enabled else NOOP_SPAN)
+    with sp:
+        b0 = transfer_bytes()[0]
+        gbufs = [_pack_groups(*_group_inputs(r.enc, Gp), cols)
+                 for r in reqs]
+        if Bp > B:
+            pad = gbufs[0].copy()
+            pad[:, len(cols)] = 0.0  # zero the counts column: a no-op row
+            gbufs.extend([pad] * (Bp - B))
+        gstack = _put(np.stack(gbufs))
+        conf = None
+        if track:
+            confs = [_pad_to(_pad_to(r.enc.conflict, Gp, 0), Gp, 1)
+                     if r.enc.conflict is not None
+                     else np.zeros((Gp, Gp), bool) for r in reqs]
+            confs.extend([np.zeros((Gp, Gp), bool)] * (Bp - B))
+            conf = _put(np.stack(confs))
+        sp.set(h2d_bytes=transfer_bytes()[0] - b0)
+    event = _dispatch_cache_event(
+        ("batch", Bp, tuple(dcat.alloc.shape), tuple(dcat.price.shape),
+         tuple(gstack.shape), track, zone_ovh, st["n_max"], st["k_max"],
+         tuple(st["cols"])))
+    sp = (TRACER.span("solve.compile" if event == "miss"
+                      else "solve.dispatch", cache=event, backend="device",
+                      batch=Bp, n_max=st["n_max"])
+          if TRACER.enabled else NOOP_SPAN)
+    # NO fault-hook probe here: the fleet's injector routes faults by
+    # current_tenant(), and this call serves MANY tenants — the caller
+    # probes via probe_dispatch_fault() under each tenant's scope BEFORE
+    # dispatching (fleet/service._dispatch_bucket), so a tenant-targeted
+    # fault aborts the batch while an unscoped probe can neither miss
+    # the target nor fire for a tenant that isn't even in the batch
+    with sp:
+        packed = _batched_fn()(
+            dcat.alloc, dcat.price, dcat.avail, gstack, conf,
+            dcat.ovh_z if zone_ovh else None,
+            n_max=st["n_max"], k_max=st["k_max"], cols=st["cols"],
+            track_conflicts=track, zone_ovh=zone_ovh)
+    return InFlightBatch(reqs, packed, _time.perf_counter())
+
+
+def probe_dispatch_fault(backend: str) -> None:
+    """Fire the injected device-fault seam, if armed. The batched
+    dispatcher calls this once per distinct tenant in a bucket, each
+    under that tenant's metric scope — the same per-tenant probe
+    semantics the serial dispatch path has (the hook fires inside the
+    ticket's scoped thunk there)."""
+    if _dispatch_fault_hook is not None:
+        _dispatch_fault_hook(backend)
+
+
+def solve_device_batched(reqs: List[BatchableSolve]) -> List[SolveResult]:
+    """Synchronous convenience: dispatch one bucket and decode every
+    row. The pipelined overlap (and the per-tenant fault probing) lives
+    in the caller (fleet/service.py); tests and direct callers use
+    this."""
+    probe_dispatch_fault("device")
+    return dispatch_batch(reqs).results()
+
+
 # monotone union of resource columns ever requested in this process: cols
 # is a jit STATIC (its value fixes the projection slices), so a per-solve
 # minimal set would recompile the kernel every time the pod mix's resource
@@ -959,12 +1228,8 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
         with sp:
             buf = _read(packed)  # ONE host read
             sp.set(d2h_bytes=int(buf.nbytes), shape=str(tuple(buf.shape)))
-        nused, overflowed, nnz = int(buf[0]), bool(buf[1]), int(buf[2])
-        o = 3
-        unsched = buf[o: o + Gp]; o += Gp
-        ntype = buf[o: o + n_max]; o += n_max
-        idx = buf[o: o + k_max]; o += k_max
-        vals = buf[o: o + k_max]
+        (nused, overflowed, nnz, unsched, ntype, idx,
+         vals) = _parse_packed(buf, Gp, n_max, k_max)
         if nnz > k_max:
             # sparse budget too small: takes were truncated — regrow & rerun
             k_max = _bucket(nnz)
@@ -980,65 +1245,96 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
     sp = (TRACER.span("solve.decode") if TRACER.enabled
           else NOOP_SPAN)
     with sp:
-        # --- host-side reconstruction (vectorized, no device reads) ---
-        # pods_by_group keys refer to THIS enc's group indices; existing nodes'
-        # prior occupancy is baked into their input cum, so their dict reports
-        # only placements from this solve (same convention as solve_host).
-        n_total = min(nused, n_max)
-        take_g = idx[:nnz] // n_max
-        take_n = idx[:nnz] % n_max
-        take_v = vals[:nnz]
-
-        # cum: accumulate in ascending group order with the same f32 ops as the
-        # kernel so golden tests agree bitwise
-        cum = np.zeros((n_total, R), np.float32)
-        cum[:n_existing] = node_cum[:n_existing]
-        zmask = np.ones((n_total, cat.Z), bool)
-        cmask = np.ones((n_total, cat.C), bool)
-        zmask[:n_existing] = node_zmask[:n_existing]
-        cmask[:n_existing] = node_cmask[:n_existing]
-        fresh = np.ones(n_total, bool)
-        fresh[:n_existing] = False
-        t_avail_z = cat.available.any(axis=2)  # [T, Z]
-        t_avail_c = cat.available.any(axis=1)  # [T, C]
-        nt = ntype[:n_total]
-        zmask[fresh] = t_avail_z[nt[fresh]]
-        cmask[fresh] = t_avail_c[nt[fresh]]
-
-        # per-group vectorized accumulation in ascending group order — the same
-        # f32 add sequence per node as the kernel's scan, so values agree bitwise
-        pods_by_node: List[dict] = [dict() for _ in range(n_total)]
-        in_range = take_n < n_total
-        for g in range(G):
-            sel = (take_g == g) & in_range
-            if not sel.any():
-                continue
-            ns = take_n[sel]
-            vs = take_v[sel]
-            cum[ns] = cum[ns] + vs[:, None].astype(np.float32) * enc.requests[g][None, :].astype(np.float32)
-            zmask[ns] &= enc.allow_zone[g]
-            cmask[ns] &= enc.allow_cap[g]
-            for n, v in zip(ns.tolist(), vs.tolist()):
-                pods_by_node[n][g] = v
-
-        nodes: List[VirtualNode] = []
-        for i in range(n_total):
-            nodes.append(VirtualNode(
-                type_idx=int(nt[i]), zone_mask=zmask[i], cap_mask=cmask[i],
-                cum=cum[i], pods_by_group=pods_by_node[i],
-                banned_groups=existing[i].banned_groups if i < n_existing else None,
-                existing_name=existing[i].existing_name if i < n_existing else None))
-
-        unschedulable = {g: int(unsched[g]) for g in range(G) if unsched[g] > 0}
-        result = SolveResult(nodes=nodes, unschedulable=unschedulable)
-        # launch decisions straight from the dense arrays already in hand —
-        # finalize_offerings would re-stack per-node masks from the objects
-        # (several ms at 2k+ nodes, pure Python attribute traffic); the
-        # policy itself is the shared cheapest_offerings
-        fi = np.nonzero(fresh)[0]
-        if fi.size:
-            from .binpack import cheapest_offerings
-            result.launches = cheapest_offerings(nt[fi], zmask[fi], cmask[fi],
-                                                 cat)
-        sp.set(nodes=len(nodes), nnz=int(nnz))
+        result = _decode_solution(cat, enc, existing, node_cum, node_zmask,
+                                  node_cmask, nused, ntype, idx, vals, nnz,
+                                  unsched, n_max)
+        sp.set(nodes=len(result.nodes), nnz=int(nnz))
         return result
+
+
+def _parse_packed(buf: np.ndarray, Gp: int, n_max: int, k_max: int):
+    """Split one packed int32 result vector by the layout documented on
+    _solve_kernel_packed_impl — shared by the serial readback and every
+    row of a batched readback."""
+    nused, overflowed, nnz = int(buf[0]), bool(buf[1]), int(buf[2])
+    o = 3
+    unsched = buf[o: o + Gp]; o += Gp
+    ntype = buf[o: o + n_max]; o += n_max
+    idx = buf[o: o + k_max]; o += k_max
+    vals = buf[o: o + k_max]
+    return nused, overflowed, nnz, unsched, ntype, idx, vals
+
+
+def _decode_solution(cat: CatalogTensors, enc: EncodedPods,
+                     existing: List[VirtualNode], node_cum: np.ndarray,
+                     node_zmask: np.ndarray, node_cmask: np.ndarray,
+                     nused: int, ntype: np.ndarray, idx: np.ndarray,
+                     vals: np.ndarray, nnz: int, unsched: np.ndarray,
+                     n_max: int) -> SolveResult:
+    """Host-side reconstruction (vectorized, no device reads) — the ONE
+    decode the serial path and every batched row share, so the batched
+    results stay byte-identical to serial solves by construction.
+
+    pods_by_group keys refer to THIS enc's group indices; existing nodes'
+    prior occupancy is baked into their input cum, so their dict reports
+    only placements from this solve (same convention as solve_host)."""
+    R = enc.requests.shape[1]
+    G = enc.G
+    n_existing = len(existing)
+    n_total = min(nused, n_max)
+    take_g = idx[:nnz] // n_max
+    take_n = idx[:nnz] % n_max
+    take_v = vals[:nnz]
+
+    # cum: accumulate in ascending group order with the same f32 ops as the
+    # kernel so golden tests agree bitwise
+    cum = np.zeros((n_total, R), np.float32)
+    cum[:n_existing] = node_cum[:n_existing]
+    zmask = np.ones((n_total, cat.Z), bool)
+    cmask = np.ones((n_total, cat.C), bool)
+    zmask[:n_existing] = node_zmask[:n_existing]
+    cmask[:n_existing] = node_cmask[:n_existing]
+    fresh = np.ones(n_total, bool)
+    fresh[:n_existing] = False
+    t_avail_z = cat.available.any(axis=2)  # [T, Z]
+    t_avail_c = cat.available.any(axis=1)  # [T, C]
+    nt = ntype[:n_total]
+    zmask[fresh] = t_avail_z[nt[fresh]]
+    cmask[fresh] = t_avail_c[nt[fresh]]
+
+    # per-group vectorized accumulation in ascending group order — the same
+    # f32 add sequence per node as the kernel's scan, so values agree bitwise
+    pods_by_node: List[dict] = [dict() for _ in range(n_total)]
+    in_range = take_n < n_total
+    for g in range(G):
+        sel = (take_g == g) & in_range
+        if not sel.any():
+            continue
+        ns = take_n[sel]
+        vs = take_v[sel]
+        cum[ns] = cum[ns] + vs[:, None].astype(np.float32) * enc.requests[g][None, :].astype(np.float32)
+        zmask[ns] &= enc.allow_zone[g]
+        cmask[ns] &= enc.allow_cap[g]
+        for n, v in zip(ns.tolist(), vs.tolist()):
+            pods_by_node[n][g] = v
+
+    nodes: List[VirtualNode] = []
+    for i in range(n_total):
+        nodes.append(VirtualNode(
+            type_idx=int(nt[i]), zone_mask=zmask[i], cap_mask=cmask[i],
+            cum=cum[i], pods_by_group=pods_by_node[i],
+            banned_groups=existing[i].banned_groups if i < n_existing else None,
+            existing_name=existing[i].existing_name if i < n_existing else None))
+
+    unschedulable = {g: int(unsched[g]) for g in range(G) if unsched[g] > 0}
+    result = SolveResult(nodes=nodes, unschedulable=unschedulable)
+    # launch decisions straight from the dense arrays already in hand —
+    # finalize_offerings would re-stack per-node masks from the objects
+    # (several ms at 2k+ nodes, pure Python attribute traffic); the
+    # policy itself is the shared cheapest_offerings
+    fi = np.nonzero(fresh)[0]
+    if fi.size:
+        from .binpack import cheapest_offerings
+        result.launches = cheapest_offerings(nt[fi], zmask[fi], cmask[fi],
+                                             cat)
+    return result

@@ -73,6 +73,12 @@ class Options:
     # noisy-neighbor backpressure knob (fleet/service.py); only read in
     # fleet mode
     fleet_inflight_cap: int = 16
+    # arm the shared SolverService's batched + pipelined dispatch engine
+    # (fleet/service.py): compatible tenants' solves pack into one
+    # vmapped device call, encode/decode for batch k+1 overlaps device
+    # work for batch k. Results, hashes, and fault fingerprints are
+    # identical either way; only read in fleet mode
+    fleet_batch: bool = False
     # feature gates (reference Makefile:21-24 + settings.md)
     feature_gates: Dict[str, bool] = field(default_factory=lambda: {
         "SpotToSpotConsolidation": True,

@@ -1,0 +1,353 @@
+"""Batched-vs-serial dispatch parity fuzz (ISSUE 9 acceptance gate).
+
+The batched dispatch engine (ops/solver.dispatch_batch + the fleet
+service's batched pump) packs many tenants' solves into one vmapped
+device call. Its contract is BYTE-IDENTITY: every request's SolveOutput
+must equal what a serial per-ticket dispatch produces — same launches
+(type/zone/captype/price/overrides/pod keys), same placements, same
+unschedulable set — across randomized shape classes, batch-padding
+remainders, and mid-batch tenant catalog divergence (an ICE mark that
+splits one tenant off the shared device catalog). Same gate style as
+the encode-cache cold/cached fuzz: sweep the space the golden tests
+can't reach, fail by seed.
+
+Everything runs the device path on whatever backend jax resolved (CPU
+in tier-1) — the kernel is identical math either way.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_tpu.catalog import CatalogProvider
+from karpenter_tpu.catalog.generator import small_catalog
+from karpenter_tpu.fleet.service import SolverService
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.utils.clock import FakeClock
+
+POOL = NodePool(name="default")
+
+_CPUS = ["100m", "250m", "500m", "1", "2"]
+_MEMS = ["128Mi", "512Mi", "1Gi", "2Gi"]
+
+
+def _tenant_pods(rng: random.Random, tenant: str, n: int,
+                 manifests: int, anti: bool):
+    """n pods drawn from `manifests` distinct constraint signatures —
+    more manifests => more groups => a different padded shape class;
+    `anti` adds hostname anti-affinity (the conflict-tracking kernel
+    variant)."""
+    pods = []
+    for i in range(n):
+        s = i % manifests
+        kw = dict(requests=Resources.parse(
+            {"cpu": _CPUS[s % len(_CPUS)], "memory": _MEMS[s % len(_MEMS)]}),
+            labels={"app": f"{tenant}-m{s}"})
+        if s % 3 == 0:
+            kw["node_selector"] = {
+                L.ZONE: rng.choice(["zone-a", "zone-b"])}
+        if anti and s % 4 == 1:
+            kw["affinity_terms"] = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": f"{tenant}-m{s}"}, anti=True)]
+        pods.append(Pod(name=f"{tenant}-p{i}", **kw))
+    return pods
+
+
+def _mk_fleet(rng: random.Random, n_tenants: int):
+    """(tenant name, pods, ice?) rows — a randomized mix of shape
+    classes; one tenant may take an ICE mark (catalog divergence)."""
+    rows = []
+    ice_at = rng.randrange(n_tenants) if rng.random() < 0.7 else -1
+    for t in range(n_tenants):
+        name = f"t{t:02d}"
+        manifests = rng.choice([3, 5, 8, 12])
+        n = rng.randrange(4, 28)
+        anti = rng.random() < 0.3
+        rows.append((name, _tenant_pods(rng, name, n, manifests, anti),
+                     t == ice_at))
+    return rows
+
+
+def _run_serial(rows, types):
+    svc = SolverService(FakeClock(), backend="device")
+    outs = {}
+    for name, pods, ice in rows:
+        client = svc.register(name, CatalogProvider(lambda: types))
+        if ice:
+            client.catalog.unavailable.mark_unavailable(
+                types[0].name, "zone-a", "spot", reason="fuzz")
+        outs[name] = client.solve(pods, POOL)
+    return outs
+
+
+def _run_batched(rows, types):
+    svc = SolverService(FakeClock(), backend="device", batch=True)
+    clients = {}
+    for name, pods, ice in rows:
+        clients[name] = svc.register(name, CatalogProvider(lambda: types))
+        if ice:
+            clients[name].catalog.unavailable.mark_unavailable(
+                types[0].name, "zone-a", "spot", reason="fuzz")
+    tickets = {name: clients[name].solve_async(pods, POOL)
+               for name, pods, _ in rows}
+    svc.pump()
+    return {name: tk.result() for name, tk in tickets.items()}, svc
+
+
+def _assert_identical(serial, batched, seed):
+    assert serial.keys() == batched.keys()
+    for name in serial:
+        s, b = serial[name], batched[name]
+        assert s.launches == b.launches, (
+            f"seed {seed} tenant {name}: launches diverged")
+        assert s.existing_placements == b.existing_placements, (
+            f"seed {seed} tenant {name}: placements diverged")
+        assert s.unschedulable == b.unschedulable, (
+            f"seed {seed} tenant {name}: unschedulable diverged")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_batched_dispatch_byte_identical_to_serial(seed):
+    rng = random.Random(seed * 7919 + 13)
+    types = small_catalog()
+    rows = _mk_fleet(rng, n_tenants=rng.randrange(3, 7))
+    serial = _run_serial(rows, types)
+    batched, svc = _run_batched(rows, types)
+    _assert_identical(serial, batched, seed)
+    # the fleet actually co-batched something whenever >=2 tenants
+    # shared a shape class AND a catalog view this round
+    assert svc.stats["dispatched"] == len(rows)
+
+
+def test_padding_remainder_rows_are_inert():
+    """A 5-request bucket pads its request axis to 6: the padded row
+    must place nothing, and every real row decodes as if dispatched
+    alone."""
+    types = small_catalog()
+    rows = [(f"t{i:02d}",
+             _tenant_pods(random.Random(i), f"t{i:02d}", 6 + i, 3, False),
+             False)
+            for i in range(5)]
+    serial = _run_serial(rows, types)
+    batched, svc = _run_batched(rows, types)
+    _assert_identical(serial, batched, "pad")
+    assert svc.stats["batches"] == 1
+    assert svc.stats["batched_tickets"] == 5
+    assert svc.stats["padded_slots"] == 6  # {1,2,3,4,6,8,...} ladder
+
+
+def test_mid_batch_ice_divergence_splits_the_bucket():
+    """One tenant's ICE mark re-fingerprints its catalog view: it may
+    no longer share the batch's device catalog, so it dispatches in its
+    own bucket — and its result reflects the mark while its neighbors'
+    do not (isolation by content, exactly like the shared-catalog
+    cache)."""
+    types = small_catalog()
+    rng = random.Random(99)
+    rows = [("t00", _tenant_pods(rng, "t00", 8, 3, False), False),
+            ("t01", _tenant_pods(rng, "t01", 8, 3, False), True),
+            ("t02", _tenant_pods(rng, "t02", 8, 3, False), False)]
+    serial = _run_serial(rows, types)
+    batched, svc = _run_batched(rows, types)
+    _assert_identical(serial, batched, "ice")
+    # the diverged tenant could not ride the shared bucket: >= 2 device
+    # calls served the round (shape classes agree, catalogs do not)
+    assert svc.stats["batches"] >= 2
+
+
+def test_two_staged_encodes_of_one_tenant_do_not_alias():
+    """Regression: a staged EncodedPods holds views into its facade's
+    staging arena, valid only until the next encode leases it — and the
+    batched pump interleaves MANY encodes before any dispatch. Two
+    same-tenant tickets in one pump must decode to what two serial
+    solves produce (the pump pre-leases the arena so each staged encode
+    owns its memory)."""
+    types = small_catalog()
+    rng = random.Random(21)
+    # the SECOND encode is the smaller one, so the arena's capacity-
+    # doubling buffers would be REUSED (not regrown) — without the
+    # pump's pre-lease, ticket b's stage overwrites ticket a's rows
+    pods_a = _tenant_pods(rng, "x", 14, 6, True)
+    pods_b = _tenant_pods(rng, "y", 9, 4, False)
+
+    serial_svc = SolverService(FakeClock(), backend="device")
+    sc = serial_svc.register("t", CatalogProvider(lambda: types))
+    ser_a, ser_b = sc.solve(pods_a, POOL), sc.solve(pods_b, POOL)
+
+    svc = SolverService(FakeClock(), backend="device", batch=True)
+    client = svc.register("t", CatalogProvider(lambda: types))
+    ta = client.solve_async(pods_a, POOL)
+    tb = client.solve_async(pods_b, POOL)
+    svc.pump()
+    assert ta.result().launches == ser_a.launches
+    assert ta.result().unschedulable == ser_a.unschedulable
+    assert tb.result().launches == ser_b.launches
+    assert tb.result().unschedulable == ser_b.unschedulable
+    # the arena lease is released once the pump drains: the NEXT solve
+    # takes the zero-copy fast path again and still agrees
+    assert not client.facade._arena._leased
+    assert client.solve(pods_a, POOL).launches == ser_a.launches
+
+
+def test_solve_async_counts_against_the_inflight_cap():
+    """The window cap must gate SUBMISSION, not just dispatch: queued-
+    but-unpumped async tickets count, or a tenant could park an
+    unbounded storm between pumps."""
+    from karpenter_tpu.fleet.service import SolverServiceBusy
+    types = small_catalog()
+    svc = SolverService(FakeClock(), backend="device", batch=True,
+                        inflight_cap=2)
+    client = svc.register("a", CatalogProvider(lambda: types))
+    pods = _tenant_pods(random.Random(1), "a", 4, 2, False)
+    t1 = client.solve_async(pods, POOL)
+    t2 = client.solve_async(pods, POOL)
+    with pytest.raises(SolverServiceBusy):
+        client.solve_async(pods, POOL)
+    svc.pump()
+    assert t1.result().launches and t2.result().launches
+    # dispatched tickets still occupy the window until it rolls
+    with pytest.raises(SolverServiceBusy):
+        client.solve_async(pods, POOL)
+    svc.clock.step(svc.window + 1)
+    assert client.solve(pods, POOL).launches
+
+
+def test_block_failure_degrades_only_that_batch(monkeypatch):
+    """Real device errors surface at block()/readback, not at dispatch
+    — the containment contract must hold there too: the batch's tickets
+    re-run through their facades, nothing escapes pump()."""
+    from karpenter_tpu.metrics import FLEET_SHAPE_CLASS
+    from karpenter_tpu.ops.solver import InFlightBatch
+
+    def boom(self):
+        raise RuntimeError("device lost at readback")
+
+    monkeypatch.setattr(InFlightBatch, "block", boom)
+    types = small_catalog()
+    svc = SolverService(FakeClock(), backend="device", batch=True)
+    a = svc.register("a", CatalogProvider(lambda: types))
+    b = svc.register("b", CatalogProvider(lambda: types))
+    ta = a.solve_async(_tenant_pods(random.Random(1), "a", 5, 2, False),
+                       POOL)
+    tb = b.solve_async(_tenant_pods(random.Random(2), "b", 5, 2, False),
+                       POOL)
+    svc.pump()  # must not raise
+    assert ta.result().launches and tb.result().launches
+    assert FLEET_SHAPE_CLASS.value(event="fault_fallback", tenant="a") >= 1
+    assert FLEET_SHAPE_CLASS.value(event="fault_fallback", tenant="b") >= 1
+
+
+def test_tenant_targeted_fault_spares_cobatched_neighbors():
+    """The device-fault seam is probed under EACH bucket tenant's scope:
+    a fault targeting tenant b aborts the shared call, but only b's
+    facade degrades — a's serial re-run keeps the device path."""
+    from karpenter_tpu.metrics.tenant import current_tenant
+    from karpenter_tpu.ops import solver as ops_solver
+    types = small_catalog()
+    svc = SolverService(FakeClock(), backend="device", batch=True)
+    a = svc.register("a", CatalogProvider(lambda: types))
+    b = svc.register("b", CatalogProvider(lambda: types))
+
+    def hook(backend):
+        if current_tenant() == "b":
+            raise RuntimeError("injected: tenant b's device is gone")
+
+    ops_solver.set_dispatch_fault_hook(hook)
+    try:
+        ta = a.solve_async(_tenant_pods(random.Random(3), "a", 5, 2,
+                                        False), POOL)
+        tb = b.solve_async(_tenant_pods(random.Random(4), "b", 5, 2,
+                                        False), POOL)
+        svc.pump()
+        assert ta.result().launches and tb.result().launches
+        assert a.facade.stats["device_fallbacks"] == 0  # stayed on device
+        assert b.facade.stats["device_fallbacks"] == 1  # degraded alone
+    finally:
+        ops_solver.set_dispatch_fault_hook(None)
+
+
+def test_catalog_divergence_never_trips_pipeline_stall():
+    """Two tenants with EQUAL shape classes but diverged catalog views
+    legitimately never co-batch — co-pending is counted on the full
+    signature, so the watchdog's pipeline_stall cannot false-positive
+    on them (the PR 8 zero-false-positive contract)."""
+    from karpenter_tpu.obs.watchdog import Watchdog
+    types = small_catalog()
+    svc = SolverService(FakeClock(), backend="device", batch=True)
+    a = svc.register("a", CatalogProvider(lambda: types))
+    b = svc.register("b", CatalogProvider(lambda: types))
+    b.catalog.unavailable.mark_unavailable(types[0].name, "zone-a",
+                                           "spot", reason="split")
+    wd = Watchdog(svc.clock, service=svc).arm()
+    pods = _tenant_pods(random.Random(6), "p", 6, 3, False)
+    for _ in range(wd.COBATCH_MIN_PUMPS + 1):
+        ta, tb = a.solve_async(pods, POOL), b.solve_async(pods, POOL)
+        svc.pump()
+        assert ta.result().launches and tb.result().launches
+        svc.clock.step(6.0)
+        wd.tick(force=True)
+    assert wd.fired("pipeline_stall") == 0
+
+
+def test_ledger_attributes_batching_overhead_with_full_coverage():
+    """ISSUE 9 profile satellite: a traced batched pump lands
+    `batch_pack` and `pipeline_wait` in the phase ledger, and the >=99%
+    coverage invariant stays green — `fleet.pump` roots the trace and is
+    itself mapped, so the pump's own glue attributes to queue_wait."""
+    from karpenter_tpu.obs import TRACER
+    from karpenter_tpu.obs.profile import LEDGER
+    types = small_catalog()
+    svc = SolverService(FakeClock(), backend="device", batch=True)
+    clients = [svc.register(f"t{i}", CatalogProvider(lambda: types))
+               for i in range(3)]
+    warm = [c.solve_async(_tenant_pods(random.Random(i), f"w{i}", 5, 3,
+                                       False), POOL)
+            for i, c in enumerate(clients)]
+    svc.pump()
+    for t in warm:
+        t.result()
+    LEDGER.reset()
+    TRACER.configure(enabled=True)
+    try:
+        tickets = [c.solve_async(_tenant_pods(random.Random(i), f"x{i}",
+                                              5, 3, False), POOL)
+                   for i, c in enumerate(clients)]
+        svc.pump()
+        for t in tickets:
+            t.result()
+    finally:
+        TRACER.configure(enabled=False)
+    snap = LEDGER.snapshot()
+    buckets = {b for tenant in snap["phases"].values()
+               for kind in tenant.values() for b in kind}
+    assert "batch_pack" in buckets, buckets
+    assert "pipeline_wait" in buckets, buckets
+    assert LEDGER.coverage(kind="reconcile") >= 0.99
+    # per-TENANT attribution inside the shared trace: each co-batched
+    # tenant's stage/decode phases land on ITS series (the per-ticket
+    # spans carry tenant attrs; children inherit), while the shared
+    # machinery (batch_pack, pipeline_wait) stays on the ambient tenant
+    for t in ("t0", "t1", "t2"):
+        t_buckets = {b for kind in snap["phases"].get(t, {}).values()
+                     for b in kind}
+        assert "decode" in t_buckets, (t, sorted(t_buckets))
+    LEDGER.reset()
+
+
+def test_sync_solve_through_batched_pump_matches_serial_pump():
+    """client.solve() (submit+pump+result) must behave identically on
+    both engines — the fleet runner path."""
+    types = small_catalog()
+    pods = _tenant_pods(random.Random(5), "x", 10, 5, True)
+    serial = SolverService(FakeClock(), backend="device") \
+        .register("x", CatalogProvider(lambda: types)).solve(pods, POOL)
+    batched = SolverService(FakeClock(), backend="device", batch=True) \
+        .register("x", CatalogProvider(lambda: types)).solve(pods, POOL)
+    assert serial.launches == batched.launches
+    assert serial.unschedulable == batched.unschedulable

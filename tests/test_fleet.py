@@ -360,3 +360,154 @@ class TestFleetScenarios:
         assert main(["fleet_smoke", "--tenants", "3"]) == 0
         out = capsys.readouterr().out
         assert "[PASS]" in out and "tenants=3" in out
+
+
+class TestBatchedDispatch:
+    """The batched + pipelined pump (ISSUE 9): shape-class co-batching,
+    batch-aware fairness, fault containment, and the chaos parity
+    contract (hashes/fingerprints identical with batching on and off).
+    Byte parity of the outputs themselves is tests/test_batch_parity.py."""
+
+    def _svc(self, **kw):
+        kw.setdefault("backend", "device")
+        kw.setdefault("batch", True)
+        return SolverService(FakeClock(), **kw)
+
+    def test_compatible_tenants_share_one_device_call(self):
+        svc = self._svc()
+        types = small_catalog()
+        clients = [svc.register(f"t{i}", CatalogProvider(lambda: types))
+                   for i in range(4)]
+        pool = NodePool(name="default")
+        tickets = [c.solve_async(mk_pods(6, f"p{i}"), pool)
+                   for i, c in enumerate(clients)]
+        svc.pump()
+        for t in tickets:
+            assert t.result().launches
+            assert t.batch_size == 4
+            assert t.shape_class.startswith("g")
+        assert svc.stats["batches"] == 1
+        assert svc.stats["batched_tickets"] == 4
+        cs = svc.class_stats[tickets[0].shape_class]
+        assert cs["cobatched_pumps"] == 1 and cs["copending_pumps"] == 1
+
+    def test_odd_shape_tenant_rides_its_rank_not_the_back(self):
+        """Batch-aware fairness: an odd-shaped ticket interleaved into a
+        big class keeps its DRR rank (its singleton bucket dispatches at
+        that rank), and the class still co-batches around it."""
+        svc = self._svc()
+        types = small_catalog()
+        pool = NodePool(name="default")
+        big = [svc.register(f"b{i}", CatalogProvider(lambda: types))
+               for i in range(3)]
+        odd = svc.register("odd", CatalogProvider(lambda: types))
+        # the odd tenant carries 10 DISTINCT manifests: its group axis
+        # pads to a bigger bucket than the one-manifest tenants', so its
+        # padded shape class differs — it cannot join their batch
+        odd_pods = [Pod(name=f"o{i}",
+                        requests=Resources.parse(
+                            {"cpu": f"{100 + 50 * i}m",
+                             "memory": f"{256 + 64 * i}Mi"}))
+                    for i in range(10)]
+        t0 = big[0].solve_async(mk_pods(6, "b0"), pool)
+        t_odd = odd.solve_async(odd_pods, pool)
+        t1 = big[1].solve_async(mk_pods(6, "b1"), pool)
+        t2 = big[2].solve_async(mk_pods(6, "b2"), pool)
+        svc.pump()
+        assert t_odd.result().launches
+        assert t_odd.dispatch_rank == 1          # kept its DRR rank
+        assert t_odd.batch_size == 1             # its own (device) bucket
+        for t in (t0, t1, t2):
+            assert t.result().launches
+            assert t.batch_size == 3             # class co-batched around it
+        assert svc.stats["batches"] == 2
+
+    def test_device_fault_mid_batch_degrades_only_that_batch(self):
+        """ISSUE 9 chaos satellite: a device fault mid-batch degrades
+        exactly the tickets IN that batch (each re-runs through its own
+        facade's fallback machinery), not the shape-class bucket — a
+        later tenant of the same class keeps the device path."""
+        from karpenter_tpu.metrics import FLEET_SHAPE_CLASS
+        from karpenter_tpu.ops import solver as ops_solver
+        svc = self._svc()
+        types = small_catalog()
+        pool = NodePool(name="default")
+        a = svc.register("a", CatalogProvider(lambda: types))
+        b = svc.register("b", CatalogProvider(lambda: types))
+        c = svc.register("c", CatalogProvider(lambda: types))
+        armed = {"on": True}
+
+        def hook(backend):
+            if armed["on"]:
+                raise RuntimeError("injected device loss")
+
+        ops_solver.set_dispatch_fault_hook(hook)
+        # the shape-class counter is process-cumulative: assert deltas
+        fb = lambda t: FLEET_SHAPE_CLASS.value(event="fault_fallback",
+                                               tenant=t)
+        solo = lambda t: FLEET_SHAPE_CLASS.value(event="solo", tenant=t)
+        fb_a0, fb_b0, solo_c0 = fb("a"), fb("b"), solo("c")
+        try:
+            ta = a.solve_async(mk_pods(4, "a"), pool)
+            tb = b.solve_async(mk_pods(4, "b"), pool)
+            svc.pump()
+            # both still produced full placements — via host fallback
+            assert ta.result().launches and tb.result().launches
+            assert fb("a") == fb_a0 + 1
+            assert fb("b") == fb_b0 + 1
+            assert a.facade.stats["device_fallbacks"] == 1
+            assert b.facade.stats["device_fallbacks"] == 1
+            armed["on"] = False
+            # tenant c (same shape class, NOT in the faulted batch)
+            # dispatches on the device — the bucket was never condemned
+            tc = c.solve_async(mk_pods(4, "c"), pool)
+            svc.pump()
+            assert tc.result().launches
+            assert tc.batch_size == 1
+            assert solo("c") == solo_c0 + 1
+            assert c.facade.stats["device_fallbacks"] == 0
+            # a/b facades ride their own cooldown (host), exactly like a
+            # serial fault — metered as serial tickets, not fallbacks
+            ta2 = a.solve_async(mk_pods(4, "a2"), pool)
+            svc.pump()
+            assert ta2.result().launches
+            assert FLEET_SHAPE_CLASS.value(event="serial", tenant="a") >= 1
+        finally:
+            ops_solver.set_dispatch_fault_hook(None)
+
+    def test_fleet_smoke_hashes_identical_batch_on_and_off(self):
+        """The chaos parity contract: batching is an execution detail —
+        per-tenant end-state hashes AND fault fingerprints must be
+        unchanged vs serial dispatch."""
+        serial = FleetRunner("fleet_smoke", tenants=6, seed=3,
+                             batch=False).run()
+        batched = FleetRunner("fleet_smoke", tenants=6, seed=3,
+                              batch=True).run()
+        assert serial.ok, serial.summary()
+        assert batched.ok, batched.summary()
+        assert serial.tenant_hashes == batched.tenant_hashes
+        assert serial.tenant_fingerprints == batched.tenant_fingerprints
+        assert serial.fleet_hash == batched.fleet_hash
+        assert serial.fleet_fingerprint == batched.fleet_fingerprint
+        assert "pipeline_overlap_ratio" in batched.stats
+
+    @pytest.mark.slow
+    def test_noisy_neighbor_hashes_identical_batch_on_and_off(self):
+        serial = FleetRunner("fleet_noisy_neighbor", seed=0,
+                             batch=False).run()
+        batched = FleetRunner("fleet_noisy_neighbor", seed=0,
+                              batch=True).run()
+        assert serial.ok and batched.ok
+        assert serial.fleet_hash == batched.fleet_hash
+        assert serial.fleet_fingerprint == batched.fleet_fingerprint
+
+    def test_debug_fleet_reports_pipeline_state(self):
+        svc = self._svc()
+        types = small_catalog()
+        client = svc.register("a", CatalogProvider(lambda: types))
+        client.solve(mk_pods(4, "x"), NodePool(name="default"))
+        payload = svc.debug_payload()
+        assert payload["batch"]["armed"] is True
+        assert payload["batch"]["inflight_age"] is None  # pump drains
+        assert payload["batch"]["classes"]
+        assert 0.0 <= payload["batch"]["overlap_ratio"] <= 1.0

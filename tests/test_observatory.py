@@ -63,6 +63,12 @@ class TestPhaseLedgerMapping:
         ("journal.fsync", {"records": 1}, "journal_fsync"),
         ("cloud.create_fleet", {}, "cloud_api"),
         ("fleet.submit", {}, "queue_wait"),
+        # batched dispatch engine (fleet/service.py batch=True):
+        # request packing + batch upload, and the pipeline's blocked
+        # wait on an in-flight device batch
+        ("solve.batch_pack", {"h2d_bytes": 512, "requests": 4},
+         "batch_pack"),
+        ("fleet.pipeline_wait", {"batch": 4}, "pipeline_wait"),
         ("reconcile:provisioner", {}, "reconcile_other"),
     ]
 
@@ -151,7 +157,10 @@ class TestCoverageInvariant:
             with tr.span("solve.run", backend="host"):
                 pass
         snap = led.snapshot()
-        assert snap["virtual_queue_wait_ms"]["default"] == 7.5
+        # the span's own tenant attr wins over the trace-level scope:
+        # a batched pump serves many tenants inside one trace, and each
+        # ticket's virtual wait must land on ITS series
+        assert snap["virtual_queue_wait_ms"]["a"] == 7.5
 
     def test_signature_class_aggregation(self):
         tr, led = _ledger_tracer()

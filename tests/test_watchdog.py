@@ -60,7 +60,8 @@ class TestArming:
         assert INVARIANTS == (
             "claim_leak", "store_cloud_drift", "intent_age",
             "warm_audit_lag", "warm_divergence", "fleet_starvation",
-            "profile_unattributed", "trace_ring_overflow")
+            "pipeline_stall", "profile_unattributed",
+            "trace_ring_overflow")
 
 
 class TestTrips:
@@ -216,6 +217,44 @@ class TestTrips:
         assert any(f.key == "backlog"
                    for f in _findings(wd2, "fleet_starvation"))
         svc.pump()
+
+    def test_trip_pipeline_stall(self):
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        from karpenter_tpu.fleet.service import SolverService
+        clock = FakeClock()
+        svc = SolverService(clock, backend="host", batch=True)
+        svc.register("a", CatalogProvider(lambda: small_catalog()))
+        wd = Watchdog(clock, service=svc, pipeline_grace=30.0).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "pipeline_stall")
+        # flavor 1: a device batch dispatched and never drained — the
+        # async pipeline wedged (a hung tunnel the synchronous pump
+        # cannot hang on); a healthy pump always drains before returning
+        svc._inflight_since = float(clock.now())
+        clock.step(60.0)
+        wd.tick(force=True)
+        found = _findings(wd, "pipeline_stall")
+        assert found and found[0].severity == "warning"
+        assert found[0].key == "inflight"
+        # draining clears the excursion (edge re-arms)
+        svc._inflight_since = None
+        wd.tick(force=True)
+        assert ("pipeline_stall", "inflight") not in wd._active
+        # flavor 2: a shape class that co-pends >=2 tickets pump after
+        # pump but NEVER co-batches them — the bucketing silently dead
+        svc.class_stats["g8/n64"] = {
+            "tickets": 12, "batches": 6,
+            "copending_pumps": wd.COBATCH_MIN_PUMPS, "cobatched_pumps": 0}
+        wd.tick(force=True)
+        assert any(f.key == "class/g8/n64"
+                   for f in _findings(wd, "pipeline_stall"))
+        # a serial service (batch unarmed) never evaluates the monitor
+        svc2 = SolverService(FakeClock(), backend="host")
+        svc2._inflight_since = -1e9
+        wd2 = Watchdog(svc2.clock, service=svc2).arm()
+        wd2.tick(force=True)
+        assert not _findings(wd2, "pipeline_stall")
 
     def test_trip_profile_unattributed(self):
         from karpenter_tpu.obs.profile import LEDGER
