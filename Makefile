@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
         deflake run native trace-report profile-report obs-audit chaos \
         crash-audit warmpath-audit encode-report fleet fleet-audit \
-        perf-gate clean
+        perf-gate device-report clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -48,6 +48,9 @@ warmpath-audit:  ## warm-path auditor in always-on mode over the chaos smoke + s
 
 encode-report:  ## columnar encode pipeline: cold vs cached cost + hit rate (PODS=n TICKS=n)
 	$(PY) tools/encode_report.py --pods $(or $(PODS),10000) --ticks $(or $(TICKS),5)
+
+device-report:  ## device telemetry plane: HBM residency, transfer attribution, upload redundancy (PODS=n ROUNDS=n)
+	$(PY) tools/device_report.py --pods $(or $(PODS),2000) --rounds $(or $(ROUNDS),4)
 
 fleet:  ## drive TENANTS (default 50) tenant control planes through one process + one SolverService (serial, then batched dispatch)
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --tenants $(or $(TENANTS),50)

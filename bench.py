@@ -335,8 +335,24 @@ def main() -> None:
                repeats=3) * 1e3, 1)
     cat.cache_token = None
     solve_device(cat, enc3)
+    # device telemetry: the warm re-solves below re-upload the SAME
+    # request matrix — the identical-byte fraction is the measured
+    # delta-upload headroom ROADMAP item 3 banks on, and the residency
+    # audit proves the ledger accounts for what actually lives on HBM
+    from karpenter_tpu.obs.devicemem import DEVICEMEM, UPLOADS
+    _ri0, _rt0 = UPLOADS.totals()
     detail["c3_50k_affinity_ms"] = round(
         timeit(lambda: solve_device(cat, enc3), repeats=3) * 1e3, 1)
+    _ri1, _rt1 = UPLOADS.totals()
+    if _rt1 > _rt0:
+        detail["c3_upload_redundant_frac"] = round(
+            (_ri1 - _ri0) / (_rt1 - _rt0), 4)
+    _aud3 = DEVICEMEM.audit()
+    detail["c3_devicemem_coverage"] = _aud3.get("coverage", 0.0)
+    if _aud3.get("coverage", 1.0) < 0.99:
+        progress(f"DEVICEMEM ATTRIBUTION GAP: coverage "
+                 f"{_aud3['coverage']:.4f} < 0.99 "
+                 f"({_aud3['unaccounted_bytes']:,} B unaccounted)")
 
     progress("c4: 5k-node consolidation screen")
     # --- config 4: 5k-node consolidation screen (one batched kernel call) ---
@@ -690,12 +706,15 @@ def main() -> None:
                   for t in range(N12)]
     for t in range(N12):  # warm: compile the serial executable
         clients12d[t].solve(bursts12[t], pool12)
+    from karpenter_tpu.ops.solver import transfer_bytes as _xfer
+    _h0, _d0 = _xfer()
     t0 = time.perf_counter()
     for _ in range(R12):
         for t in range(N12):
             out = clients12d[t].solve(bursts12[t], pool12)
             assert out.launches
     device_serial_s = time.perf_counter() - t0
+    _serial_h2d, _serial_d2h = _xfer()[0] - _h0, _xfer()[1] - _d0
 
     # regime 5 — BATCHED + PIPELINED dispatch (ROADMAP item 2): the same
     # 16 tenants submit each round ASYNC, so the round's compatible
@@ -711,6 +730,14 @@ def main() -> None:
     service12b.pump()  # warm: compiles the batched executable
     for tk in warm12b:
         assert tk.result().launches
+    # device telemetry baseline for the batched regime: reset the HBM
+    # watermark to current residency and snapshot the transfer/upload
+    # meters — the regime's own footprint and volume, not the bench's
+    from karpenter_tpu.obs.devicemem import DEVICEMEM as _DM
+    from karpenter_tpu.obs.devicemem import UPLOADS as _UP
+    _DM.reset()
+    _h0, _d0 = _xfer()
+    _bi0, _bt0 = _UP.totals()
     round_walls = []
     for _ in range(R12):
         r0 = time.perf_counter()
@@ -721,6 +748,8 @@ def main() -> None:
             assert tk.result().launches
         round_walls.append(time.perf_counter() - r0)
     batched_s = sum(round_walls)
+    _batched_h2d, _batched_d2h = _xfer()[0] - _h0, _xfer()[1] - _d0
+    _bi1, _bt1 = _UP.totals()
 
     # one traced extra round through the service (untimed): the ledger's
     # per-TENANT solve attribution — pump() scopes each dispatch to its
@@ -784,6 +813,23 @@ def main() -> None:
     # p99 < 150ms acceptance reads this key on a comparable TPU run)
     detail["c12_batched_request_p99_ms"] = round(
         max(round_walls) * 1e3, 1)
+    # device-telemetry keys (ISSUE 10): the per-regime transfer
+    # breakdown (batched dispatch must move the same pods in FEWER,
+    # fatter crossings — byte growth here is a volume regression the
+    # perf gate reads as lower-better), the batched regime's HBM
+    # watermark, and the fleet warm path's upload redundancy
+    detail["c12_device_serial_h2d_bytes"] = int(_serial_h2d)
+    detail["c12_device_serial_d2h_bytes"] = int(_serial_d2h)
+    detail["c12_batched_h2d_bytes"] = int(_batched_h2d)
+    detail["c12_batched_d2h_bytes"] = int(_batched_d2h)
+    detail["c12_hbm_watermark_bytes"] = int(_DM.watermark_bytes)
+    if _bt1 > _bt0:
+        detail["c12_upload_redundant_frac"] = round(
+            (_bi1 - _bi0) / (_bt1 - _bt0), 4)
+    _aud12 = _DM.audit()
+    detail["devicemem_coverage"] = _aud12.get("coverage", 0.0)
+    detail["devicemem_unaccounted_bytes"] = int(
+        _aud12.get("unaccounted_bytes", 0))
     # the headline batched key (ISSUE 9 acceptance):
     detail["fleet_batched_solves_per_sec"] = \
         detail["c12_fleet_batched_solves_per_sec"]

@@ -366,6 +366,67 @@ WATCHDOG_VERDICT = REGISTRY.gauge(
     "1 = warning, 2 = critical. /readyz answers 503 while this reads 2 "
     "— the readiness face of the verification plane",
     ("tenant",), label_defaults=_TENANT)
+DEVICEMEM_LIVE = REGISTRY.gauge(
+    "karpenter_tpu_devicemem_live_bytes",
+    "Bytes currently resident on the device per residency-ledger owner "
+    "kind (obs/devicemem.py OWNER_KINDS: catalog tensors, per-solve "
+    "uploads, batched request matrices, packed results, mesh shards) — "
+    "the live face of the HBM accounting ROADMAP item 3's device-"
+    "resident state will be judged against", ("kind",))
+DEVICEMEM_WATERMARK = REGISTRY.gauge(
+    "karpenter_tpu_devicemem_watermark_bytes",
+    "High-water mark of total ledger-tracked device bytes since process "
+    "start (or the last bench regime reset) — the HBM footprint budget "
+    "observable; bench stamps it as c12_hbm_watermark_bytes")
+DEVICEMEM_UNATTRIBUTED = REGISTRY.gauge(
+    "karpenter_tpu_devicemem_unattributed_bytes",
+    "Live device bytes the residency ledger could NOT account for at "
+    "the last audit() cross-check against jax.live_arrays() — the "
+    "memory analog of the phase ledger's coverage invariant: growth "
+    "means an untracked allocation path appeared; coverage below 99% "
+    "also flight-records a devicemem.unattributed marker trace")
+DEVICEMEM_TRANSFER = REGISTRY.counter(
+    "karpenter_tpu_devicemem_transfer_bytes_total",
+    "Device-boundary bytes by attribution reason (catalog_put / "
+    "request_upload / batch_upload / screen_upload / readback) and "
+    "tenant — the decomposed successor of the two aggregate transfer "
+    "gauges: which tenant's which path moved the bytes, scrapeable "
+    "without a bench run (per-shape-class rows live on /debug/device)",
+    ("reason", "tenant"), label_defaults=_TENANT)
+UPLOAD_BYTES = REGISTRY.counter(
+    "karpenter_tpu_devicemem_upload_bytes_total",
+    "Uploaded request-matrix bytes by redundancy outcome: 'identical' "
+    "rows content-hash equal to the previous upload of the same "
+    "facade/catalog-view key (bytes a delta upload would NOT ship), "
+    "'changed' rows differ (the irreducible upload). The identical "
+    "share is the measured ROADMAP-item-3 target",
+    ("outcome", "tenant"), label_defaults=_TENANT)
+UPLOAD_REDUNDANT_FRAC = REGISTRY.gauge(
+    "karpenter_tpu_devicemem_upload_redundant_frac",
+    "Fraction of the LAST observed request-matrix upload whose rows "
+    "were content-identical to the previous upload for that catalog "
+    "view (0..1): ~1.0 on a steady warm path means almost every "
+    "uploaded byte is a byte the device already holds — informational "
+    "(never perf-gated), it sizes the delta-upload win",
+    ("tenant",), label_defaults=_TENANT)
+DCAT_EVICTIONS = REGISTRY.counter(
+    "karpenter_tpu_solver_dcat_evictions_total",
+    "Device-resident catalog entries evicted, by reason: 'weakref' = "
+    "the owning CatalogTensors died (id-keyed lifecycle), 'fifo' = the "
+    "token-keyed bound trimmed the oldest shared view, 'stale' = an "
+    "entry was rebuilt because its shape/overhead no longer served the "
+    "request, 'view_evicted' = the SharedCatalogCache dropped the view "
+    "so its device residency was released with it, 'facade_lru' = a "
+    "facade's catalog LRU rolled its device variants out. Churn here "
+    "is re-upload cost; a dead view pinning buffers would show as "
+    "residency without evictions", ("reason",))
+TRACE_RING_DROPPED = REGISTRY.counter(
+    "karpenter_tpu_trace_ring_dropped_total",
+    "Traces the flight-recorder ring rejected (full of slower "
+    "residents), per tenant — the tenant-attributed face of the "
+    "watchdog's trace_ring_overflow monitor: one tenant's hot loop "
+    "overflowing the ring must point at that tenant, not at the fleet",
+    ("tenant",), label_defaults=_TENANT)
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

@@ -6,7 +6,9 @@ for the span/phase taxonomies and how to read a bench trace."""
 from .tracer import (NOOP_SPAN, TRACER, FlightRecorder, Span, Trace, Tracer,
                      summarize, to_chrome_events, write_chrome_trace)
 # importing installs the process ledger as a tracer sink and registers
-# /debug/profile + /debug/explain; both are free while tracing is off
+# /debug/profile + /debug/explain + /debug/device; all are free while
+# tracing is off / nothing touches the device
+from .devicemem import DEVICEMEM, TRANSFERS, UPLOADS
 from .explain import RECORDER
 from .profile import LEDGER, PHASES, PhaseLedger
 from .watchdog import INVARIANTS, Finding, Watchdog
@@ -14,4 +16,5 @@ from .watchdog import INVARIANTS, Finding, Watchdog
 __all__ = ["TRACER", "Tracer", "Span", "Trace", "FlightRecorder",
            "NOOP_SPAN", "to_chrome_events", "write_chrome_trace",
            "summarize", "LEDGER", "PHASES", "PhaseLedger", "RECORDER",
-           "Watchdog", "Finding", "INVARIANTS"]
+           "Watchdog", "Finding", "INVARIANTS", "DEVICEMEM", "TRANSFERS",
+           "UPLOADS"]

@@ -154,6 +154,9 @@ def render(path: str, tracer: Optional[Tracer] = None,
             body = json.dumps({"enabled": tracer.enabled,
                                "ring_size": tracer.recorder.size,
                                "dropped": tracer.recorder.dropped,
+                               "dropped_by_tenant": dict(
+                                   getattr(tracer.recorder,
+                                           "dropped_by_tenant", {})),
                                "count": len(traces),
                                "traces": [t.to_dict() for t in traces]})
         return 200, "application/json", body.encode()

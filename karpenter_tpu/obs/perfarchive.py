@@ -63,14 +63,24 @@ MIN_BASELINE = 2      # metrics with fewer comparable samples inform only
 
 # metric-name direction classification; keys matching neither are
 # informational (counts, booleans, ids) and never gate
-_LOWER_BETTER = re.compile(r"(_ms|_ms_p\d+|headline_ms)$")
+_LOWER_BETTER = re.compile(
+    r"(_ms|_ms_p\d+|headline_ms|_bytes|_watermark\w*)$")
 _HIGHER_BETTER = re.compile(
     r"(_per_sec|_speedup|_vs_serial(_persistent)?|hit_rate|vs_baseline|"
     r"_cover(age)?|kernel_vs_native_cpp|pods_per_sec)$")
+# informational regardless of suffix: the upload-redundancy fraction is
+# a MEASUREMENT of delta-upload headroom, not a performance quantity —
+# a workload-mix change moving it must never fail the gate in either
+# direction (checked BEFORE the suffix rules: `_frac` isn't a latency)
+_NEVER_GATES = re.compile(r"_redundant_frac$")
 
 
 def metric_direction(key: str) -> Optional[str]:
-    """'lower' / 'higher' / None (ungated)."""
+    """'lower' / 'higher' / None (ungated). `*_bytes`/`*_watermark*`
+    keys (device-memory footprint, transfer volume) are lower-better;
+    `*_redundant_frac` is informational and never gates."""
+    if _NEVER_GATES.search(key):
+        return None
     if _LOWER_BETTER.search(key):
         return "lower"
     if _HIGHER_BETTER.search(key):

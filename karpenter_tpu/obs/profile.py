@@ -321,7 +321,7 @@ class PhaseLedger:
                    "coverage": round(coverage, 4),
                    "root": trace.root.name})
         TRACER.recorder.offer(Trace(trace_id=marker.trace_id,
-                                    spans=[marker]))
+                                    spans=[marker]), meter=False)
 
     # --- read side --------------------------------------------------------
     def coverage(self, tenant: Optional[str] = None,
@@ -342,6 +342,16 @@ class PhaseLedger:
     def unattributed_ms(self) -> float:
         with self._lock:
             return sum(u for (_w, u, _n) in self._walls.values())
+
+    def unattributed_by_tenant(self) -> Dict[str, float]:
+        """tenant -> total unattributed ms — the per-tenant split the
+        watchdog's profile_unattributed monitor baselines, so a fleet
+        finding names WHOSE hot path grew an un-spanned seam."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for (t, _k), (_w, u, _n) in self._walls.items():
+                out[t] = out.get(t, 0.0) + u
+            return out
 
     def snapshot(self) -> dict:
         """JSON-ready aggregate view — /debug/profile and the

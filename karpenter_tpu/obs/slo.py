@@ -275,7 +275,7 @@ class SloEngine:
                       t1=alert["burn_fast"] / 1e3, ts=alert["at"],
                       attrs=dict(alert))
         TRACER.recorder.offer(Trace(trace_id=marker.trace_id,
-                                    spans=[marker]))
+                                    spans=[marker]), meter=False)
 
     # --- exposition -------------------------------------------------------
     def payload(self, query: str = "") -> dict:

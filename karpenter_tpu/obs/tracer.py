@@ -111,10 +111,19 @@ class FlightRecorder:
         self._lock = threading.Lock()
         # lifetime count of rejected offers — the ring-overflow meter the
         # invariant watchdog reads (a hot ring too small to retain
-        # evidence is an observability failure worth a finding)
+        # evidence is an observability failure worth a finding); the
+        # per-tenant split lets a fleet watchdog attribute WHOSE hot
+        # loop is overflowing the ring
         self.dropped = 0
+        self.dropped_by_tenant: Dict[str, int] = {}
 
-    def offer(self, trace: Trace) -> bool:
+    def offer(self, trace: Trace, meter: bool = True) -> bool:
+        """`meter=False` is for the observability plane's OWN marker
+        traces (watchdog findings, coverage-gap markers): the slowest-N
+        ring legitimately rejects a near-zero-duration marker when full
+        of real traces, and that self-inflicted rejection must not
+        count toward the overflow meters the watchdog reads or export
+        as a tenant's drop — findings would manufacture findings."""
         with self._lock:
             if len(self._traces) < self.size:
                 self._traces.append(trace)
@@ -124,8 +133,22 @@ class FlightRecorder:
             if trace.duration > self._traces[fastest].duration:
                 self._traces[fastest] = trace
                 return True
+            if not meter:
+                return False
             self.dropped += 1
-            return False
+            try:
+                from ..metrics.tenant import current_tenant
+                tenant = current_tenant()
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                tenant = "default"
+            self.dropped_by_tenant[tenant] = \
+                self.dropped_by_tenant.get(tenant, 0) + 1
+        try:
+            from ..metrics import TRACE_RING_DROPPED
+            TRACE_RING_DROPPED.inc(tenant=tenant)
+        except Exception:  # noqa: BLE001 — the ring must never raise
+            pass
+        return False
 
     def slowest(self, n: Optional[int] = None) -> List[Trace]:
         with self._lock:

@@ -33,10 +33,17 @@ INCREMENTAL monitors evaluated on a sim-clock cadence:
   tickets per pump without EVER co-batching them (the bucketing that
   justifies the batching's existence is silently not happening).
 - **profile_unattributed** — the phase ledger's unattributed gap grew:
-  an un-spanned seam appeared on a traced hot path.
+  an un-spanned seam appeared on a traced hot path. Baselined and
+  evaluated PER TENANT, so a fleet finding names whose path grew it.
 - **trace_ring_overflow** — the flight recorder rejected traces since
   arming faster than the overflow threshold: the ring is too small to
-  retain the evidence the other monitors point at.
+  retain the evidence the other monitors point at. Per tenant, like
+  the profile meter.
+- **devicemem_leak** — a residency-ledger group's OWNER (DeviceCatalog,
+  InFlightBatch) died while its device buffers stay live past the
+  devicemem grace: something else is pinning an evicted owner's upload
+  — exactly the leak shape device-resident state (ROADMAP item 3) can
+  introduce, watched before that work lands.
 
 Cost discipline: the claim watchlist is maintained from the store's
 watch feed (O(delta) per event, settled claims leave the list), the
@@ -79,6 +86,7 @@ INVARIANTS: Tuple[str, ...] = (
     "pipeline_stall",
     "profile_unattributed",
     "trace_ring_overflow",
+    "devicemem_leak",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -137,6 +145,7 @@ class Watchdog:
     #                           shape class counts as a stall
     UNATTRIBUTED_MS = 5.0     # ledger gap growth per excursion
     RING_DROPS = 64           # recorder rejections since arm
+    DEVICEMEM_GRACE = 120.0   # orphaned device buffers' age before a leak
     JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
     MAX_FINDINGS = 256        # bounded finding log
 
@@ -187,10 +196,17 @@ class Watchdog:
         self.armed = False
         self.stats = {"ticks": 0, "evals": 0, "findings": 0,
                       "jump_absorbed": 0}
-        # meter baselines (set at arm): deltas, never process totals
-        self._base_dropped = 0
-        self._base_unattr = 0.0
+        # meter baselines (set at arm): deltas, never process totals.
+        # PER TENANT for the process-global ring/ledger meters, so a
+        # fleet finding attributes to the tenant whose path regressed
+        self._base_dropped: Dict[str, int] = {}
+        self._base_unattr: Dict[str, float] = {}
         self._base_div = 0.0
+        # devicemem orphans: group-id -> first-seen (watchdog clock);
+        # groups already orphaned at arm are another run's residue and
+        # never fire here (zero-false-positive contract)
+        self._devmem: Dict[int, float] = {}
+        self._devmem_base: frozenset = frozenset()
 
     # --- arming -----------------------------------------------------------
     def arm(self, now: Optional[float] = None) -> "Watchdog":
@@ -208,11 +224,15 @@ class Watchdog:
             for nc in self.store.nodeclaims.values():
                 if not self._settled(nc):
                     self._claims[nc.name] = now
+        from .devicemem import DEVICEMEM
         from .profile import LEDGER
-        self._base_dropped = getattr(TRACER.recorder, "dropped", 0)
-        self._base_unattr = LEDGER.unattributed_ms()
+        self._base_dropped = dict(getattr(TRACER.recorder,
+                                          "dropped_by_tenant", {}))
+        self._base_unattr = dict(LEDGER.unattributed_by_tenant())
         self._base_div = (float(self.warmpath.stats.get("divergences", 0))
                           if self.warmpath is not None else 0.0)
+        self._devmem_base = frozenset(o["group"]
+                                      for o in DEVICEMEM.orphans())
         register_debug_route("/debug/watchdog",
                              lambda wd, query: wd.payload(query),
                              owner=self)
@@ -261,6 +281,7 @@ class Watchdog:
         self._check_warmpath(now, fired)
         self._check_fleet(now, fired)
         self._check_meters(now, fired)
+        self._check_devicemem(now, fired)
         if self._last_sweep is None or force \
                 or now - self._last_sweep >= self.CLOUD_SWEEP:
             self._last_sweep = now
@@ -276,6 +297,7 @@ class Watchdog:
         self.stats["jump_absorbed"] += 1
         self._claims = {k: v + shift for k, v in self._claims.items()}
         self._drift = {k: v + shift for k, v in self._drift.items()}
+        self._devmem = {k: v + shift for k, v in self._devmem.items()}
         if self._audit_pending is not None:
             ps, seen = self._audit_pending
             self._audit_pending = (ps, seen + shift)
@@ -502,22 +524,83 @@ class Watchdog:
 
     def _check_meters(self, now: float, fired: List[Finding]) -> None:
         from .profile import LEDGER
-        unattr = LEDGER.unattributed_ms()
-        if unattr - self._base_unattr >= self.UNATTRIBUTED_MS:
+        cur_unattr = LEDGER.unattributed_by_tenant()
+        tenant_fired = False
+        for tenant, unattr in cur_unattr.items():
+            gap = unattr - self._base_unattr.get(tenant, 0.0)
+            if gap >= self.UNATTRIBUTED_MS:
+                tenant_fired = True
+                self._fire(fired, "profile_unattributed", "info",
+                           f"ledger/{tenant}",
+                           f"phase ledger unattributed gap for tenant "
+                           f"{tenant} grew {gap:.1f}ms since last "
+                           f"excursion", now, tenant=tenant,
+                           gap_ms=round(gap, 3))
+                self._base_unattr[tenant] = unattr
+        # DIFFUSE growth: many tenants each under the per-tenant
+        # threshold must still trip the process-aggregate one — the
+        # per-tenant split must never RAISE the effective threshold by
+        # the tenant count. Firing advances every baseline, so the same
+        # diffuse excursion is counted once.
+        agg_gap = sum(cur_unattr.values()) \
+            - sum(self._base_unattr.get(t, 0.0) for t in cur_unattr)
+        if not tenant_fired and agg_gap >= self.UNATTRIBUTED_MS:
             self._fire(fired, "profile_unattributed", "info", "ledger",
                        f"phase ledger unattributed gap grew "
-                       f"{unattr - self._base_unattr:.1f}ms since last "
-                       f"excursion", now,
-                       gap_ms=round(unattr - self._base_unattr, 3))
-            self._base_unattr = unattr
-        dropped = getattr(TRACER.recorder, "dropped", 0)
-        if dropped - self._base_dropped >= self.RING_DROPS:
+                       f"{agg_gap:.1f}ms across tenants since last "
+                       f"excursion", now, gap_ms=round(agg_gap, 3))
+            self._base_unattr.update(cur_unattr)
+        drops = dict(getattr(TRACER.recorder, "dropped_by_tenant", {}))
+        tenant_fired = False
+        for tenant, dropped in drops.items():
+            delta = dropped - self._base_dropped.get(tenant, 0)
+            if delta >= self.RING_DROPS:
+                tenant_fired = True
+                self._fire(fired, "trace_ring_overflow", "info",
+                           f"ring/{tenant}",
+                           f"flight recorder rejected {delta} of tenant "
+                           f"{tenant}'s traces since last excursion "
+                           f"(ring size {TRACER.recorder.size})",
+                           now, tenant=tenant, dropped=delta)
+                self._base_dropped[tenant] = dropped
+        agg_drop = sum(drops.values()) \
+            - sum(self._base_dropped.get(t, 0) for t in drops)
+        if not tenant_fired and agg_drop >= self.RING_DROPS:
             self._fire(fired, "trace_ring_overflow", "info", "ring",
-                       f"flight recorder rejected "
-                       f"{dropped - self._base_dropped} traces since last "
-                       f"excursion (ring size {TRACER.recorder.size})",
-                       now, dropped=dropped - self._base_dropped)
-            self._base_dropped = dropped
+                       f"flight recorder rejected {agg_drop} traces "
+                       f"across tenants since last excursion (ring "
+                       f"size {TRACER.recorder.size})", now,
+                       dropped=agg_drop)
+            self._base_dropped.update(drops)
+
+    def _check_devicemem(self, now: float, fired: List[Finding]) -> None:
+        """Device buffers outliving their owner (residency-ledger
+        orphans) past the devicemem grace — aged on the watchdog's
+        observation clock like every other window, pre-arm residue
+        excluded."""
+        from .devicemem import DEVICEMEM
+        seen: set = set()
+        for o in DEVICEMEM.orphans():
+            gid = o["group"]
+            if gid in self._devmem_base:
+                continue
+            seen.add(gid)
+            first = self._devmem.setdefault(gid, now)
+            age = now - first
+            if age < self.DEVICEMEM_GRACE:
+                continue
+            self._fire(fired, "devicemem_leak", "warning",
+                       f"group/{gid}",
+                       f"{o['bytes']} device bytes ({o['kind']}"
+                       f"{', token ' + o['token'] if o['token'] else ''}) "
+                       f"outlive their dead owner for {age:.0f}s "
+                       f"(grace {self.DEVICEMEM_GRACE:g}s)", now,
+                       tenant=o.get("tenant"), kind=o["kind"],
+                       leaked_bytes=o["bytes"], age_s=round(age, 1))
+        for gid in list(self._devmem):
+            if gid not in seen:   # buffers finally freed: re-arm edge
+                self._devmem.pop(gid, None)
+                self._clear("devicemem_leak", f"group/{gid}")
 
     # --- firing / clearing ------------------------------------------------
     def _fire(self, fired: List[Finding], invariant: str, severity: str,
@@ -536,7 +619,15 @@ class Watchdog:
             self.stats["findings"] += 1
         fired.append(f)
         from ..metrics import WATCHDOG_FINDINGS
-        WATCHDOG_FINDINGS.inc(invariant=invariant, severity=severity)
+        tenant = attrs.get("tenant")
+        if tenant:
+            # a finding about a SPECIFIC tenant's meter attributes to
+            # that tenant even when the ticking thread is unscoped (a
+            # service-level watchdog watching process-global meters)
+            WATCHDOG_FINDINGS.inc(invariant=invariant, severity=severity,
+                                  tenant=str(tenant))
+        else:
+            WATCHDOG_FINDINGS.inc(invariant=invariant, severity=severity)
         self._flight_record(f)
 
     def _clear(self, invariant: str, key: str) -> None:
@@ -549,14 +640,11 @@ class Watchdog:
                                f"{int(f.at)}",
                       span_id=0, parent_id=None, t0=0.0, t1=1e-6,
                       ts=f.at, attrs=f.to_dict())
-        accepted = TRACER.recorder.offer(Trace(trace_id=marker.trace_id,
-                                               spans=[marker]))
-        if not accepted:
-            # the slowest-N ring legitimately rejects a near-zero-
-            # duration marker when full of real traces; that rejection
-            # must not count toward the overflow meter the watchdog
-            # itself reads, or findings would manufacture findings
-            self._base_dropped += 1
+        # meter=False: a rejected self-marker must not count toward the
+        # overflow meter the watchdog itself reads (findings would
+        # manufacture findings) nor export as a tenant's drop
+        TRACER.recorder.offer(Trace(trace_id=marker.trace_id,
+                                    spans=[marker]), meter=False)
 
     # --- read side --------------------------------------------------------
     def fired(self, invariant: str) -> int:
@@ -623,7 +711,8 @@ class Watchdog:
                            "audit_lag_s": self.audit_lag_grace,
                            "starvation_s": self.starvation_s,
                            "backlog_max": self.backlog_max,
-                           "pipeline_s": self.pipeline_grace},
+                           "pipeline_s": self.pipeline_grace,
+                           "devicemem_s": self.DEVICEMEM_GRACE},
                 "stats": dict(self.stats),
                 "fired": dict(self._fired),
                 "watchlist": {"claims": len(self._claims),

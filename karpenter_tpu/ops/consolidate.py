@@ -267,16 +267,19 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
                                  node_cmask, active, counts, list(cols))
     gbuf_np = _pack_screen_groups(req, compat, allow_zone, allow_cap,
                                   list(cols))
+    from ..obs import devicemem as _dm
     if mesh is not None:
         # same 2-upload budget as single-device: the node matrix shards
         # over the mesh, the group matrix + catalog replicate (catalog
         # from the mesh-keyed epoch cache)
         from jax.sharding import NamedSharding, PartitionSpec as P
         dcat = _auto_dcat(cat, R, mesh=mesh)
-        nbuf = _put_sharded(nbuf_np, NamedSharding(mesh, P("nodes", None)))
-        gbuf = _put_sharded(gbuf_np, NamedSharding(mesh, P()))
-        buf = _read(_mesh_screen_fn(mesh, cols)(dcat.alloc, dcat.avail,
-                                                nbuf, gbuf))
+        with _dm.attributed(reason="screen_upload"):
+            nbuf = _put_sharded(nbuf_np,
+                                NamedSharding(mesh, P("nodes", None)))
+            gbuf = _put_sharded(gbuf_np, NamedSharding(mesh, P()))
+            buf = _read(_mesh_screen_fn(mesh, cols)(dcat.alloc, dcat.avail,
+                                                    nbuf, gbuf))
     else:
         # single-device path: TWO packed uploads (node-side + group-side;
         # catalog tensors ride the solver's per-epoch device cache) and
@@ -287,8 +290,9 @@ def consolidation_screen(cat: CatalogTensors, enc: EncodedPods,
         # back to the XLA path, as the pallas_screen contract promises.
         from . import pallas_screen
         dcat = _auto_dcat(cat, R)
-        nbuf = _put(nbuf_np)
-        gbuf = _put(gbuf_np)
+        with _dm.attributed(reason="screen_upload"):
+            nbuf = _put(nbuf_np)
+            gbuf = _put(gbuf_np)
         if pallas_screen.available():
             try:
                 packed = _screen_onebuf(dcat.alloc, dcat.avail, nbuf, gbuf,

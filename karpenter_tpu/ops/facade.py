@@ -114,7 +114,13 @@ class SharedCatalogCache:
         self.stats["misses"] += 1
         FLEET_CATALOG_SHARED.inc(event="miss")
         while len(self._entries) > self.MAX_ENTRIES:
-            self._entries.popitem(last=False)
+            old_key, _old = self._entries.popitem(last=False)
+            # a dead shared view must not pin device buffers until the
+            # token FIFO happens to trim them: release every device-
+            # resident variant of this view (base + noblocks/daemonset-
+            # derived tokens) the moment the view itself is evicted
+            from .solver import release_shared_views
+            release_shared_views(("shared",) + old_key)
         return cat
 
 
@@ -491,9 +497,11 @@ class Solver:
             # evicted view's device-resident variants go with it
             while len(self._cat_cache) > self.CAT_CACHE_SIZE:
                 old_key, _ = self._cat_cache.popitem(last=False)
+                from ..metrics import DCAT_EVICTIONS
                 for k in [k for k in self._dcat_cache
                           if k[: len(old_key)] == old_key]:
                     del self._dcat_cache[k]
+                    DCAT_EVICTIONS.inc(reason="facade_lru")
             # availability-tensor rebuild counter: chaos tests assert an
             # ICE mark re-keys this (and the device upload cache) exactly
             # once per epoch change, not once per solve
@@ -756,9 +764,11 @@ class Solver:
             # NodeClasses must not thrash a full host→device transfer
             # per solve
             n = len(prep.cat_key)
+            from ..metrics import DCAT_EVICTIONS
             for k in [k for k in self._dcat_cache
                       if k[:n] not in self._cat_cache]:
                 del self._dcat_cache[k]
+                DCAT_EVICTIONS.inc(reason="facade_lru")
             dcat = device_catalog(cat, R, mesh=mesh)
             self._dcat_cache[dkey] = dcat
         return dcat
@@ -774,8 +784,13 @@ class Solver:
             return None
         from .solver import prepare_batchable
         try:
+            # meter key: "the previous upload for this catalog view,
+            # from THIS facade" — co-batched tenants sharing a device
+            # catalog still hash against their own upload history
             return prepare_batchable(prep.cat, prep.enc,
-                                     dcat=self._device_dcat(prep, None))
+                                     dcat=self._device_dcat(prep, None),
+                                     meter_key=(("facade", id(self))
+                                                + tuple(prep.cat_key)))
         except Exception:  # noqa: BLE001 — staging is an optimization;
             # any surprise falls back to the serial path, never crashes
             return None

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs import devicemem as dm
 from ..obs.tracer import NOOP_SPAN, TRACER
 from .binpack import BIG, EPS, SolveResult, VirtualNode
 from .encode import CatalogTensors, EncodedPods, align_resources
@@ -40,11 +41,11 @@ _F32_MAX = jnp.finfo(jnp.float32).max
 # host↔device traffic counters — the hot-boundary discipline
 # (cloud/metering.py meters wire calls; this meters the device tunnel the
 # same way so a transfer regression is a red test, not a judge finding).
-# Incremented by _put/_read; read via transfer_stats()/transfer_bytes().
+# Call COUNTS live here; byte volume is attributed per (reason, tenant,
+# shape-class) by the device telemetry plane (obs/devicemem.TRANSFERS),
+# whose totals transfer_bytes() serves.
 _TRANSFERS = 0   # host→device array uploads issued by this module
 _READS = 0       # device→host blocking reads issued by this module
-_TRANSFER_BYTES = 0   # host→device bytes
-_READ_BYTES = 0       # device→host bytes
 
 
 def transfer_stats() -> Tuple[int, int]:
@@ -79,36 +80,39 @@ def transfer_bytes() -> Tuple[int, int]:
     """(host→device, device→host) bytes since import — the companion to
     transfer_stats(): call COUNT is the RTT budget, byte volume is the
     bandwidth budget. Diff around a solve; solve_device publishes the
-    per-solve deltas on the transfer-bytes gauges."""
-    return _TRANSFER_BYTES, _READ_BYTES
+    per-solve deltas on the transfer-bytes gauges. Served from the
+    transfer-attribution ledger (obs/devicemem.TRANSFERS), so the same
+    bytes are also decomposable per (reason, tenant, shape-class)."""
+    return dm.TRANSFERS.totals()
 
 
 def _put(x) -> jax.Array:
-    """Host→device upload, counted. On the deployment rig the TPU sits
-    behind a network tunnel where every independent upload can cost a full
-    RTT (~70-100 ms measured) — per-solve upload COUNT, not bytes, is the
-    latency budget."""
-    global _TRANSFERS, _TRANSFER_BYTES
+    """Host→device upload, counted + attributed. On the deployment rig
+    the TPU sits behind a network tunnel where every independent upload
+    can cost a full RTT (~70-100 ms measured) — per-solve upload COUNT,
+    not bytes, is the latency budget; the byte volume lands on the
+    device telemetry plane's transfer/residency ledgers."""
+    global _TRANSFERS
     _TRANSFERS += 1
     out = jnp.asarray(x)
-    _TRANSFER_BYTES += out.nbytes
+    dm.on_upload(out)
     return out
 
 
 def _put_sharded(x, sharding) -> jax.Array:
     """Counted jax.device_put with an explicit sharding (mesh path)."""
-    global _TRANSFERS, _TRANSFER_BYTES
+    global _TRANSFERS
     _TRANSFERS += 1
     out = jax.device_put(x, sharding)
-    _TRANSFER_BYTES += out.nbytes
+    dm.on_upload(out, sharded=True)
     return out
 
 
 def _read(arr) -> np.ndarray:
-    global _READS, _READ_BYTES
+    global _READS
     _READS += 1
     out = np.asarray(arr)
-    _READ_BYTES += out.nbytes
+    dm.on_readback(out.nbytes)
     return out
 
 
@@ -172,12 +176,18 @@ def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
           if TRACER.enabled else NOOP_SPAN)
     with sp:
         b0 = transfer_bytes()[0]
-        dcat = DeviceCatalog(
-            alloc=put(align_resources(cat.allocatable, R)),
-            price=put(cat.price),
-            avail=put(cat.available),
-            ovh_z=put(zovh) if zovh is not None else None,
-        )
+        with dm.attributed(reason="catalog_put", kind="catalog",
+                           token=cat.cache_token) as grp:
+            dcat = DeviceCatalog(
+                alloc=put(align_resources(cat.allocatable, R)),
+                price=put(cat.price),
+                avail=put(cat.available),
+                ovh_z=put(zovh) if zovh is not None else None,
+            )
+        # the DeviceCatalog OWNS these tensors: the residency ledger's
+        # leak invariant watches for the owner dying while the buffers
+        # stay live (something else pinning an evicted view's upload)
+        dm.DEVICEMEM.adopt(grp, dcat)
         sp.set(h2d_bytes=transfer_bytes()[0] - b0)
     return dcat
 
@@ -200,14 +210,49 @@ def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
 # availability differs.
 _dcat_auto: dict = {}
 _DCAT_TOKEN_MAX = 32  # bound for token-keyed entries (no weakref owner)
+# evictions observed inside weakref finalizers queue here and flush to
+# the metric from caller context: a finalizer runs inside GC, which can
+# fire on a thread already holding the metric's (non-reentrant) lock
+_dcat_evict_pending: list = []
+
+
+def _count_dcat_eviction(reason: str) -> None:
+    from ..metrics import DCAT_EVICTIONS
+    DCAT_EVICTIONS.inc(reason=reason)
+
+
+def _finalize_dcat(key) -> None:
+    """weakref-finalizer eviction of an id-keyed entry (GC context:
+    dict ops only, metric deferred)."""
+    if _dcat_auto.pop(key, None) is not None:
+        _dcat_evict_pending.append("weakref")
+
+
+def release_shared_views(prefix: tuple) -> int:
+    """Drop every token-keyed device-catalog entry whose content token
+    starts with `prefix` — the SharedCatalogCache calls this when it
+    evicts a view, so a dead shared view can never pin device buffers
+    past its own eviction (they would otherwise linger until the FIFO
+    bound trimmed them). Returns the number of entries released."""
+    victims = [k for k in _dcat_auto
+               if isinstance(k[0], tuple) and k[0][:len(prefix)] == prefix]
+    for k in victims:
+        _dcat_auto.pop(k, None)
+        _count_dcat_eviction("view_evicted")
+    return len(victims)
 
 
 def _auto_dcat(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
     """Epoch-cached device catalog for callers without their own cache;
     mesh=None caches the single-device replica, a Mesh caches the
     mesh-replicated one (same staleness predicate and weakref lifecycle
-    — ONE implementation so the two can't diverge)."""
+    — ONE implementation so the two can't diverge). Every eviction path
+    meters dcat_evictions_total{reason} — churn here is re-upload cost,
+    and residency WITHOUT evictions is how a pinned dead view would
+    present."""
     import weakref
+    while _dcat_evict_pending:  # flush GC-deferred weakref evictions
+        _count_dcat_eviction(_dcat_evict_pending.pop())
     tok = cat.cache_token
     by_token = tok is not None and len(tok) > 0 and tok[0] == "shared"
     key = (tuple(tok), mesh) if by_token else (id(cat), mesh)
@@ -215,8 +260,12 @@ def _auto_dcat(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
     if (ent is not None and ent.alloc.shape[1] >= R
             and (ent.ovh_z is not None) == (cat.zone_overhead is not None)):
         return ent
+    if ent is not None:
+        # present but unusable (resource axis grew / overhead flipped):
+        # the rebuild below replaces it
+        _count_dcat_eviction("stale")
     if ent is None and not by_token:
-        weakref.finalize(cat, _dcat_auto.pop, key, None)
+        weakref.finalize(cat, _finalize_dcat, key)
     dcat = device_catalog(cat, R, mesh=mesh)
     _dcat_auto[key] = dcat
     if by_token:
@@ -226,6 +275,7 @@ def _auto_dcat(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
         tkeys = [k for k in _dcat_auto if isinstance(k[0], tuple)]
         for k in tkeys[:max(0, len(tkeys) - _DCAT_TOKEN_MAX)]:
             _dcat_auto.pop(k, None)
+            _count_dcat_eviction("fifo")
     return dcat
 
 
@@ -599,10 +649,16 @@ class BatchableSolve:
     statics: dict          # n_max / k_max / cols / track_conflicts / zone_ovh
     signature: tuple       # full co-batch key (shape class + device catalog)
     shape_class: str       # "g<Gp>/n<n_max>" — the ledger's signature class
+    # upload-redundancy meter key: identifies "the previous upload for
+    # this catalog view" — per (facade, view) when staged through a
+    # facade, per device catalog otherwise, so co-batched tenants'
+    # matrices never hash against each other's history
+    meter_key: tuple = ()
 
 
 def prepare_batchable(cat: CatalogTensors, enc: EncodedPods,
                       dcat: Optional["DeviceCatalog"] = None,
+                      meter_key: Optional[tuple] = None,
                       ) -> Optional[BatchableSolve]:
     """Stage a FRESH solve (no existing nodes, no priors/bans — the
     dominant fleet case) for batched dispatch. Returns None when the
@@ -636,7 +692,9 @@ def prepare_batchable(cat: CatalogTensors, enc: EncodedPods,
                  id(dcat))
     return BatchableSolve(cat=cat, enc=enc, dcat=dcat, Gp=Gp,
                           statics=statics, signature=signature,
-                          shape_class=f"g{Gp}/n{n_max}")
+                          shape_class=f"g{Gp}/n{n_max}",
+                          meter_key=(meter_key if meter_key is not None
+                                     else ("dcat", id(dcat))))
 
 
 class InFlightBatch:
@@ -677,7 +735,7 @@ class InFlightBatch:
         self.wait_s = _time.perf_counter() - t0
         sp = (TRACER.span("solve.readback", batch=self.size)
               if TRACER.enabled else NOOP_SPAN)
-        with sp:
+        with sp, dm.attributed(shape_class=self.reqs[0].shape_class):
             self._buf = _read(self._packed)
             sp.set(d2h_bytes=int(self._buf.nbytes))
         self._packed = None
@@ -745,18 +803,26 @@ def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
         b0 = transfer_bytes()[0]
         gbufs = [_pack_groups(*_group_inputs(r.enc, Gp), cols)
                  for r in reqs]
+        # redundancy metering BEFORE the stack: each request's matrix
+        # hashes against the previous upload under ITS OWN meter key
+        # (per facade/view), so the identical-byte fraction measures
+        # exactly what a per-view delta upload would save
+        for r, g in zip(reqs, gbufs):
+            dm.UPLOADS.observe(r.meter_key, g)
         if Bp > B:
             pad = gbufs[0].copy()
             pad[:, len(cols)] = 0.0  # zero the counts column: a no-op row
             gbufs.extend([pad] * (Bp - B))
-        gstack = _put(np.stack(gbufs))
-        conf = None
-        if track:
-            confs = [_pad_to(_pad_to(r.enc.conflict, Gp, 0), Gp, 1)
-                     if r.enc.conflict is not None
-                     else np.zeros((Gp, Gp), bool) for r in reqs]
-            confs.extend([np.zeros((Gp, Gp), bool)] * (Bp - B))
-            conf = _put(np.stack(confs))
+        with dm.attributed(reason="batch_upload", kind="batch_gbuf",
+                           shape_class=first.shape_class) as grp:
+            gstack = _put(np.stack(gbufs))
+            conf = None
+            if track:
+                confs = [_pad_to(_pad_to(r.enc.conflict, Gp, 0), Gp, 1)
+                         if r.enc.conflict is not None
+                         else np.zeros((Gp, Gp), bool) for r in reqs]
+                confs.extend([np.zeros((Gp, Gp), bool)] * (Bp - B))
+                conf = _put(np.stack(confs))
         sp.set(h2d_bytes=transfer_bytes()[0] - b0)
     event = _dispatch_cache_event(
         ("batch", Bp, tuple(dcat.alloc.shape), tuple(dcat.price.shape),
@@ -778,7 +844,14 @@ def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
             dcat.ovh_z if zone_ovh else None,
             n_max=st["n_max"], k_max=st["k_max"], cols=st["cols"],
             track_conflicts=track, zone_ovh=zone_ovh)
-    return InFlightBatch(reqs, packed, _time.perf_counter())
+    ifb = InFlightBatch(reqs, packed, _time.perf_counter())
+    # the in-flight batch OWNS the staged uploads and the pending packed
+    # output: residency drops when it drains (block() frees _packed) or
+    # when the batch object itself dies
+    dm.DEVICEMEM.adopt(grp, ifb)
+    dm.DEVICEMEM.track("packed_result", [packed], owner=ifb,
+                       shape_class=first.shape_class)
+    return ifb
 
 
 def probe_dispatch_fault(backend: str) -> None:
@@ -1127,15 +1200,21 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
         # the jit
         cols = _request_cols(enc, cat)
         prep_sp.set(n_max=int(n_max), groups_padded=int(Gp))
+    shape_class = f"g{Gp}/n{n_max}"
     if mesh is None:
         sp = (TRACER.span("solve.device_put") if TRACER.enabled
               else NOOP_SPAN)
         with sp:
             b0 = transfer_bytes()[0]
-            gbuf_dev = _put(_pack_groups(requests, counts, compat,
-                                         allow_zone, allow_cap,
-                                         max_per_node, list(cols)))
-            conflict_dev = _put(conflict_np) if track else None
+            gbuf_np = _pack_groups(requests, counts, compat, allow_zone,
+                                   allow_cap, max_per_node, list(cols))
+            # redundancy meter: how much of THIS view's request matrix
+            # is byte-identical to the previous solve's upload — the
+            # measured delta-upload headroom (ROADMAP item 3)
+            dm.UPLOADS.observe(("serial", id(dcat), Gp), gbuf_np)
+            with dm.attributed(shape_class=shape_class):
+                gbuf_dev = _put(gbuf_np)
+                conflict_dev = _put(conflict_np) if track else None
             sp.set(gbuf_shape=str(tuple(gbuf_dev.shape)),
                    h2d_bytes=transfer_bytes()[0] - b0)
     # sparse-take budget: nnz ≈ n_used + cross-node sharing, far below the
@@ -1171,7 +1250,7 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
                   if TRACER.enabled else NOOP_SPAN)
             if _dispatch_fault_hook is not None:
                 _dispatch_fault_hook("mesh")
-            with sp:
+            with sp, dm.attributed(shape_class=shape_class):
                 packed = _mesh_packed_fn(mesh, n_max, k_max, track,
                                          zone_ovh)(
                     dcat.alloc, dcat.price, dcat.avail,
@@ -1191,7 +1270,7 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
         else:
             sp = (TRACER.span("solve.device_put") if TRACER.enabled
                   else NOOP_SPAN)
-            with sp:
+            with sp, dm.attributed(shape_class=shape_class):
                 b0 = transfer_bytes()[0]
                 nbuf = (None if n_existing == 0 else
                         _put(_pack_nodes(_pad_to(node_type, n_max),
@@ -1223,9 +1302,11 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
                     conflict_dev, dcat.ovh_z if zone_ovh else None, nbuf,
                     n_max=n_max, k_max=k_max, cols=cols,
                     track_conflicts=track, zone_ovh=zone_ovh)
+        dm.DEVICEMEM.track("packed_result", [packed],
+                           shape_class=shape_class)
         sp = (TRACER.span("solve.readback") if TRACER.enabled
               else NOOP_SPAN)
-        with sp:
+        with sp, dm.attributed(shape_class=shape_class):
             buf = _read(packed)  # ONE host read
             sp.set(d2h_bytes=int(buf.nbytes), shape=str(tuple(buf.shape)))
         (nused, overflowed, nnz, unsched, ntype, idx,

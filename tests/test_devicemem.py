@@ -1,0 +1,446 @@
+"""The device telemetry plane (obs/devicemem.py): residency ledger,
+transfer attribution, and the upload-redundancy meter.
+
+Canonical coverage file for `make obs-audit`'s residency-taxonomy check:
+every owner kind in `OWNER_KINDS` — catalog, solve_upload, batch_gbuf,
+packed_result, mesh_shard — is exercised here, and the batched-pump
+transfer contracts (one upload + one readback per BUCKET, byte-identical
+totals batch on/off, fault-fallback re-runs metered under the degraded
+tenant's scope) live here too.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from karpenter_tpu.catalog import CatalogProvider
+from karpenter_tpu.catalog.generator import small_catalog
+from karpenter_tpu.fleet.service import SolverService
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.obs import devicemem as dm
+from karpenter_tpu.obs.devicemem import (OWNER_KINDS, TRANSFER_REASONS,
+                                         ResidencyLedger, TransferLedger,
+                                         UploadMeter)
+from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+from karpenter_tpu.utils.clock import FakeClock
+
+POOL = NodePool(name="default")
+
+
+def mk_pods(n, prefix="p", cpu="500m", mem="1Gi"):
+    return [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+            for i in range(n)]
+
+
+class _Owner:
+    pass
+
+
+class TestResidencyLedger:
+    def test_track_and_auto_release(self):
+        led = ResidencyLedger()
+        arr = jnp.zeros(1024, jnp.float32)
+        led.track("solve_upload", [arr])
+        assert led.live_bytes == 4096
+        assert led.kind_bytes["solve_upload"] == 4096
+        assert led.watermark_bytes == 4096
+        del arr
+        gc.collect()
+        led._drain()
+        assert led.live_bytes == 0
+        assert led.kind_bytes["solve_upload"] == 0
+        # the watermark remembers the peak after the release
+        assert led.watermark_bytes == 4096
+
+    def test_same_array_never_double_counted(self):
+        led = ResidencyLedger()
+        arr = jnp.zeros(16, jnp.float32)
+        led.track("solve_upload", [arr])
+        led.track("batch_gbuf", [arr])  # jnp.asarray identity reuse
+        assert led.live_bytes == 64
+
+    def test_orphans_require_dead_owner_and_live_bytes(self):
+        led = ResidencyLedger()
+        owner = _Owner()
+        arr = jnp.zeros(32, jnp.float32)
+        gid = led.track("catalog", [arr], owner=owner, token=("t", "x"))
+        assert led.orphans() == []          # owner alive: healthy
+        del owner
+        orphans = led.orphans()
+        assert len(orphans) == 1
+        assert orphans[0]["group"] == gid
+        assert orphans[0]["kind"] == "catalog"
+        assert orphans[0]["bytes"] == 128
+        assert orphans[0]["token"] == "t/x"
+        del arr
+        gc.collect()
+        assert led.orphans() == []          # bytes freed: resolved
+
+    def test_audit_meters_unaccounted_bytes(self):
+        led = ResidencyLedger()
+        tracked = jnp.zeros(64, jnp.float32)
+        foreign = jnp.ones(64, jnp.float32)
+        led.track("packed_result", [tracked])
+        audit = led.audit(live_arrays=[tracked, foreign])
+        assert audit["accounted_bytes"] == 256
+        assert audit["unaccounted_bytes"] == 256
+        assert audit["coverage"] == 0.5
+        audit = led.audit(live_arrays=[tracked])
+        assert audit["coverage"] == 1.0
+
+    def test_audit_gap_flight_records(self):
+        from karpenter_tpu.obs.tracer import TRACER, FlightRecorder
+        saved = TRACER.recorder
+        try:
+            TRACER.recorder = FlightRecorder(8)
+            led = ResidencyLedger()
+            foreign = jnp.zeros(1024, jnp.float32)
+            led.audit(live_arrays=[foreign])
+            names = [t.root.name for t in TRACER.recorder.slowest()]
+            assert "devicemem.unattributed" in names
+        finally:
+            TRACER.recorder = saved
+
+    def test_mesh_shard_kind_tracks(self):
+        # the mesh path's _put_sharded registers under "mesh_shard";
+        # CPU rigs have no mesh, so the kind is exercised directly
+        led = ResidencyLedger()
+        arr = jnp.zeros(8, jnp.float32)
+        led.track("mesh_shard", [arr])
+        assert led.kind_bytes["mesh_shard"] == 32
+
+    def test_owner_kinds_frozen(self):
+        assert OWNER_KINDS == ("catalog", "solve_upload", "batch_gbuf",
+                               "packed_result", "mesh_shard")
+        assert TRANSFER_REASONS == ("catalog_put", "request_upload",
+                                    "batch_upload", "screen_upload",
+                                    "readback")
+
+
+class TestTransferAttribution:
+    def test_rows_key_on_reason_tenant_shape_class(self):
+        from karpenter_tpu.metrics.tenant import tenant_scope
+        led = TransferLedger()
+        led.record("request_upload", 100, shape_class="g8/n64")
+        with tenant_scope("t7"):
+            led.record("readback", 40, shape_class="g8/n64")
+        snap = led.snapshot()
+        assert snap["h2d_bytes"] == 100 and snap["d2h_bytes"] == 40
+        rows = {(r["reason"], r["tenant"], r["shape_class"]):
+                (r["bytes"], r["calls"]) for r in snap["rows"]}
+        assert rows[("request_upload", "default", "g8/n64")] == (100, 1)
+        assert rows[("readback", "t7", "g8/n64")] == (40, 1)
+
+    def test_solver_wrappers_thread_through_the_ledger(self):
+        """A real solve attributes catalog_put/request_upload/readback
+        rows, and transfer_bytes() equals the ledger totals (the global
+        byte counters are REPLACED by, not parallel to, the plane)."""
+        from karpenter_tpu.ops import solver as S
+        cat = encode_catalog(small_catalog())
+        enc = encode_pods(mk_pods(8), cat)
+        rows0 = {(r["reason"],): r["bytes"]
+                 for r in dm.TRANSFERS.snapshot()["rows"]}
+        h0, d0 = S.transfer_bytes()
+        S.solve_device(cat, enc)
+        h1, d1 = S.transfer_bytes()
+        assert h1 > h0 and d1 > d0
+        assert (h1, d1) == dm.TRANSFERS.totals()
+        snap = dm.TRANSFERS.snapshot()
+        reasons = {r["reason"] for r in snap["rows"]}
+        assert {"catalog_put", "request_upload", "readback"} <= reasons
+        # the readback row carries the padded shape class
+        assert any(r["reason"] == "readback"
+                   and r["shape_class"].startswith("g")
+                   for r in snap["rows"])
+        del rows0
+
+    def test_transfer_metric_family_observes(self):
+        from karpenter_tpu.metrics import DEVICEMEM_TRANSFER
+        before = DEVICEMEM_TRANSFER.value(reason="request_upload")
+        dm.TRANSFERS.record("request_upload", 77)
+        assert DEVICEMEM_TRANSFER.value(
+            reason="request_upload") == before + 77
+
+
+class TestUploadMeter:
+    def test_identical_reupload_reads_fully_redundant(self):
+        m = UploadMeter()
+        mat = np.arange(64, dtype=np.float32).reshape(8, 8)
+        assert m.observe(("k",), mat) == 0.0       # first sight
+        assert m.observe(("k",), mat.copy()) == 1.0
+        ident, total = m.totals()
+        assert ident == mat.nbytes and total == 2 * mat.nbytes
+
+    def test_changed_rows_reduce_the_fraction(self):
+        m = UploadMeter()
+        mat = np.zeros((8, 8), np.float32)
+        m.observe(("k",), mat)
+        mat2 = mat.copy()
+        mat2[3] = 9.0   # one of eight rows changed
+        assert m.observe(("k",), mat2) == pytest.approx(7 / 8)
+
+    def test_keys_isolate_histories(self):
+        m = UploadMeter()
+        a = np.zeros((4, 4), np.float32)
+        b = np.ones((4, 4), np.float32)
+        m.observe(("a",), a)
+        # b's first upload must not hash against a's history
+        assert m.observe(("b",), b) == 0.0
+        assert m.observe(("a",), a) == 1.0
+
+    def test_key_lru_bounded(self):
+        m = UploadMeter()
+        mat = np.zeros((2, 2), np.float32)
+        for i in range(dm._METER_MAX_KEYS + 10):
+            m.observe((i,), mat)
+        assert m.snapshot()["keys"] == dm._METER_MAX_KEYS
+
+    def test_warm_resolve_meters_redundancy(self):
+        """Re-solving the same encoded problem re-uploads a byte-
+        identical request matrix — the measured ROADMAP-item-3 target."""
+        from karpenter_tpu.ops import solver as S
+        cat = encode_catalog(small_catalog())
+        enc = encode_pods(mk_pods(12), cat)
+        S.solve_device(cat, enc)  # seed this view's row hashes
+        i0, t0 = dm.UPLOADS.totals()
+        S.solve_device(cat, enc)
+        i1, t1 = dm.UPLOADS.totals()
+        assert t1 > t0
+        assert (i1 - i0) == (t1 - t0)  # warm re-upload: 100% redundant
+
+
+class TestDcatEvictions:
+    def test_shared_view_eviction_releases_device_residency(self):
+        """A SharedCatalogCache view rolling out of its LRU must drop
+        its token-keyed device-catalog entries immediately — a dead
+        view cannot pin device buffers until the FIFO bound trims it."""
+        from karpenter_tpu.metrics import DCAT_EVICTIONS
+        from karpenter_tpu.ops import solver as S
+        from karpenter_tpu.ops.facade import SharedCatalogCache
+        cache = SharedCatalogCache()
+        types = small_catalog()
+        cat = cache.get_or_encode("nc0", types)
+        tok = tuple(cat.cache_token)
+        S._auto_dcat(cat, cat.allocatable.shape[1])
+        key = (tok, None)
+        assert key in S._dcat_auto
+        before = DCAT_EVICTIONS.value(reason="view_evicted")
+        # push MAX_ENTRIES distinct views through: nc0 evicts
+        for i in range(cache.MAX_ENTRIES):
+            cache.get_or_encode(f"nc{i + 1}", types)
+        assert key not in S._dcat_auto
+        assert DCAT_EVICTIONS.value(reason="view_evicted") > before
+
+    def test_fifo_bound_meters_evictions(self):
+        from karpenter_tpu.metrics import DCAT_EVICTIONS
+        from karpenter_tpu.ops import solver as S
+        types = small_catalog()
+        before = DCAT_EVICTIONS.value(reason="fifo")
+        cats = []
+        for i in range(S._DCAT_TOKEN_MAX + 4):
+            cat = encode_catalog(types)
+            cat.cache_token = ("shared", f"fifo-test-{i}", "fp")
+            cats.append(cat)
+            S._auto_dcat(cat, cat.allocatable.shape[1])
+        assert DCAT_EVICTIONS.value(reason="fifo") >= before + 4
+        tkeys = [k for k in S._dcat_auto if isinstance(k[0], tuple)]
+        assert len(tkeys) <= S._DCAT_TOKEN_MAX
+
+    def test_weakref_eviction_metered_on_next_lookup(self):
+        from karpenter_tpu.metrics import DCAT_EVICTIONS
+        from karpenter_tpu.ops import solver as S
+        types = small_catalog()
+        cat = encode_catalog(types)   # no token -> id-keyed + weakref
+        S._auto_dcat(cat, cat.allocatable.shape[1])
+        del cat
+        gc.collect()
+        assert "weakref" in S._dcat_evict_pending or not \
+            S._dcat_evict_pending  # finalizer may already have flushed
+        before = DCAT_EVICTIONS.value(reason="weakref")
+        cat2 = encode_catalog(types)
+        S._auto_dcat(cat2, cat2.allocatable.shape[1])  # flushes pending
+        assert DCAT_EVICTIONS.value(reason="weakref") >= before
+
+    def test_stale_shape_rebuild_metered(self):
+        from karpenter_tpu.metrics import DCAT_EVICTIONS
+        from karpenter_tpu.ops import solver as S
+        types = small_catalog()
+        cat = encode_catalog(types)
+        cat.cache_token = ("shared", "stale-test", "fp")
+        R = cat.allocatable.shape[1]
+        S._auto_dcat(cat, R)
+        before = DCAT_EVICTIONS.value(reason="stale")
+        S._auto_dcat(cat, R + 3)   # resource axis grew: entry unusable
+        assert DCAT_EVICTIONS.value(reason="stale") == before + 1
+
+
+class TestBatchedPumpTransfers:
+    """ISSUE 10 satellite: transfer accounting under the batched pump."""
+
+    def _catalog_devices(self):
+        from karpenter_tpu.ops import solver as S
+        return S
+
+    def test_one_upload_one_readback_per_bucket(self):
+        """N co-batched tickets cross the boundary ONCE each way — per
+        BUCKET, not per ticket (the whole point of batching the RTT)."""
+        from karpenter_tpu.ops import solver as S
+        svc = SolverService(FakeClock(), backend="device", batch=True)
+        types = small_catalog()
+        clients = [svc.register(f"t{i}",
+                                CatalogProvider(lambda: types))
+                   for i in range(4)]
+        # warm round: catalog upload + executable compile happen here
+        warm = [c.solve_async(mk_pods(6, f"w{i}"), POOL)
+                for i, c in enumerate(clients)]
+        svc.pump()
+        for t in warm:
+            assert t.result().launches
+        u0, r0 = S.transfer_stats()
+        batches0 = svc.stats["batches"]
+        tickets = [c.solve_async(mk_pods(6, f"x{i}"), POOL)
+                   for i, c in enumerate(clients)]
+        svc.pump()
+        for t in tickets:
+            assert t.result().launches
+        u1, r1 = S.transfer_stats()
+        buckets = svc.stats["batches"] - batches0
+        assert buckets == 1
+        # one gstack upload + one packed readback per BUCKET
+        assert u1 - u0 == buckets
+        assert r1 - r0 == buckets
+        assert all(t.batch_size == 4 for t in tickets)
+
+    def test_bytes_identical_batch_on_off(self):
+        """The same solves move the same bytes whether dispatched
+        serially or as one ladder-sized batch — batching amortizes
+        ROUND-TRIPS, it must not inflate volume."""
+        from karpenter_tpu.ops import solver as S
+        types = small_catalog()
+
+        def run(batch):
+            svc = SolverService(FakeClock(), backend="device", batch=batch)
+            clients = [svc.register(f"t{i}",
+                                    CatalogProvider(lambda: types))
+                       for i in range(2)]
+            warm = [c.solve_async(mk_pods(5, f"w{i}"), POOL)
+                    for i, c in enumerate(clients)]
+            svc.pump()
+            [t.result() for t in warm]
+            h0, d0 = S.transfer_bytes()
+            tickets = [c.solve_async(mk_pods(5, f"x{i}"), POOL)
+                       for i, c in enumerate(clients)]
+            svc.pump()
+            for t in tickets:
+                assert t.result().launches
+            h1, d1 = S.transfer_bytes()
+            return h1 - h0, d1 - d0
+
+        batched = run(True)    # B=2: in the padding ladder, no waste
+        serial = run(False)
+        assert batched == serial
+
+    def test_fault_fallback_metered_under_degraded_tenant_scope(self):
+        """A mid-batch device fault degrades exactly the faulted batch;
+        the degraded tenant's re-run transfers (and fallback meters)
+        land under ITS tenant scope, the co-batched neighbor keeps the
+        device path and its own attribution."""
+        from karpenter_tpu.faults.injector import fleet_device_fault_hook
+        from karpenter_tpu.faults.plan import DeviceFault, FaultPlan
+        from karpenter_tpu.metrics import SOLVER_FALLBACKS
+        svc = SolverService(FakeClock(), backend="device", batch=True)
+        types = small_catalog()
+        a = svc.register("a", CatalogProvider(lambda: types))
+        b = svc.register("b", CatalogProvider(lambda: types))
+        warm = [a.solve_async(mk_pods(4, "wa"), POOL),
+                b.solve_async(mk_pods(4, "wb"), POOL)]
+        svc.pump()
+        [t.result() for t in warm]
+        fb0 = SOLVER_FALLBACKS.sum(from_backend="device", tenant="a")
+
+        def rows_for(tenant, reason):
+            return sum(r["bytes"] for r in dm.TRANSFERS.snapshot()["rows"]
+                       if r["tenant"] == tenant and r["reason"] == reason)
+
+        a_up0 = rows_for("a", "request_upload")
+        b_up0 = rows_for("b", "request_upload")
+        b_rd0 = rows_for("b", "readback")
+        # dispatch 1 = the bucket probe under a's scope (aborts the
+        # batch), dispatch 2 = a's serial re-run (degrades a to host)
+        plan = FaultPlan(seed=0, rules=[DeviceFault(dispatch=1, count=2)])
+        plan.clock = svc.clock
+        with fleet_device_fault_hook({"a": plan}):
+            ta = a.solve_async(mk_pods(4, "xa"), POOL)
+            tb = b.solve_async(mk_pods(4, "xb"), POOL)
+            svc.pump()
+            assert ta.result().launches and tb.result().launches
+        # a's degraded re-run metered under a's scope: its upload bytes
+        # (shipped before the dispatch fault) attribute to tenant a,
+        # and ITS facade recorded the fallback
+        assert rows_for("a", "request_upload") > a_up0
+        assert a.facade.stats["device_fallbacks"] == 1
+        assert SOLVER_FALLBACKS.sum(from_backend="device",
+                                    tenant="a") == fb0 + 1
+        # the neighbor re-ran on the DEVICE under its own scope
+        assert b.facade.stats["device_fallbacks"] == 0
+        assert rows_for("b", "request_upload") > b_up0
+        assert rows_for("b", "readback") > b_rd0
+
+    def test_batch_residency_kinds_tracked(self):
+        """A batched dispatch registers its stacked request matrix
+        (batch_gbuf) and pending output (packed_result) in the
+        residency ledger, owned by the in-flight batch."""
+        from karpenter_tpu.ops import solver as S
+        cat = encode_catalog(small_catalog())
+        encs = [encode_pods(mk_pods(4, f"r{i}"), cat) for i in range(2)]
+        reqs = [S.prepare_batchable(cat, e) for e in encs]
+        assert all(r is not None for r in reqs)
+        tracked0 = dm.DEVICEMEM.stats["tracked"]
+        ifb = S.dispatch_batch(reqs)
+        # the packed output is resident while the batch is in flight
+        assert dm.DEVICEMEM.stats["tracked"] > tracked0
+        with dm.DEVICEMEM._lock:
+            kinds = {g["kind"] for g in dm.DEVICEMEM._groups.values()
+                     if g["live"]}
+        assert "packed_result" in kinds
+        results = ifb.results()
+        assert all(r.nodes for r in results)
+
+
+class TestDebugRoute:
+    def test_debug_device_serves_the_plane(self):
+        from karpenter_tpu.obs.exposition import render
+        status, ctype, body = render("/debug/device")
+        assert status == 200 and "json" in ctype
+        payload = json.loads(body)
+        assert payload["owner_kinds"] == list(OWNER_KINDS)
+        assert payload["reasons"] == list(TRANSFER_REASONS)
+        assert "residency" in payload and "transfers" in payload
+        assert "uploads" in payload and "orphans" in payload
+        assert payload["residency"]["watermark_bytes"] >= 0
+
+
+class TestDeviceReport:
+    def test_device_report_runs_and_emits_json(self, capsys):
+        import tools.device_report as dr
+        rc = dr.main(["--pods", "64", "--rounds", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        line = [ln for ln in out.strip().splitlines()
+                if ln.startswith("{")][-1]
+        doc = json.loads(line)
+        assert doc["rounds"] == 2
+        # 64 pods at 1% churn rounds to zero churned pods: the single
+        # warm round re-uploads a byte-identical matrix, and the cold
+        # seeding round must NOT dilute the reported fraction
+        assert doc["upload_redundant_frac"] >= 0.99
+        assert doc["residency"]["watermark_bytes"] > 0
+        assert doc["audit"]["coverage"] >= 0.0

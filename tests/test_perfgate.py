@@ -55,6 +55,38 @@ class TestDirections:
         assert metric_direction("c5_uploads_per_solve") is None
         assert metric_direction("c8_standing_nodes") is None
 
+    def test_device_telemetry_classification(self):
+        """ISSUE 10 satellite: byte/watermark keys are lower-better
+        (footprint and transfer volume regressions gate), while the
+        upload-redundancy fraction is a measurement — informational,
+        never gated in either direction."""
+        assert metric_direction("c12_hbm_watermark_bytes") == "lower"
+        assert metric_direction("devicemem_watermark") == "lower"
+        assert metric_direction("c12_batched_h2d_bytes") == "lower"
+        assert metric_direction("c12_batched_d2h_bytes") == "lower"
+        assert metric_direction("devicemem_unattributed_bytes") == "lower"
+        assert metric_direction("c3_upload_redundant_frac") is None
+        assert metric_direction("c12_upload_redundant_frac") is None
+
+    def test_redundant_frac_never_gates(self, tmp_path):
+        """A wild swing in the redundancy fraction (a workload-mix
+        change) produces NO verdict; a byte-key regression does."""
+        base = {"headline_ms": 100.0, "c12_hbm_watermark_bytes": 1e6,
+                "c3_upload_redundant_frac": 0.9}
+        runs = [_run(f"r{i}", base) for i in range(3)]
+        cand = dict(base)
+        cand["c3_upload_redundant_frac"] = 0.01   # collapsed: ungated
+        cand["c12_hbm_watermark_bytes"] = 5e6     # 5x footprint: gated
+        runs.append(_run("cand", cand))
+        arch = PerfArchive(str(tmp_path / "a.jsonl"),
+                           root=str(tmp_path))
+        for r in runs:
+            arch.append(r)
+        report = arch.gate(arch.load())
+        assert not report.ok
+        flagged = {v.metric for v in report.regressions}
+        assert flagged == {"c12_hbm_watermark_bytes"}
+
 
 class TestGate:
     def _baseline_runs(self):

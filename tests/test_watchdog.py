@@ -61,7 +61,7 @@ class TestArming:
             "claim_leak", "store_cloud_drift", "intent_age",
             "warm_audit_lag", "warm_divergence", "fleet_starvation",
             "pipeline_stall", "profile_unattributed",
-            "trace_ring_overflow")
+            "trace_ring_overflow", "devicemem_leak")
 
 
 class TestTrips:
@@ -272,6 +272,137 @@ class TestTrips:
         found = _findings(wd, "profile_unattributed")
         assert found and found[0].severity == "info"
         assert found[0].attrs["gap_ms"] >= wd.UNATTRIBUTED_MS
+
+    def test_trip_devicemem_leak(self):
+        """A residency-ledger group whose OWNER died while its device
+        buffers stay live (pinned elsewhere) past the devicemem grace
+        is a leak finding; freeing the buffers clears the excursion."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.obs.devicemem import DEVICEMEM
+
+        class Owner:
+            pass
+
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        owner = Owner()
+        arr = jnp.zeros(256)  # the pin: outlives its owner below
+        DEVICEMEM.track("catalog", [arr], owner=owner,
+                        token=("shared", "leaktest"))
+        wd.tick(force=True)
+        assert not _findings(wd, "devicemem_leak")  # owner alive: healthy
+        del owner
+        try:
+            _age(wd, wd.DEVICEMEM_GRACE + wd.interval + 1)
+            found = _findings(wd, "devicemem_leak")
+            assert found and found[0].severity == "warning"
+            assert found[0].attrs["leaked_bytes"] >= 256 * 4
+            assert "leaktest" in found[0].message
+        finally:
+            del arr
+        # buffers freed -> the excursion clears (edge re-arms)
+        wd.tick(force=True)
+        assert not any(inv == "devicemem_leak"
+                       for inv, _k in wd._active)
+
+    def test_devicemem_orphans_predating_arm_never_fire(self):
+        """Another run's residue (a group already orphaned when THIS
+        watchdog armed) is excluded from the leak monitor — the
+        zero-false-positive contract across sequential runs."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.obs.devicemem import DEVICEMEM
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        arr = jnp.zeros(64)
+        DEVICEMEM.track("catalog", [arr], owner=owner)
+        del owner  # orphaned BEFORE arm
+        try:
+            clock = FakeClock()
+            wd = Watchdog(clock).arm()
+            _age(wd, wd.DEVICEMEM_GRACE + wd.interval + 1)
+            assert not _findings(wd, "devicemem_leak")
+        finally:
+            del arr
+
+    def test_meter_monitors_attribute_per_tenant(self):
+        """The ring/ledger meters are process-global but the monitors
+        baseline and fire PER TENANT: tenant b's overflow names b, and
+        tenant a (quiet) never fires."""
+        from karpenter_tpu.metrics.tenant import tenant_scope
+        clock = FakeClock()
+        saved = TRACER.recorder
+        try:
+            TRACER.recorder = FlightRecorder(1)
+            wd = Watchdog(clock).arm()
+            TRACER.recorder.offer(Trace(trace_id="slow", spans=[
+                Span(name="s", trace_id="slow", span_id=1,
+                     parent_id=None, t0=0.0, t1=1.0)]))
+            with tenant_scope("b"):
+                for i in range(wd.RING_DROPS + 5):
+                    TRACER.recorder.offer(Trace(trace_id=f"f{i}", spans=[
+                        Span(name="s", trace_id=f"f{i}", span_id=1,
+                             parent_id=None, t0=0.0, t1=1e-6)]))
+            clock.step(wd.interval + 1)
+            wd.tick(force=True)
+            found = _findings(wd, "trace_ring_overflow")
+            assert found and found[0].key == "ring/b"
+            assert found[0].attrs["tenant"] == "b"
+            assert TRACER.recorder.dropped_by_tenant["b"] >= wd.RING_DROPS
+        finally:
+            TRACER.recorder = saved
+
+    def test_meter_overflow_fires_on_diffuse_cross_tenant_drops(self):
+        """Many tenants each UNDER the per-tenant threshold must still
+        trip the process-aggregate edge — the per-tenant split must not
+        multiply the effective threshold by the tenant count."""
+        from karpenter_tpu.metrics.tenant import tenant_scope
+        clock = FakeClock()
+        saved = TRACER.recorder
+        try:
+            TRACER.recorder = FlightRecorder(1)
+            wd = Watchdog(clock).arm()
+            TRACER.recorder.offer(Trace(trace_id="slow", spans=[
+                Span(name="s", trace_id="slow", span_id=1,
+                     parent_id=None, t0=0.0, t1=1.0)]))
+            per_tenant = wd.RING_DROPS // 4  # well below the threshold
+            for t in range(8):               # 8 * 16 = 128 >= 64 total
+                with tenant_scope(f"t{t}"):
+                    for i in range(per_tenant):
+                        TRACER.recorder.offer(Trace(
+                            trace_id=f"d{t}-{i}", spans=[
+                                Span(name="s", trace_id=f"d{t}-{i}",
+                                     span_id=1, parent_id=None,
+                                     t0=0.0, t1=1e-6)]))
+            clock.step(wd.interval + 1)
+            wd.tick(force=True)
+            found = _findings(wd, "trace_ring_overflow")
+            assert found and found[0].key == "ring"  # the aggregate edge
+            assert found[0].attrs["dropped"] >= wd.RING_DROPS
+        finally:
+            TRACER.recorder = saved
+
+    def test_marker_rejections_never_meter(self):
+        """The observability plane's own rejected markers (watchdog
+        findings, coverage-gap markers) must not count as drops —
+        findings must not manufacture findings, and the exported
+        per-tenant counter must not blame a tenant for plane-internal
+        rejections."""
+        rec = FlightRecorder(1)
+        rec.offer(Trace(trace_id="slow", spans=[
+            Span(name="s", trace_id="slow", span_id=1,
+                 parent_id=None, t0=0.0, t1=1.0)]))
+        marker = Trace(trace_id="m", spans=[
+            Span(name="watchdog.finding", trace_id="m", span_id=0,
+                 parent_id=None, t0=0.0, t1=1e-6)])
+        assert rec.offer(marker, meter=False) is False
+        assert rec.dropped == 0 and rec.dropped_by_tenant == {}
+        assert rec.offer(marker) is False  # a metered reject DOES count
+        assert rec.dropped == 1
 
     def test_trip_trace_ring_overflow(self):
         clock = FakeClock()

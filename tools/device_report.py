@@ -1,0 +1,126 @@
+"""Device telemetry report — `make device-report`.
+
+A CPU-friendly probe of the device telemetry plane (obs/devicemem.py):
+runs a few warm solve rounds against a synthetic cluster and prints
+
+- the residency table (live/watermark bytes per owner kind),
+- the transfer-attribution breakdown (reason x tenant x shape class),
+- the upload-redundancy meter (the measured delta-upload headroom of
+  ROADMAP item 3: how much of each warm upload is byte-identical to
+  the previous one), and
+- the `jax.live_arrays()` cross-check (accounted vs unaccounted bytes).
+
+Prints one human table and one JSON line, so it serves both a terminal
+spot-check and scripted regression tracking.
+
+Usage:
+    python tools/device_report.py [--pods 2000] [--rounds 4]
+                                  [--churn-pct 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", type=int, default=2000)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--churn-pct", type=float, default=1.0,
+                    help="%% of pods whose requests change each round "
+                         "(0 = perfectly warm re-uploads)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from karpenter_tpu.catalog import generate_catalog
+    from karpenter_tpu.models.pod import Pod
+    from karpenter_tpu.models.resources import Resources
+    from karpenter_tpu.obs import devicemem as dm
+    from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+    from karpenter_tpu.ops.solver import solve_device, transfer_stats
+
+    shapes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"),
+              ("2", "4Gi")]
+    manifests = max(8, args.pods // 50)
+
+    def mk(i: int, gen: int = 0) -> Pod:
+        s = (i + gen) % manifests
+        cpu, mem = shapes[s % len(shapes)]
+        return Pod(name=f"d-{i}-g{gen}",
+                   requests=Resources.parse({"cpu": cpu, "memory": mem}),
+                   labels={"app": f"svc-{s}"})
+
+    cat = encode_catalog(generate_catalog())
+    churn = max(0, int(args.pods * args.churn_pct / 100.0))
+    pods = [mk(i) for i in range(args.pods)]
+    u0, r0 = transfer_stats()
+    # round 0 is the COLD upload: it seeds the view's row hashes and
+    # must not dilute the warm-round redundancy fraction (all its
+    # bytes are first-sight "changed" by definition)
+    solve_device(cat, encode_pods(pods, cat))
+    i0, t0 = dm.UPLOADS.totals()
+    for rnd in range(1, args.rounds):
+        if churn:
+            # churn the tail: a few manifests change, the rest of the
+            # request matrix should read as redundant upload bytes
+            for j in range(churn):
+                pods[-(j + 1)] = mk(args.pods + j, gen=rnd)
+        enc = encode_pods(pods, cat)
+        solve_device(cat, enc)
+    uploads, reads = (transfer_stats()[0] - u0,
+                      transfer_stats()[1] - r0)
+    ident, total = dm.UPLOADS.totals()
+    warm_ident, warm_total = ident - i0, total - t0
+    frac = warm_ident / warm_total if warm_total else 0.0
+    audit = dm.DEVICEMEM.audit()
+    res = dm.DEVICEMEM.snapshot()
+    xfer = dm.TRANSFERS.snapshot()
+
+    print(f"device telemetry — {args.pods} pods x {args.rounds} rounds "
+          f"({args.churn_pct:g}% churn), {uploads} uploads / "
+          f"{reads} reads")
+    print(f"\n  residency (live {res['live_bytes']:,} B, watermark "
+          f"{res['watermark_bytes']:,} B)")
+    print(f"  {'kind':<16} {'bytes':>14} {'groups':>7}")
+    for kind, row in res["kinds"].items():
+        print(f"  {kind:<16} {row['bytes']:>14,} {row['groups']:>7}")
+    print(f"\n  transfers (h2d {xfer['h2d_bytes']:,} B, d2h "
+          f"{xfer['d2h_bytes']:,} B)")
+    print(f"  {'reason':<16} {'tenant':<10} {'shape class':<14} "
+          f"{'bytes':>14} {'calls':>6}")
+    for row in xfer["rows"]:
+        print(f"  {row['reason']:<16} {row['tenant']:<10} "
+              f"{row['shape_class']:<14} {row['bytes']:>14,} "
+              f"{row['calls']:>6}")
+    print(f"\n  upload redundancy: {frac:.4f} of warm-round request-"
+          f"matrix bytes identical to the previous upload "
+          f"({warm_ident:,}/{warm_total:,} B) — the delta-upload "
+          f"headroom")
+    if "coverage" in audit:
+        print(f"  live-array audit: coverage {audit['coverage']:.4f} "
+              f"({audit['unaccounted_bytes']:,} B unaccounted of "
+              f"{audit['live_arrays']} live arrays)")
+    print()
+    print(json.dumps({
+        "pods": args.pods, "rounds": args.rounds,
+        "churn_pct": args.churn_pct,
+        "uploads": uploads, "reads": reads,
+        "upload_redundant_frac": round(frac, 4),
+        "residency": {"live_bytes": res["live_bytes"],
+                      "watermark_bytes": res["watermark_bytes"],
+                      "kinds": res["kinds"]},
+        "transfers": {"h2d_bytes": xfer["h2d_bytes"],
+                      "d2h_bytes": xfer["d2h_bytes"]},
+        "audit": audit,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

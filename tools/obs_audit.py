@@ -14,7 +14,13 @@ Three invariants that otherwise rot silently:
 3. every watchdog invariant (obs/watchdog.INVARIANTS) has MUTATION-
    STYLE negative coverage in tests/test_watchdog.py: a seeded fault
    scenario that TRIPS it (`def test_trip_<invariant>`) — a monitor
-   nothing can trip is dead code wearing a green badge.
+   nothing can trip is dead code wearing a green badge;
+4. every residency-ledger owner kind (obs/devicemem.OWNER_KINDS) and
+   transfer reason (TRANSFER_REASONS) is exercised by the canonical
+   device-telemetry tests (tests/test_devicemem.py) — an owner kind
+   nothing registers under means a device allocation path fell out of
+   the accounting, which is exactly the drift the >=99%-coverage audit
+   exists to catch.
 
 Exit 0 = no drift. Wired into the default verify path (`make test`
 depends on this).
@@ -32,6 +38,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def audit() -> int:
     from karpenter_tpu import metrics as M
+    from karpenter_tpu.obs.devicemem import OWNER_KINDS, TRANSFER_REASONS
     from karpenter_tpu.obs.profile import PHASES
     from karpenter_tpu.obs.watchdog import INVARIANTS
 
@@ -68,6 +75,22 @@ def audit() -> int:
                 f"tripping it — tests/test_watchdog.py needs a "
                 f"`def test_trip_{inv}` (mutation-style negative coverage)")
 
+    dm_canon = os.path.join(ROOT, "tests", "test_devicemem.py")
+    dm_tests = open(dm_canon).read() if os.path.exists(dm_canon) else ""
+    if not dm_tests:
+        failures.append("tests/test_devicemem.py (the canonical device-"
+                        "telemetry tests) is missing")
+    for kind in OWNER_KINDS:
+        if f'"{kind}"' not in dm_tests and f"'{kind}'" not in dm_tests:
+            failures.append(
+                f"residency-ledger owner kind '{kind}' is in the taxonomy "
+                f"but tests/test_devicemem.py does not exercise it")
+    for reason in TRANSFER_REASONS:
+        if f'"{reason}"' not in dm_tests and f"'{reason}'" not in dm_tests:
+            failures.append(
+                f"transfer reason '{reason}' is in the taxonomy but "
+                f"tests/test_devicemem.py does not exercise it")
+
     if failures:
         print("obs-audit: DRIFT DETECTED")
         for f in failures:
@@ -75,7 +98,9 @@ def audit() -> int:
         return 1
     print(f"obs-audit: ok ({len(M.REGISTRY._metrics)} metric families "
           f"documented, {len(PHASES)} phase buckets test-covered, "
-          f"{len(INVARIANTS)} watchdog invariants trip-covered)")
+          f"{len(INVARIANTS)} watchdog invariants trip-covered, "
+          f"{len(OWNER_KINDS)} residency owner kinds + "
+          f"{len(TRANSFER_REASONS)} transfer reasons test-covered)")
     return 0
 
 
