@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
         deflake run native trace-report profile-report obs-audit chaos \
         crash-audit warmpath-audit encode-report fleet fleet-audit \
-        perf-gate device-report clean
+        perf-gate device-report resident-report clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -51,6 +51,9 @@ encode-report:  ## columnar encode pipeline: cold vs cached cost + hit rate (POD
 
 device-report:  ## device telemetry plane: HBM residency, transfer attribution, upload redundancy (PODS=n ROUNDS=n)
 	$(PY) tools/device_report.py --pods $(or $(PODS),2000) --rounds $(or $(ROUNDS),4)
+
+resident-report:  ## device-resident state: patched-vs-reuploaded rows/bytes over warm rounds (PODS=n ROUNDS=n CHURN=pct)
+	$(PY) tools/device_report.py --pods $(or $(PODS),4000) --rounds $(or $(ROUNDS),6) --churn-pct $(or $(CHURN),1.0)
 
 fleet:  ## drive TENANTS (default 50) tenant control planes through one process + one SolverService (serial, then batched dispatch)
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --tenants $(or $(TENANTS),50)

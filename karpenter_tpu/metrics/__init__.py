@@ -409,6 +409,26 @@ UPLOAD_REDUNDANT_FRAC = REGISTRY.gauge(
     "uploaded byte is a byte the device already holds — informational "
     "(never perf-gated), it sizes the delta-upload win",
     ("tenant",), label_defaults=_TENANT)
+DEVICEMEM_PATCH = REGISTRY.counter(
+    "karpenter_tpu_devicemem_patch_bytes_total",
+    "Device-resident state traffic by outcome (ops/resident.py): "
+    "'patched' = changed-row bytes shipped as sparse scatter patches "
+    "onto a resident buffer, 'avoided' = bytes content-identical to "
+    "the resident copy and therefore NEVER shipped (the realized "
+    "delta-upload win the upload-redundancy meter only predicted), "
+    "'full' = fallback full re-uploads (epoch bumps, shape-class "
+    "growth, dense patches, invalidations)",
+    ("outcome", "tenant"), label_defaults=_TENANT)
+RESIDENT_FALLBACKS = REGISTRY.counter(
+    "karpenter_tpu_resident_fallback_total",
+    "Resident-state full re-uploads by trigger: 'first_sight' (cold "
+    "seeding), 'token_change' (catalog epoch bump / ICE-price view "
+    "re-fingerprint), 'shape_change' (padded shape-class or resource-"
+    "axis growth), 'dtype_change', 'dense' (patch would ship most of "
+    "the matrix), 'invalidated' (SharedCatalogCache view split/"
+    "eviction or warm-path audit divergence). Steady state is patches, "
+    "not fallbacks — growth here is re-upload cost returning",
+    ("reason", "tenant"), label_defaults=_TENANT)
 DCAT_EVICTIONS = REGISTRY.counter(
     "karpenter_tpu_solver_dcat_evictions_total",
     "Device-resident catalog entries evicted, by reason: 'weakref' = "

@@ -68,6 +68,9 @@ OWNER_KINDS: Tuple[str, ...] = (
     "batch_gbuf",     # batched dispatch: stacked (donated) request matrix
     "packed_result",  # the packed int32 kernel output awaiting readback
     "mesh_shard",     # mesh-sharded uploads (P('nodes') / replicated)
+    "resident_state",  # device-resident cross-reconcile state (the delta-
+    #                    patched gbuf/conflict/catalog buffers ops/resident
+    #                    holds; owner = the ResidentEntry)
 )
 
 # transfer-attribution reasons (the "why bytes move" axis)
@@ -77,6 +80,8 @@ TRANSFER_REASONS: Tuple[str, ...] = (
     "batch_upload",    # batched dispatch's stacked request matrix
     "screen_upload",   # consolidation screen inputs
     "readback",        # device -> host packed-result reads
+    "resident_patch",  # sparse row patches onto resident state (changed
+    #                    rows + index vector only — ops/resident.py)
 )
 
 COVERAGE_TARGET = 0.99

@@ -71,14 +71,16 @@ _HIGHER_BETTER = re.compile(
 # informational regardless of suffix: the upload-redundancy fraction is
 # a MEASUREMENT of delta-upload headroom, not a performance quantity —
 # a workload-mix change moving it must never fail the gate in either
-# direction (checked BEFORE the suffix rules: `_frac` isn't a latency)
-_NEVER_GATES = re.compile(r"_redundant_frac$")
+# direction (checked BEFORE the suffix rules: `_frac` isn't a latency).
+# `*_rows_frac` (the resident patch-density measurement) is the same
+# kind of quantity: churn in the workload moves it, the code does not.
+_NEVER_GATES = re.compile(r"(_redundant_frac|_rows_frac)$")
 
 
 def metric_direction(key: str) -> Optional[str]:
     """'lower' / 'higher' / None (ungated). `*_bytes`/`*_watermark*`
     keys (device-memory footprint, transfer volume) are lower-better;
-    `*_redundant_frac` is informational and never gates."""
+    `*_redundant_frac` / `*_rows_frac` are informational, never gated."""
     if _NEVER_GATES.search(key):
         return None
     if _LOWER_BETTER.search(key):

@@ -42,6 +42,8 @@ PHASES: Tuple[str, ...] = (
     "queue_wait",       # fleet service submit/dispatch bookkeeping
     "batch_pack",       # batched dispatch: request packing + batch upload
     "pipeline_wait",    # batched dispatch: blocked on an in-flight batch
+    "resident_patch",   # device-resident state: sparse row patch (digest
+    #                     diff + changed-row upload + donated scatter)
     "hooks",            # engine per-tick hooks (cloud tick, arrivals)
     "batch",            # pending-group collection (store index)
     "encode_cold",      # pod->tensor lowering, rows not in the encode cache
@@ -71,7 +73,7 @@ PHASES: Tuple[str, ...] = (
 # device_put; pipeline_wait is device execution the host could not hide)
 DEVICE_PHASES = frozenset(
     {"catalog_put", "device_put", "compile", "dispatch", "readback",
-     "batch_pack", "pipeline_wait"})
+     "batch_pack", "pipeline_wait", "resident_patch"})
 
 # static span-name -> bucket map; names absent here inherit their nearest
 # mapped ancestor's bucket (and the root's own self-time is the gap)
@@ -96,6 +98,7 @@ _SPAN_PHASE: Dict[str, str] = {
     "solve.decode": "decode",
     "solve.device": "solver_overhead",
     "solve.batch_pack": "batch_pack",
+    "solve.resident_patch": "resident_patch",
     "fleet.pipeline_wait": "pipeline_wait",
     "fleet.submit": "queue_wait",
     "fleet.dispatch": "queue_wait",
@@ -246,6 +249,10 @@ class PhaseLedger:
             row[0] += self_ms
             row[1] += 1.0
             attributed += self_ms
+            # solve.resident_patch is deliberately ABSENT here: its
+            # transfers happen inside the enclosing device_put/
+            # catalog_put span, whose transfer-ledger delta already
+            # covers them — counting both would double the H2D bytes
             if s.name in ("solve.device_put", "solve.catalog_put",
                           "solve.batch_pack"):
                 bytes_acc[(st, b)] = bytes_acc.get((st, b), 0) \

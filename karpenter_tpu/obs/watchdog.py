@@ -40,10 +40,17 @@ INCREMENTAL monitors evaluated on a sim-clock cadence:
   retain the evidence the other monitors point at. Per tenant, like
   the profile meter.
 - **devicemem_leak** — a residency-ledger group's OWNER (DeviceCatalog,
-  InFlightBatch) died while its device buffers stay live past the
-  devicemem grace: something else is pinning an evicted owner's upload
-  — exactly the leak shape device-resident state (ROADMAP item 3) can
-  introduce, watched before that work lands.
+  InFlightBatch, ResidentEntry) died while its device buffers stay live
+  past the devicemem grace: something else is pinning an evicted
+  owner's upload — exactly the leak shape device-resident state can
+  introduce, and it now governs ops/resident.py's buffers too.
+- **resident_staleness** — a device-resident delta buffer
+  (ops/resident.py) whose catalog token no longer matches the newest
+  one its facade resolved, lingering past a sim grace: device bytes
+  encode an older catalog epoch than the store serves. The serving path
+  cannot hand them out (upload() re-keys on token mismatch), so a
+  persistent stale entry is held HBM plus a latent-bug signal — the
+  refresh that should have re-seeded it never ran.
 
 Cost discipline: the claim watchlist is maintained from the store's
 watch feed (O(delta) per event, settled claims leave the list), the
@@ -87,6 +94,7 @@ INVARIANTS: Tuple[str, ...] = (
     "profile_unattributed",
     "trace_ring_overflow",
     "devicemem_leak",
+    "resident_staleness",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -146,6 +154,10 @@ class Watchdog:
     UNATTRIBUTED_MS = 5.0     # ledger gap growth per excursion
     RING_DROPS = 64           # recorder rejections since arm
     DEVICEMEM_GRACE = 120.0   # orphaned device buffers' age before a leak
+    RESIDENT_GRACE = 900.0    # stale resident-state age before a finding
+    #                           (generous: a healthy view refreshes at its
+    #                           next solve — only a view that NEVER
+    #                           refreshes after an epoch bump should fire)
     JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
     MAX_FINDINGS = 256        # bounded finding log
 
@@ -207,6 +219,10 @@ class Watchdog:
         # never fire here (zero-false-positive contract)
         self._devmem: Dict[int, float] = {}
         self._devmem_base: frozenset = frozenset()
+        # resident-state staleness: entry key -> first-seen (watchdog
+        # clock); stale at arm = another run's residue, excluded
+        self._resident: Dict[tuple, float] = {}
+        self._resident_base: frozenset = frozenset()
 
     # --- arming -----------------------------------------------------------
     def arm(self, now: Optional[float] = None) -> "Watchdog":
@@ -233,6 +249,8 @@ class Watchdog:
                           if self.warmpath is not None else 0.0)
         self._devmem_base = frozenset(o["group"]
                                       for o in DEVICEMEM.orphans())
+        from ..ops.resident import RESIDENT
+        self._resident_base = frozenset(s["key"] for s in RESIDENT.stale())
         register_debug_route("/debug/watchdog",
                              lambda wd, query: wd.payload(query),
                              owner=self)
@@ -282,6 +300,7 @@ class Watchdog:
         self._check_fleet(now, fired)
         self._check_meters(now, fired)
         self._check_devicemem(now, fired)
+        self._check_resident(now, fired)
         if self._last_sweep is None or force \
                 or now - self._last_sweep >= self.CLOUD_SWEEP:
             self._last_sweep = now
@@ -298,6 +317,7 @@ class Watchdog:
         self._claims = {k: v + shift for k, v in self._claims.items()}
         self._drift = {k: v + shift for k, v in self._drift.items()}
         self._devmem = {k: v + shift for k, v in self._devmem.items()}
+        self._resident = {k: v + shift for k, v in self._resident.items()}
         if self._audit_pending is not None:
             ps, seen = self._audit_pending
             self._audit_pending = (ps, seen + shift)
@@ -602,6 +622,37 @@ class Watchdog:
                 self._devmem.pop(gid, None)
                 self._clear("devicemem_leak", f"group/{gid}")
 
+    def _check_resident(self, now: float, fired: List[Finding]) -> None:
+        """Device-resident delta buffers whose catalog token the world
+        moved past (ops/resident.RESIDENT.stale()) — aged on the
+        watchdog's observation clock, jump-absorbed, pre-arm residue
+        excluded. A healthy view clears itself: its next solve re-keys
+        the entry (full re-upload) or an invalidation drops it."""
+        from ..ops.resident import RESIDENT
+        seen: set = set()
+        for s in RESIDENT.stale():
+            key = s["key"]
+            if key in self._resident_base:
+                continue
+            seen.add(key)
+            first = self._resident.setdefault(key, now)
+            age = now - first
+            if age < self.RESIDENT_GRACE:
+                continue
+            kstr = "/".join(str(t) for t in key)
+            self._fire(fired, "resident_staleness", "warning",
+                       f"view/{kstr}",
+                       f"resident buffer {kstr} encodes catalog token "
+                       f"{s['token']} but the store serves {s['base']} — "
+                       f"stale for {age:.0f}s "
+                       f"(grace {self.RESIDENT_GRACE:g}s)", now,
+                       age_s=round(age, 1))
+        for key in list(self._resident):
+            if key not in seen:   # refreshed or invalidated: re-arm edge
+                self._resident.pop(key, None)
+                kstr = "/".join(str(t) for t in key)
+                self._clear("resident_staleness", f"view/{kstr}")
+
     # --- firing / clearing ------------------------------------------------
     def _fire(self, fired: List[Finding], invariant: str, severity: str,
               key: str, message: str, now: float, **attrs) -> None:
@@ -712,7 +763,8 @@ class Watchdog:
                            "starvation_s": self.starvation_s,
                            "backlog_max": self.backlog_max,
                            "pipeline_s": self.pipeline_grace,
-                           "devicemem_s": self.DEVICEMEM_GRACE},
+                           "devicemem_s": self.DEVICEMEM_GRACE,
+                           "resident_s": self.RESIDENT_GRACE},
                 "stats": dict(self.stats),
                 "fired": dict(self._fired),
                 "watchlist": {"claims": len(self._claims),

@@ -509,6 +509,24 @@ class Solver:
         else:
             self._cat_cache.move_to_end(key)
         self._last_cat_key = key
+        # device-resident staleness feed (ops/resident.py): record the
+        # newest catalog token this facade resolved for the view, so an
+        # idle resident buffer whose epoch the world moved past is
+        # visible to the watchdog's resident_staleness invariant. Both
+        # the cold path (prepare_solve) and the warm path (prepare_warm
+        # via warm_catalog) land here.
+        # Facade-prefixed entries ONLY: one facade has exactly one
+        # current token per nodeclass, so base and entry granularity
+        # agree. The process-shared ("dcat", "shared", ...) entries are
+        # deliberately NOT observed — during a persistent view split
+        # two live fingerprints of one nodeclass legitimately alternate
+        # through one resident key, and a single last-observer base
+        # would flag that healthy state stale forever (their lifecycle
+        # is governed by release_shared_views/invalidate_token instead).
+        tok = hit.cache_token
+        if tok:
+            from .resident import RESIDENT
+            RESIDENT.observe_view(("facade", id(self), key[0]), tuple(tok))
         return hit
 
     def solve(self, pods: Sequence[Pod], nodepool: NodePool,
@@ -769,9 +787,32 @@ class Solver:
                       if k[:n] not in self._cat_cache]:
                 del self._dcat_cache[k]
                 DCAT_EVICTIONS.inc(reason="facade_lru")
-            dcat = device_catalog(cat, R, mesh=mesh)
+            rk = None if mesh is not None else self._resident_key(prep)
+            dcat = device_catalog(
+                cat, R, mesh=mesh,
+                resident_key=rk + ("dcat",) if rk is not None else None)
             self._dcat_cache[dkey] = dcat
         return dcat
+
+    def _resident_key(self, prep: PreparedSolve) -> Optional[tuple]:
+        """Key prefix for this facade's device-resident state (one per
+        (nodeclass, block-gating, daemonset-view) — the catalog EPOCH is
+        deliberately absent: an epoch bump is exactly the moment a delta
+        patch beats a full re-upload, and the entry's stored cache_token
+        forces the conservative full path when content lineage breaks."""
+        if not prep.cat_key:
+            return None
+        return ("facade", id(self), prep.cat_key[0], prep.blocks_gated,
+                prep.ds_fp)
+
+    def invalidate_resident(self, reason: str = "invalidated") -> int:
+        """Drop every device-resident view this facade seeded — called
+        by the warm-path engine when its auditor diverges (the
+        incremental pipeline disagreed with a cold solve, so no
+        incremental device state may be trusted either) and available to
+        chaos/restart machinery. Returns the entries dropped."""
+        from .resident import RESIDENT
+        return RESIDENT.invalidate(("facade", id(self)), reason=reason)
 
     def stage_batchable(self, prep: PreparedSolve):
         """ops.solver.BatchableSolve for a prepared solve, or None when
@@ -816,8 +857,10 @@ class Solver:
                     from .solver import solve_device
                     mesh = self.mesh() if backend == "mesh" else None
                     dcat = self._device_dcat(prep, mesh)
-                    result = solve_device(cat, enc, existing, dcat=dcat,
-                                          mesh=mesh)
+                    result = solve_device(
+                        cat, enc, existing, dcat=dcat, mesh=mesh,
+                        resident_key=(None if mesh is not None
+                                      else self._resident_key(prep)))
                 except Exception as e:  # noqa: BLE001 — graceful degradation:
                     # the TPU backend faulting mid-solve (tunnel drop,
                     # device reset, injected fault) must cost ONE rerouted

@@ -1,0 +1,486 @@
+"""Device-resident cluster state: delta uploads + donated in-place patches.
+
+ROADMAP item 1, the optimization the PR 10 telemetry plane was built to
+judge. Cold 100k-pod solves spend 30-50x the ~2-3ms kernel on host
+orchestration and H2D transfer, and the upload-redundancy meter
+(`obs/devicemem.UploadMeter`) shows most warm-upload bytes are
+byte-identical to the previous tick — bytes the device already holds.
+This module spends that measured headroom: the feasibility/occupancy/
+request tensors stay RESIDENT on device across reconciles, and each
+solve ships only the rows that changed.
+
+Mechanics (`ResidentStateManager`, process singleton `RESIDENT`):
+
+- every resident view is a `ResidentEntry` keyed per facade/catalog
+  view (the same key discipline as the upload meter), holding the
+  device buffer, the uint64 per-row content digests of its CURRENT
+  bytes (the `UploadMeter._row_digests` checksum — the row classifier
+  the warm path's DeltaTracker-adjacent machinery feeds), and the
+  catalog `cache_token` the bytes were encoded against;
+- `upload(key, matrix, token)` digests the new host matrix, diffs it
+  against the entry, uploads ONLY the changed rows (one [k, W] block +
+  one [k] index vector), and applies them with a jitted scatter whose
+  `donate_argnums` donates the resident buffer — the update mutates the
+  device allocation in place instead of reallocating (SNIPPETS.md [1]);
+  zero changed rows means ZERO device traffic;
+- full re-upload fallbacks, each metered on
+  `resident_fallback_total{reason}`: `first_sight` (no entry),
+  `token_change` (catalog epoch bump / ICE or price re-fingerprint —
+  the entry's token no longer matches the view's), `shape_change`
+  (padded shape-class growth or resource-axis width growth),
+  `dtype_change`, `dense` (more than `PATCH_MAX_FRAC` of rows changed:
+  a patch would ship most of the matrix anyway, and the full path keeps
+  one transfer instead of two), and `invalidated` (an explicit
+  `invalidate()` — SharedCatalogCache view splits/evictions, warm-path
+  audit divergence);
+- catalog tensors patch too (`device_catalog(resident_key=...)` routes
+  alloc/price/avail/zone-overhead through the manager), but WITHOUT
+  donation: a shared view's previous `DeviceCatalog` may still serve a
+  co-tenant (an ICE divergence splits views, it doesn't retire them),
+  and donating a buffer another tenant still reads would corrupt it.
+  The transfer saving — only changed type rows cross the tunnel — is
+  identical either way; batched buckets therefore patch their shared
+  catalog once per epoch bump (the first staged ticket's `_auto_dcat`
+  miss), not per ticket;
+- every resident buffer registers with the PR 10 residency ledger under
+  the new owner kind `resident_state` (owner = the entry), so the HBM
+  watermark, the live-bytes gauges, and the watchdog's `devicemem_leak`
+  invariant govern resident state exactly like every other device
+  allocation; patch traffic is attributed under the new transfer reason
+  `resident_patch` and metered on `devicemem_patch_bytes_total{outcome}`
+  (patched = changed-row bytes shipped, avoided = identical bytes NOT
+  shipped, full = fallback re-upload bytes).
+
+Correctness: a patched buffer's bytes equal the cold upload's by
+construction — changed rows are written verbatim, unchanged rows are
+unchanged because their 64-bit content digests match (accidental
+collision odds ~2^-64 per row pair, far below anything observable; the
+byte-parity fuzz in tests/test_resident.py is the gate, and the
+warm-path auditor's divergence hook invalidates resident state the
+moment the incremental pipeline disagrees with a cold solve).
+
+Staleness: `observe_view(prefix, base_token)` records the newest token
+the facade resolved for a view; entries under the prefix whose token no
+longer starts with that base are STALE (device bytes encode an older
+catalog epoch than the store serves). A stale entry can never be
+*served* — `upload()` re-keys on token mismatch — but one lingering
+past a grace is the watchdog's `resident_staleness` invariant: HBM held
+for a view the world moved past.
+
+Opt-out: `KARPENTER_TPU_RESIDENT=0` disarms the manager process-wide
+(every caller falls back to the classic full-upload path).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import devicemem as dm
+from ..obs.tracer import NOOP_SPAN, TRACER
+
+# above this changed-row fraction a patch ships most of the matrix in
+# two transfers (rows + indices) where a full re-upload ships it in one
+PATCH_MAX_FRAC = 0.5
+# resident views kept (LRU). Sized ABOVE the default fleet's working
+# set (50 tenants x (gbuf [+conflict]) + the shared catalog tensors):
+# an LRU smaller than a round-robin working set thrashes on every
+# access — each upload would fall back to first_sight and the delta
+# path would never engage at exactly the scale it targets. Entries are
+# host-cheap (digest vector + a device-buffer reference); evictions are
+# counted in stats["evictions"], so a fleet outgrowing the bound is a
+# visible number, not a silent perf cliff.
+MAX_ENTRIES = 512
+
+FALLBACK_REASONS: Tuple[str, ...] = (
+    "first_sight", "token_change", "shape_change", "dtype_change",
+    "dense", "invalidated",
+)
+
+
+def _jit_scatter():
+    import jax
+
+    def _scatter(buf, idx, rows):
+        return buf.at[idx].set(rows)
+
+    donate = partial(jax.jit, donate_argnums=(0,))(_scatter)
+    plain = jax.jit(_scatter)
+    return donate, plain
+
+
+_scatter_donate = None
+_scatter_plain = None
+
+
+def _scatter_fn(donate: bool):
+    """The jitted row scatter; the donating variant only off-CPU (CPU
+    backends warn on donation, same gate as the batched dispatch)."""
+    global _scatter_donate, _scatter_plain
+    if _scatter_plain is None:
+        _scatter_donate, _scatter_plain = _jit_scatter()
+    if not donate:
+        return _scatter_plain
+    try:
+        import jax
+        cpu = jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 — backend probing must not crash a solve
+        cpu = True
+    return _scatter_plain if cpu else _scatter_donate
+
+
+@dataclass
+class ResidentEntry:
+    """One device-resident view: the buffer, its row digests, and the
+    catalog token its bytes were encoded against. The entry OWNS its
+    buffer in the residency ledger's sense — the entry dying while the
+    bytes stay live is the devicemem_leak orphan condition."""
+
+    key: tuple
+    token: Optional[tuple]
+    shape: tuple
+    dtype: object
+    digests: np.ndarray            # uint64 [rows]
+    buf: object                    # jax.Array
+    group: int                     # residency-ledger group id
+    shape_class: Optional[str] = None
+    stats: Dict[str, int] = field(default_factory=lambda: {
+        "patches": 0, "full": 0, "clean": 0,
+        "rows_patched": 0, "rows_total": 0})
+
+
+class ResidentStateManager:
+    """Process-wide resident-view registry — see module docstring."""
+
+    def __init__(self, max_entries: int = MAX_ENTRIES):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, ResidentEntry]" = OrderedDict()
+        # view prefix -> newest base token, LRU-ordered: re-observation
+        # refreshes position, so the prune below drops dead facades'
+        # residue, never an active view's staleness baseline
+        self._latest: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # keys dropped by invalidate(): the NEXT upload for one meters
+        # its fallback under the invalidation reason (one logical
+        # re-upload = one counter increment, never invalidated AND
+        # first_sight for the same event)
+        self._pending_reason: Dict[tuple, str] = {}
+        self.max_entries = max_entries
+        self.stats: Dict[str, int] = {
+            "patches": 0, "full_uploads": 0, "clean_hits": 0,
+            "rows_patched": 0, "rows_total": 0,
+            "patched_bytes": 0, "avoided_bytes": 0, "full_bytes": 0,
+            "invalidations": 0, "evictions": 0}
+
+    @property
+    def armed(self) -> bool:
+        return os.environ.get("KARPENTER_TPU_RESIDENT", "1") != "0"
+
+    # --- the write side ---------------------------------------------------
+    def upload(self, key: tuple, matrix: np.ndarray,
+               token: Optional[tuple] = None,
+               shape_class: Optional[str] = None,
+               donate: bool = True,
+               patch_across_tokens: bool = False):
+        """Return a device array holding `matrix`'s bytes: the patched
+        resident buffer when the view matches, a full (re-)upload on any
+        fallback trigger. `matrix` is digested on axis 0 (rows = pod
+        groups, instance types, ...); higher-rank tensors patch whole
+        axis-0 rows.
+
+        patch_across_tokens: a token mismatch RE-KEYS the entry and
+        patches instead of re-uploading — for the CATALOG tensors, whose
+        token IS a content fingerprint (every epoch bump mints a new
+        one), a strict token gate would mean they never patch at all.
+        Correctness never rides the token either way: the digest diff
+        compares the new host bytes against the resident copy's, so a
+        patch always lands the new content exactly. Request matrices
+        keep the conservative default (epoch bump => full re-upload)."""
+        token = tuple(token) if token is not None else None
+        mat = np.ascontiguousarray(matrix)
+        with self._lock:
+            ent = self._entries.get(key)
+            reason = None
+            if ent is None:
+                reason = "first_sight"
+            elif ent.shape != mat.shape:
+                reason = "shape_change"
+            elif ent.dtype != mat.dtype:
+                reason = "dtype_change"
+            elif ent.token != token and not patch_across_tokens:
+                reason = "token_change"
+            if reason == "first_sight":
+                # an invalidated view re-seeding counts under the
+                # invalidation reason, not as a brand-new sighting
+                reason = self._pending_reason.pop(key, reason)
+        if reason is not None:
+            return self._full_upload(key, mat, token, shape_class, reason)
+        digests = dm.UploadMeter._row_digests(mat.reshape(mat.shape[0], -1))
+        changed = np.nonzero(digests != ent.digests)[0]
+        rows = int(mat.shape[0])
+        row_bytes = mat.nbytes // max(rows, 1)
+        if changed.size > rows * PATCH_MAX_FRAC:
+            return self._full_upload(key, mat, token, shape_class, "dense",
+                                     digests=digests)
+        try:
+            return self._patch(ent, mat, digests, changed, row_bytes,
+                               shape_class, donate, token)
+        except BaseException:
+            # a device fault mid-patch (tunnel drop during the row
+            # upload or the donated scatter) may have consumed the
+            # resident buffer AND re-keyed the entry's token — the
+            # entry is unusable and must not poison every later solve
+            # for this view. Drop it so the next acquire re-seeds cold;
+            # the raising solve degrades through the facade's normal
+            # fallback machinery.
+            with self._lock:
+                self._entries.pop(key, None)
+                self._pending_reason[key] = "invalidated"
+                self._trim_pending()
+            raise
+
+    def _full_upload(self, key: tuple, mat: np.ndarray,
+                     token: Optional[tuple], shape_class: Optional[str],
+                     reason: str, digests: Optional[np.ndarray] = None):
+        from ..metrics import DEVICEMEM_PATCH, RESIDENT_FALLBACKS
+        from . import solver as _ops
+        RESIDENT_FALLBACKS.inc(reason=reason)
+        if digests is None:
+            digests = dm.UploadMeter._row_digests(
+                mat.reshape(mat.shape[0], -1))
+        with dm.attributed(kind="resident_state",
+                           shape_class=shape_class) as grp:
+            buf = _ops._put(mat)
+        # shipped-bytes redundancy metering: with residency armed the
+        # meter sees what actually crosses the tunnel, so a steady warm
+        # path collapses upload_redundant_frac toward zero changed bytes.
+        # Full uploads and patches observe under DISTINCT keys — the
+        # meter compares row i against row i of the previous observation
+        # for the same key, and a full matrix diffed against a previous
+        # patch's arbitrary changed-row set would be positional noise
+        dm.UPLOADS.observe(key + ("resident", "full"),
+                           mat.reshape(mat.shape[0], -1))
+        with self._lock:
+            ent = self._entries.get(key)
+        if ent is None:
+            ent = ResidentEntry(key=key, token=token, shape=mat.shape,
+                                dtype=mat.dtype, digests=digests, buf=buf,
+                                group=grp, shape_class=shape_class)
+        else:
+            # refresh IN PLACE: the entry object stays the ledger owner
+            # of its previous groups, so a predecessor buffer another
+            # holder still reads (a split view's old DeviceCatalog)
+            # never presents as an owner-dead orphan
+            ent.token, ent.shape, ent.dtype = token, mat.shape, mat.dtype
+            ent.digests, ent.buf, ent.group = digests, buf, grp
+            ent.shape_class = shape_class
+        dm.DEVICEMEM.adopt(grp, ent)
+        ent.stats["full"] += 1
+        ent.stats["rows_total"] += int(mat.shape[0])
+        with self._lock:
+            self._entries[key] = ent
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.stats["evictions"] += 1
+            self.stats["full_uploads"] += 1
+            self.stats["full_bytes"] += int(mat.nbytes)
+            self.stats["rows_total"] += int(mat.shape[0])
+        DEVICEMEM_PATCH.inc(float(mat.nbytes), outcome="full")
+        return buf
+
+    def _patch(self, ent: ResidentEntry, mat: np.ndarray,
+               digests: np.ndarray, changed: np.ndarray, row_bytes: int,
+               shape_class: Optional[str], donate: bool,
+               token: Optional[tuple]):
+        from ..metrics import DEVICEMEM_PATCH
+        rows = int(mat.shape[0])
+        avoided = (rows - int(changed.size)) * row_bytes
+        ent.token = token  # patch-across-tokens re-keys the lineage
+        if changed.size == 0:
+            # nothing moved: the device already holds every byte —
+            # zero transfers, the steady-state fast path
+            with self._lock:
+                self.stats["clean_hits"] += 1
+                self.stats["avoided_bytes"] += avoided
+                self.stats["rows_total"] += rows
+                if ent.key in self._entries:
+                    self._entries.move_to_end(ent.key, last=True)
+            ent.stats["clean"] += 1
+            ent.stats["rows_total"] += rows
+            if avoided:
+                DEVICEMEM_PATCH.inc(float(avoided), outcome="avoided")
+            return ent.buf
+        changed_rows = np.ascontiguousarray(mat[changed])
+        from . import solver as _ops
+        sp = (TRACER.span("solve.resident_patch", rows=int(changed.size),
+                          total_rows=rows,
+                          donate=bool(donate))
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            b0 = dm.TRANSFERS.totals()[0]
+            with dm.attributed(reason="resident_patch",
+                               kind="resident_state",
+                               shape_class=shape_class):
+                idx_dev = _ops._put(changed.astype(np.int32))
+                rows_dev = _ops._put(changed_rows)
+            new_buf = _scatter_fn(donate)(ent.buf, idx_dev, rows_dev)
+            # the scatter output replaces the resident buffer inside the
+            # entry's ledger group (the donated input's bytes release
+            # via its finalizer; non-donated catalog patches keep the
+            # predecessor alive for whoever still reads it)
+            dm.DEVICEMEM.track("resident_state", [new_buf], owner=ent,
+                               shape_class=shape_class, group=ent.group)
+            sp.set(h2d_bytes=dm.TRANSFERS.totals()[0] - b0)
+        dm.UPLOADS.observe(ent.key + ("resident", "patch"),
+                           changed_rows.reshape(changed_rows.shape[0], -1))
+        ent.buf = new_buf
+        ent.digests = digests
+        patched = int(changed.size) * row_bytes
+        ent.stats["patches"] += 1
+        ent.stats["rows_patched"] += int(changed.size)
+        ent.stats["rows_total"] += rows
+        with self._lock:
+            self.stats["patches"] += 1
+            self.stats["rows_patched"] += int(changed.size)
+            self.stats["rows_total"] += rows
+            self.stats["patched_bytes"] += patched
+            self.stats["avoided_bytes"] += avoided
+            if ent.key in self._entries:
+                self._entries.move_to_end(ent.key, last=True)
+        DEVICEMEM_PATCH.inc(float(patched), outcome="patched")
+        if avoided:
+            DEVICEMEM_PATCH.inc(float(avoided), outcome="avoided")
+        return new_buf
+
+    # --- invalidation -----------------------------------------------------
+    def invalidate(self, prefix: tuple, reason: str = "invalidated") -> int:
+        """Drop every entry whose KEY starts with `prefix` (a facade's
+        views on audit divergence, a dead fleet's residue). The next
+        acquire re-uploads cold and meters its fallback under `reason`
+        (deferred — one logical re-upload is one counter increment,
+        and an invalidation nothing ever re-seeds meters nothing);
+        freed entries release their ledger claim when the buffers die."""
+        n = len(prefix)
+        with self._lock:
+            victims = [k for k in self._entries if k[:n] == prefix]
+            for k in victims:
+                del self._entries[k]
+                self._pending_reason[k] = reason
+            self.stats["invalidations"] += len(victims)
+            self._trim_pending()
+        return len(victims)
+
+    def invalidate_token(self, prefix: tuple,
+                         reason: str = "invalidated") -> int:
+        """Drop every entry whose catalog TOKEN starts with `prefix` —
+        the SharedCatalogCache's seam: evicting (or splitting) a shared
+        view must release the resident tensors encoded against its
+        ("shared", ...) token, so a stale resident catalog can never
+        outlive the view it mirrors."""
+        n = len(prefix)
+        with self._lock:
+            victims = [k for k, e in self._entries.items()
+                       if e.token is not None and e.token[:n] == prefix]
+            for k in victims:
+                del self._entries[k]
+                self._pending_reason[k] = reason
+            self.stats["invalidations"] += len(victims)
+            self._trim_pending()
+        return len(victims)
+
+    def _trim_pending(self) -> None:
+        """Bound the deferred-reason map (lock held): reasons for keys
+        that never re-seed must not accumulate forever."""
+        while len(self._pending_reason) > 4 * self.max_entries:
+            self._pending_reason.pop(next(iter(self._pending_reason)))
+
+    # --- staleness (the watchdog's resident_staleness observable) ---------
+    def observe_view(self, prefix: tuple, base_token: tuple) -> None:
+        """Record the newest catalog token base a facade resolved for
+        the views under `prefix` — called from `Solver.tensors()` on
+        both the cold and warm (prepare_warm -> warm_catalog) paths, so
+        the staleness picture tracks the store's catalog epoch even
+        while a view idles."""
+        with self._lock:
+            self._latest[prefix] = tuple(base_token)
+            # LRU, not insertion order: re-observation refreshes the
+            # prefix's position, so the prune drops dead facades'
+            # residue — never an active view's staleness baseline
+            self._latest.move_to_end(prefix)
+            while len(self._latest) > 4 * self.max_entries:
+                self._latest.popitem(last=False)
+
+    def stale(self) -> List[dict]:
+        """Entries whose token no longer starts with the newest base
+        observed for their view prefix: device bytes encoding a catalog
+        epoch older than the one the store serves. Served-path safety
+        does not depend on this (upload() re-keys on token mismatch);
+        lingering staleness is held HBM + a latent-bug signal — the
+        watchdog ages it past a sim grace."""
+        out: List[dict] = []
+        with self._lock:
+            for key, ent in self._entries.items():
+                for prefix, base in self._latest.items():
+                    if key[: len(prefix)] != prefix:
+                        continue
+                    tok = ent.token
+                    if tok is None or tok[: len(base)] != base:
+                        out.append({"key": key, "token": tok,
+                                    "base": base})
+                    break
+        return out
+
+    # --- read side --------------------------------------------------------
+    def patched_rows_frac(self) -> float:
+        """Patched rows / total rows over every resident acquire — the
+        bench's c8_patched_rows_frac (informational in the perf gate:
+        workload churn moves it, latency does not)."""
+        with self._lock:
+            total = self.stats["rows_total"]
+            return self.stats["rows_patched"] / total if total else 0.0
+
+    def snapshot(self) -> dict:
+        stale_n = len(self.stale())
+        with self._lock:
+            entries = [{
+                "key": "/".join(str(t) for t in e.key),
+                "rows": int(e.shape[0]),
+                "shape": list(e.shape),
+                "shape_class": e.shape_class,
+                "stats": dict(e.stats),
+            } for e in self._entries.values()]
+            stats = dict(self.stats)
+        total = stats["rows_total"]
+        return {"armed": self.armed,
+                "entries": entries,
+                "stale": stale_n,
+                "patched_rows_frac": round(
+                    stats["rows_patched"] / total, 4) if total else 0.0,
+                "stats": stats}
+
+    def reset(self) -> None:
+        """Forget every resident view and counter — bench regime
+        isolation (mirrors the residency ledger's reset discipline)."""
+        with self._lock:
+            self._entries.clear()
+            self._latest.clear()
+            self._pending_reason.clear()
+            self.stats.update({k: 0 for k in self.stats})
+
+
+RESIDENT = ResidentStateManager()
+
+
+def payload(query: str = "") -> dict:
+    return RESIDENT.snapshot()
+
+
+from ..obs.exposition import register_debug_route  # noqa: E402
+
+register_debug_route("/debug/resident", lambda query: payload(query))

@@ -160,16 +160,32 @@ class DeviceCatalog:
     ovh_z: Optional[jax.Array] = None
 
 
-def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
+def device_catalog(cat: CatalogTensors, R: int, mesh=None,
+                   resident_key: Optional[tuple] = None) -> DeviceCatalog:
     """mesh: replicate the catalog over the mesh's devices (the sharded
-    solve reads it on every chip) instead of committing to device 0."""
+    solve reads it on every chip) instead of committing to device 0.
+
+    resident_key (single-device only): route the four catalog tensors
+    through the device-resident state manager (ops/resident.py) — an
+    epoch bump then ships only the instance-type rows whose content
+    changed (an ICE mark flips a few avail rows, not the catalog), as a
+    NON-donated scatter from the previous resident copy: a split shared
+    view's predecessor DeviceCatalog may still serve a co-tenant, so
+    its buffers must survive the patch."""
     from .encode import align_zone_overhead
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         rep = NamedSharding(mesh, P())
-        put = lambda x: _put_sharded(np.asarray(x), rep)
+        put = lambda name, x: _put_sharded(np.asarray(x), rep)
     else:
-        put = _put
+        from .resident import RESIDENT
+        if resident_key is not None and RESIDENT.armed:
+            tok = cat.cache_token
+            put = lambda name, x: RESIDENT.upload(
+                resident_key + (name,), np.asarray(x), token=tok,
+                donate=False, patch_across_tokens=True)
+        else:
+            put = lambda name, x: _put(x)
     zovh = align_zone_overhead(cat, R)
     sp = (TRACER.span("solve.catalog_put", T=int(cat.T), R=int(R),
                       mesh=mesh is not None)
@@ -179,10 +195,10 @@ def device_catalog(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
         with dm.attributed(reason="catalog_put", kind="catalog",
                            token=cat.cache_token) as grp:
             dcat = DeviceCatalog(
-                alloc=put(align_resources(cat.allocatable, R)),
-                price=put(cat.price),
-                avail=put(cat.available),
-                ovh_z=put(zovh) if zovh is not None else None,
+                alloc=put("alloc", align_resources(cat.allocatable, R)),
+                price=put("price", cat.price),
+                avail=put("avail", cat.available),
+                ovh_z=put("ovh_z", zovh) if zovh is not None else None,
             )
         # the DeviceCatalog OWNS these tensors: the residency ledger's
         # leak invariant watches for the owner dying while the buffers
@@ -239,6 +255,12 @@ def release_shared_views(prefix: tuple) -> int:
     for k in victims:
         _dcat_auto.pop(k, None)
         _count_dcat_eviction("view_evicted")
+    # the view's device-resident delta state goes with it: resident
+    # tensors encoded against the dead view's ("shared", ...) token must
+    # never outlive the view — a later tenant resolving the same
+    # nodeclass re-seeds cold instead of patching a retired baseline
+    from .resident import RESIDENT
+    RESIDENT.invalidate_token(prefix)
     return len(victims)
 
 
@@ -266,7 +288,21 @@ def _auto_dcat(cat: CatalogTensors, R: int, mesh=None) -> DeviceCatalog:
         _count_dcat_eviction("stale")
     if ent is None and not by_token:
         weakref.finalize(cat, _finalize_dcat, key)
-    dcat = device_catalog(cat, R, mesh=mesh)
+    # shared (content-token) views patch through the resident manager:
+    # the key carries the nodeclass root + derived-view structure but
+    # NOT the availability fingerprint, so an epoch bump ships only the
+    # changed type rows — and since _dcat_auto fronts this per token,
+    # a batched pump's co-staged tickets patch the shared catalog once
+    # per bump, not once per ticket
+    rkey = None
+    if by_token and mesh is None:
+        # key = nodeclass root + the FULL derived-view suffix (the
+        # "noblocks"/"ds" markers AND the daemonset digest) minus the
+        # availability fingerprint (tok[2]) — epoch bumps patch, but
+        # two distinct daemonset-derived views never collide on (and
+        # alternately thrash) one resident entry
+        rkey = ("dcat", "shared", tok[1]) + tuple(tok[3:])
+    dcat = device_catalog(cat, R, mesh=mesh, resident_key=rkey)
     _dcat_auto[key] = dcat
     if by_token:
         # token-keyed entries deliberately OUTLIVE any one CatalogTensors
@@ -1100,7 +1136,8 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
                  existing: Optional[List[VirtualNode]] = None,
                  n_max: Optional[int] = None,
                  dcat: Optional[DeviceCatalog] = None,
-                 mesh=None) -> SolveResult:
+                 mesh=None,
+                 resident_key: Optional[tuple] = None) -> SolveResult:
     """Run the kernel and decode the result to the same SolveResult shape
     solve_host produces. `enc` must be spread-free (split_spread_groups).
 
@@ -1124,7 +1161,8 @@ def solve_device(cat: CatalogTensors, enc: EncodedPods,
     else:
         span = NOOP_SPAN
     with span:
-        result = _solve_device_impl(cat, enc, existing, n_max, dcat, mesh)
+        result = _solve_device_impl(cat, enc, existing, n_max, dcat, mesh,
+                                    resident_key=resident_key)
         u1, d1 = transfer_bytes()
         TRANSFER_BYTES_H2D.set(u1 - u0)
         TRANSFER_BYTES_D2H.set(d1 - d0)
@@ -1136,7 +1174,8 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
                        existing: Optional[List[VirtualNode]] = None,
                        n_max: Optional[int] = None,
                        dcat: Optional[DeviceCatalog] = None,
-                       mesh=None) -> SolveResult:
+                       mesh=None,
+                       resident_key: Optional[tuple] = None) -> SolveResult:
     assert not enc.spread_zone.any(), "run split_spread_groups before solve"
     prep_sp = (TRACER.span("solve.prep") if TRACER.enabled else NOOP_SPAN)
     with prep_sp:
@@ -1208,13 +1247,31 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
             b0 = transfer_bytes()[0]
             gbuf_np = _pack_groups(requests, counts, compat, allow_zone,
                                    allow_cap, max_per_node, list(cols))
-            # redundancy meter: how much of THIS view's request matrix
-            # is byte-identical to the previous solve's upload — the
-            # measured delta-upload headroom (ROADMAP item 3)
-            dm.UPLOADS.observe(("serial", id(dcat), Gp), gbuf_np)
-            with dm.attributed(shape_class=shape_class):
-                gbuf_dev = _put(gbuf_np)
-                conflict_dev = _put(conflict_np) if track else None
+            from .resident import RESIDENT
+            if resident_key is not None and RESIDENT.armed:
+                # device-resident delta path (ops/resident.py): the
+                # request matrix stays on device across reconciles and
+                # only CHANGED group rows cross the tunnel, applied as
+                # a donated in-place scatter; an unchanged warm solve
+                # ships zero upload bytes. Fallbacks (epoch bump,
+                # shape-class growth, dense churn) re-upload full —
+                # byte-parity with this cold path either way.
+                gbuf_dev = RESIDENT.upload(
+                    resident_key + ("gbuf", Gp), gbuf_np,
+                    token=cat.cache_token, shape_class=shape_class)
+                conflict_dev = (RESIDENT.upload(
+                    resident_key + ("conflict", Gp), conflict_np,
+                    token=cat.cache_token, shape_class=shape_class)
+                    if track else None)
+            else:
+                # redundancy meter: how much of THIS view's request
+                # matrix is byte-identical to the previous solve's
+                # upload — the measured delta-upload headroom the
+                # resident path above spends
+                dm.UPLOADS.observe(("serial", id(dcat), Gp), gbuf_np)
+                with dm.attributed(shape_class=shape_class):
+                    gbuf_dev = _put(gbuf_np)
+                    conflict_dev = _put(conflict_np) if track else None
             sp.set(gbuf_shape=str(tuple(gbuf_dev.shape)),
                    h2d_bytes=transfer_bytes()[0] - b0)
     # sparse-take budget: nnz ≈ n_used + cross-node sharing, far below the

@@ -191,7 +191,13 @@ class WarmPathEngine:
                 "warm-path audit diverged from the full solver — forcing "
                 "cold: %s", "; ".join(divergences))
             # never wrong twice: the path goes cold until the next
-            # committed full solve rebuilds the ledger
+            # committed full solve rebuilds the ledger — and no
+            # incremental DEVICE state may be trusted either: drop the
+            # solver's resident delta buffers so the repair solve
+            # re-seeds them from a clean cold upload
+            inval = getattr(self.solver, "invalidate_resident", None)
+            if inval is not None:
+                inval("invalidated")
             self.force_cold("audit-divergence")
         else:
             WARMPATH_AUDITS.inc(outcome="clean")

@@ -119,10 +119,11 @@ class TestResidencyLedger:
 
     def test_owner_kinds_frozen(self):
         assert OWNER_KINDS == ("catalog", "solve_upload", "batch_gbuf",
-                               "packed_result", "mesh_shard")
+                               "packed_result", "mesh_shard",
+                               "resident_state")
         assert TRANSFER_REASONS == ("catalog_put", "request_upload",
                                     "batch_upload", "screen_upload",
-                                    "readback")
+                                    "readback", "resident_patch")
 
 
 class TestTransferAttribution:
@@ -319,10 +320,15 @@ class TestBatchedPumpTransfers:
         assert r1 - r0 == buckets
         assert all(t.batch_size == 4 for t in tickets)
 
-    def test_bytes_identical_batch_on_off(self):
+    def test_bytes_identical_batch_on_off(self, monkeypatch):
         """The same solves move the same bytes whether dispatched
         serially or as one ladder-sized batch — batching amortizes
-        ROUND-TRIPS, it must not inflate volume."""
+        ROUND-TRIPS, it must not inflate volume. Residency is disarmed
+        here: the contract compares the two DISPATCH engines at equal
+        upload policy (with residency armed, the serial path ships
+        strictly fewer bytes — the delta win tests/test_resident.py
+        measures on its own)."""
+        monkeypatch.setenv("KARPENTER_TPU_RESIDENT", "0")
         from karpenter_tpu.ops import solver as S
         types = small_catalog()
 
@@ -413,6 +419,38 @@ class TestBatchedPumpTransfers:
         assert "packed_result" in kinds
         results = ifb.results()
         assert all(r.nodes for r in results)
+
+
+class TestResidentStatePlane:
+    """The device-resident state manager's face on the telemetry plane
+    (ops/resident.py): the resident_state owner kind and the
+    resident_patch transfer reason — obs-audit's taxonomy coverage."""
+
+    def test_resident_state_kind_and_patch_reason(self):
+        from karpenter_tpu.ops.resident import RESIDENT
+        RESIDENT.reset()
+
+        def patch_bytes():
+            return sum(r["bytes"] for r in dm.TRANSFERS.snapshot()["rows"]
+                       if r["reason"] == "resident_patch")
+
+        try:
+            mat = np.arange(32, dtype=np.float32).reshape(8, 4)
+            RESIDENT.upload(("dm-kind",), mat, token=("t",))
+            with dm.DEVICEMEM._lock:
+                kinds = {g["kind"] for g in dm.DEVICEMEM._groups.values()
+                         if g["live"]}
+            # the resident buffer wears the resident_state owner kind
+            assert "resident_state" in kinds
+            # a delta patch attributes its traffic to resident_patch:
+            # one changed row + the index vector, nothing else
+            b0 = patch_bytes()
+            mat2 = mat.copy()
+            mat2[5] += 9.0
+            RESIDENT.upload(("dm-kind",), mat2, token=("t",))
+            assert patch_bytes() - b0 == 4 * 4 + 4
+        finally:
+            RESIDENT.reset()
 
 
 class TestDebugRoute:

@@ -69,6 +69,10 @@ class TestPhaseLedgerMapping:
         ("solve.batch_pack", {"h2d_bytes": 512, "requests": 4},
          "batch_pack"),
         ("fleet.pipeline_wait", {"batch": 4}, "pipeline_wait"),
+        # device-resident state (ops/resident.py): the sparse row patch
+        # — digest diff + changed-row upload + donated scatter
+        ("solve.resident_patch", {"h2d_bytes": 96, "rows": 3},
+         "resident_patch"),
         ("reconcile:provisioner", {}, "reconcile_other"),
     ]
 

@@ -61,7 +61,8 @@ class TestArming:
             "claim_leak", "store_cloud_drift", "intent_age",
             "warm_audit_lag", "warm_divergence", "fleet_starvation",
             "pipeline_stall", "profile_unattributed",
-            "trace_ring_overflow", "devicemem_leak")
+            "trace_ring_overflow", "devicemem_leak",
+            "resident_staleness")
 
 
 class TestTrips:
@@ -328,6 +329,56 @@ class TestTrips:
             assert not _findings(wd, "devicemem_leak")
         finally:
             del arr
+
+    def test_trip_resident_staleness(self):
+        """A device-resident delta buffer whose catalog token the world
+        moved past (the facade resolved a newer epoch, the entry never
+        refreshed) fires after the resident grace; refreshing the entry
+        (the re-key a healthy solve performs) clears the excursion."""
+        import numpy as np
+
+        from karpenter_tpu.ops.resident import RESIDENT
+
+        RESIDENT.reset()
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        mat = np.ones((4, 8), np.float32)
+        key = ("facade", 1234, "nc-stale", False, 0)
+        RESIDENT.upload(key + ("gbuf", 8), mat, token=("nc-stale", 7))
+        # the view is current: no staleness, no finding
+        RESIDENT.observe_view(("facade", 1234, "nc-stale"), ("nc-stale", 7))
+        wd.tick(force=True)
+        assert not _findings(wd, "resident_staleness")
+        # the catalog epoch moves on, the entry never refreshes
+        RESIDENT.observe_view(("facade", 1234, "nc-stale"), ("nc-stale", 8))
+        _age(wd, wd.RESIDENT_GRACE + wd.interval + 1)
+        found = _findings(wd, "resident_staleness")
+        assert found and found[0].severity == "warning"
+        assert "nc-stale" in found[0].message
+        # a refresh at the new token (what the next solve does) clears it
+        RESIDENT.upload(key + ("gbuf", 8), mat, token=("nc-stale", 8))
+        wd.tick(force=True)
+        assert not any(inv == "resident_staleness"
+                       for inv, _k in wd._active)
+        RESIDENT.reset()
+
+    def test_resident_staleness_predating_arm_never_fires(self):
+        """Stale resident residue from a previous run is baselined out
+        at arm() — the zero-false-positive contract."""
+        import numpy as np
+
+        from karpenter_tpu.ops.resident import RESIDENT
+
+        RESIDENT.reset()
+        mat = np.ones((2, 4), np.float32)
+        key = ("facade", 99, "nc-old", False, 0)
+        RESIDENT.upload(key + ("gbuf", 4), mat, token=("nc-old", 1))
+        RESIDENT.observe_view(("facade", 99, "nc-old"), ("nc-old", 2))
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()  # already stale HERE: residue
+        _age(wd, wd.RESIDENT_GRACE + wd.interval + 1)
+        assert not _findings(wd, "resident_staleness")
+        RESIDENT.reset()
 
     def test_meter_monitors_attribute_per_tenant(self):
         """The ring/ledger meters are process-global but the monitors

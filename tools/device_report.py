@@ -7,15 +7,21 @@ runs a few warm solve rounds against a synthetic cluster and prints
 - the transfer-attribution breakdown (reason x tenant x shape class),
 - the upload-redundancy meter (the measured delta-upload headroom of
   ROADMAP item 3: how much of each warm upload is byte-identical to
-  the previous one), and
-- the `jax.live_arrays()` cross-check (accounted vs unaccounted bytes).
+  the previous one),
+- the `jax.live_arrays()` cross-check (accounted vs unaccounted bytes),
+  and
+- the device-resident breakdown (`make resident-report`): the same warm
+  rounds through a facade with delta patching armed — rows patched vs
+  re-uploaded vs clean (zero-transfer), bytes shipped vs avoided, and
+  the fallback reasons (ops/resident.py spends the headroom the meter
+  above only measures).
 
 Prints one human table and one JSON line, so it serves both a terminal
 spot-check and scripted regression tracking.
 
 Usage:
     python tools/device_report.py [--pods 2000] [--rounds 4]
-                                  [--churn-pct 1.0]
+                                  [--churn-pct 1.0] [--no-resident]
 """
 
 from __future__ import annotations
@@ -35,6 +41,9 @@ def main(argv=None) -> int:
     ap.add_argument("--churn-pct", type=float, default=1.0,
                     help="%% of pods whose requests change each round "
                          "(0 = perfectly warm re-uploads)")
+    ap.add_argument("--no-resident", action="store_true",
+                    help="skip the device-resident patched-vs-reuploaded "
+                         "breakdown phase")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -106,6 +115,49 @@ def main(argv=None) -> int:
         print(f"  live-array audit: coverage {audit['coverage']:.4f} "
               f"({audit['unaccounted_bytes']:,} B unaccounted of "
               f"{audit['live_arrays']} live arrays)")
+
+    resident = None
+    if not args.no_resident:
+        # phase 2: SPEND the measured headroom — the same warm rounds
+        # through a facade with device-resident delta patching armed,
+        # reported as a patched-vs-reuploaded breakdown
+        from karpenter_tpu.catalog import CatalogProvider
+        from karpenter_tpu.models.nodepool import NodePool
+        from karpenter_tpu.ops.facade import Solver
+        from karpenter_tpu.ops.resident import RESIDENT
+        RESIDENT.reset()
+        pool = NodePool(name="device-report")
+        facade = Solver(CatalogProvider(generate_catalog),
+                        backend="device")
+        rpods = [mk(i) for i in range(args.pods)]
+        facade.solve(rpods, pool)              # cold seed
+        h_res0 = dm.TRANSFERS.totals()[0]
+        for rnd in range(1, args.rounds):
+            for j in range(churn):
+                rpods[-(j + 1)] = mk(args.pods + j, gen=rnd)
+            facade.solve(rpods, pool)
+        h_res = dm.TRANSFERS.totals()[0] - h_res0
+        snap = RESIDENT.snapshot()
+        st = snap["stats"]
+        shipped = st["patched_bytes"] + st["full_bytes"]
+        print(f"\n  device-resident breakdown ({args.rounds - 1} warm "
+              f"rounds, residency {'armed' if snap['armed'] else 'OFF'})")
+        print(f"  {'outcome':<16} {'rows':>10} {'bytes':>14}")
+        print(f"  {'patched':<16} {st['rows_patched']:>10,} "
+              f"{st['patched_bytes']:>14,}")
+        print(f"  {'avoided':<16} "
+              f"{st['rows_total'] - st['rows_patched']:>10,} "
+              f"{st['avoided_bytes']:>14,}")
+        print(f"  {'full reupload':<16} {st['full_uploads']:>10,} "
+              f"{st['full_bytes']:>14,}")
+        print(f"  patched-rows fraction {snap['patched_rows_frac']:.4f}; "
+              f"warm h2d {h_res:,} B shipped vs "
+              f"{st['avoided_bytes']:,} B avoided "
+              f"(clean zero-transfer solves: {st['clean_hits']})")
+        resident = {"patched_rows_frac": snap["patched_rows_frac"],
+                    "warm_h2d_bytes": h_res,
+                    "shipped_bytes": shipped,
+                    "stats": st}
     print()
     print(json.dumps({
         "pods": args.pods, "rounds": args.rounds,
@@ -118,6 +170,7 @@ def main(argv=None) -> int:
         "transfers": {"h2d_bytes": xfer["h2d_bytes"],
                       "d2h_bytes": xfer["d2h_bytes"]},
         "audit": audit,
+        "resident": resident,
     }))
     return 0
 
