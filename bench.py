@@ -915,6 +915,50 @@ def main() -> None:
                  f"{solves12 / batched_s:.0f}/s vs serial "
                  f"{solves12 / serial_s:.0f}/s")
 
+    progress("c13: open-loop soak — sustained arrivals past saturation")
+    # --- config 13: the open-loop traffic plane (loadgen/, ROADMAP item
+    # 5). A seeded soak drives 4 tenant fleets past saturation through
+    # recurring spot-capacity fronts: arrivals fire on the sim clock
+    # WITHOUT waiting for drain, the admission controller bounds the
+    # backlog by shedding (metered per tenant/reason), and the run is
+    # judged live by the SLO burn rates + the watchdog's
+    # overload_unbounded invariant. Stamped through the run-stamp
+    # machinery so `make perf-gate` baselines the soak throughput from
+    # this run forward; `*_shed_frac` is classified informational (a
+    # workload property), `*_arrivals_per_sec` gates higher-better.
+    from karpenter_tpu.loadgen import SoakRunner
+    t0 = time.perf_counter()
+    soak13 = SoakRunner("soak_overload", seed=0, backend="host")
+    rep13 = soak13.run()
+    soak_wall_s = time.perf_counter() - t0
+    st13 = rep13.stats
+    detail["c13_tenants"] = rep13.tenants
+    detail["c13_offered_pods"] = int(st13["offered_pods"])
+    detail["c13_admitted_pods"] = int(st13["admitted_pods"])
+    detail["c13_shed_pods"] = int(st13["shed_pods"])
+    detail["c13_shed_frac"] = st13["shed_frac"]          # informational
+    detail["c13_max_waiting_depth"] = int(st13["max_waiting_depth"])
+    detail["c13_overload_findings"] = int(st13["overload_findings"])
+    detail["c13_slo_alerts"] = int(st13["slo_alerts"])
+    detail["c13_soak_sim_seconds"] = round(rep13.sim_seconds, 1)
+    detail["c13_soak_wall_ms"] = round(soak_wall_s * 1e3, 1)
+    # throughput: offered open-loop pods processed (admitted+shed
+    # verdicts issued) per wall second of the whole soak, and the
+    # admitted-only rate — the "how much traffic can this serving stack
+    # chew through" headline the perf gate tracks
+    detail["c13_arrivals_per_sec"] = round(
+        st13["offered_pods"] / max(soak_wall_s, 1e-9), 1)
+    detail["c13_admitted_arrivals_per_sec"] = round(
+        st13["admitted_pods"] / max(soak_wall_s, 1e-9), 1)
+    detail["soak_arrivals_per_sec"] = detail["c13_arrivals_per_sec"]
+    detail["soak_shed_frac"] = detail["c13_shed_frac"]
+    if not rep13.ok:
+        progress(f"SOAK REGIME FAILED: {rep13.violations[:3]}")
+    if st13["overload_findings"]:
+        progress(f"OVERLOAD UNBOUNDED: {int(st13['overload_findings'])} "
+                 "watchdog findings with shedding armed — the admission "
+                 "budgets did not hold")
+
     progress("profile: writing profile_bench.json (phase attribution)")
     # --- the phase-attribution artifact (obs/profile.py): everything the
     # traced windows above fed the ledger (c7 solve, c8 warm+cold

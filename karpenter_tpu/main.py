@@ -195,6 +195,29 @@ def run_fleet(opts: Options) -> int:
     return 0 if report.ok else 1
 
 
+def run_soak(opts: Options) -> int:
+    """Long-soak serving mode (--soak): drive a tenant fleet through an
+    OPEN-LOOP, seeded arrival process (loadgen/) — arrivals fire on the
+    sim clock without waiting for drain, the admission controller in
+    the shared SolverService sheds/defers load past saturation, and the
+    run is judged by the SLO engine, the watchdog (overload_unbounded
+    armed over the generator's depth observables), and the three-digest
+    repeat contract. `--arrival-rate` / `--soak-duration` override the
+    scenario; `--fleet-tenants` (when >0) overrides the shard count."""
+    from .loadgen import SoakRunner
+    runner = SoakRunner(
+        opts.soak_scenario,
+        tenants=opts.fleet_tenants or None,
+        backend=opts.solver_backend,
+        arrival_rate=opts.arrival_rate or None,
+        duration=opts.soak_duration or None,
+        admission=False if opts.soak_no_admission else None,
+        batch=opts.fleet_batch or None)
+    report = runner.run()
+    print(report.summary())
+    return 0 if report.ok else 1
+
+
 def main() -> None:
     import sys
     # parse the REAL command line: Options.parse(None) deliberately
@@ -202,6 +225,8 @@ def main() -> None:
     # and pytest's argv must never leak in), so the entrypoint is the
     # one place that feeds sys.argv through
     opts = Options.parse(sys.argv[1:])
+    if opts.soak:
+        raise SystemExit(run_soak(opts))
     if opts.fleet_tenants > 0:
         raise SystemExit(run_fleet(opts))
     runtime, _store, _cloud = build_operator(options=opts)

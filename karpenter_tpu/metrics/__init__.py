@@ -447,6 +447,54 @@ TRACE_RING_DROPPED = REGISTRY.counter(
     "watchdog's trace_ring_overflow monitor: one tenant's hot loop "
     "overflowing the ring must point at that tenant, not at the fleet",
     ("tenant",), label_defaults=_TENANT)
+FLEET_QUEUE_DEPTH = REGISTRY.gauge(
+    "karpenter_tpu_fleet_queue_depth",
+    "Solve tickets a tenant has queued in the shared SolverService that "
+    "no pump has picked yet — the live per-tenant face of the service "
+    "backlog the watchdog's fleet_starvation monitor reads in aggregate. "
+    "The serial fleet drains synchronously so this is ~0 between pumps; "
+    "under the async/open-loop drivers a persistently growing value for "
+    "one tenant is the admission-control engage signal",
+    ("tenant",), label_defaults=_TENANT)
+LOADGEN_ARRIVALS = REGISTRY.counter(
+    "karpenter_tpu_loadgen_arrivals_total",
+    "Pods offered by the open-loop load generator (loadgen/), by arrival "
+    "process (poisson / diurnal / bursty / trace) — offered load, before "
+    "the admission controller's admit/defer/shed verdict, so "
+    "offered - admitted - shed = currently deferred",
+    ("process", "tenant"), label_defaults=_TENANT)
+LOADGEN_ADMITTED = REGISTRY.counter(
+    "karpenter_tpu_loadgen_admitted_total",
+    "Offered pods the admission controller let into the store "
+    "(fleet/service.AdmissionController): the load the control plane "
+    "actually serves. admitted/offered is the soak acceptance ratio the "
+    "bench c13 keys report",
+    ("tenant",), label_defaults=_TENANT)
+LOADGEN_SHED = REGISTRY.counter(
+    "karpenter_tpu_loadgen_shed_total",
+    "Offered pods the admission controller DROPPED, by reason: "
+    "'queue_depth' = the tenant's waiting-pod depth (pending + deferred) "
+    "already exceeded the shed budget, 'defer_budget' = the arrival "
+    "exhausted its re-offer attempts without the backlog clearing. "
+    "Zero below saturation (the soak_smoke assert); nonzero past it is "
+    "overload degrading PREDICTABLY — unbounded queue growth instead "
+    "of shedding is the watchdog's overload_unbounded invariant",
+    ("tenant", "reason"), label_defaults=_TENANT)
+LOADGEN_DEFERRED = REGISTRY.counter(
+    "karpenter_tpu_loadgen_deferred_total",
+    "Arrival batches the admission controller deferred for a later "
+    "re-offer with seed-deterministic backoff (each re-offer of the "
+    "same batch counts again): soft backpressure — the load is delayed, "
+    "not dropped, and the deferred backlog is bounded by the shed budget",
+    ("tenant",), label_defaults=_TENANT)
+LOADGEN_BACKLOG = REGISTRY.gauge(
+    "karpenter_tpu_loadgen_backlog",
+    "Pods currently held in the load generator's deferred queue "
+    "awaiting re-offer (per tenant): the admission controller's "
+    "waiting room. Bounded by the shed budget whenever shedding is "
+    "armed; growth past that with shedding disabled is exactly the "
+    "overload_unbounded excursion",
+    ("tenant",), label_defaults=_TENANT)
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

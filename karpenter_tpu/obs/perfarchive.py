@@ -74,7 +74,12 @@ _HIGHER_BETTER = re.compile(
 # direction (checked BEFORE the suffix rules: `_frac` isn't a latency).
 # `*_rows_frac` (the resident patch-density measurement) is the same
 # kind of quantity: churn in the workload moves it, the code does not.
-_NEVER_GATES = re.compile(r"(_redundant_frac|_rows_frac)$")
+# `*_shed_frac` (the c13 soak regime's admission-control drop rate) is a
+# WORKLOAD property too — the scenario chooses how far past saturation
+# it drives, so neither direction is a code regression; the gated soak
+# quantities are the `*_arrivals_per_sec` throughput keys (higher-better
+# via the `_per_sec` rule below).
+_NEVER_GATES = re.compile(r"(_redundant_frac|_rows_frac|_shed_frac)$")
 
 
 def metric_direction(key: str) -> Optional[str]:

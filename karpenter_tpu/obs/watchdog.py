@@ -51,6 +51,14 @@ INCREMENTAL monitors evaluated on a sim-clock cadence:
   cannot hand them out (upload() re-keys on token mismatch), so a
   persistent stale entry is held HBM plus a latent-bug signal — the
   refresh that should have re-seeded it never ran.
+- **overload_unbounded** — an open-loop tenant's waiting-pod depth
+  (pending + deferred, loadgen/source.py) sits ABOVE the admission
+  controller's shed budget and is still not shrinking (or its oldest
+  parked arrival keeps aging) after the overload grace: admission
+  control should have engaged and bounded the queue — with shedding
+  armed this can never fire (the budgets hold by construction), with
+  shedding disabled it is the page that says overload is degrading
+  unboundedly instead of predictably.
 
 Cost discipline: the claim watchlist is maintained from the store's
 watch feed (O(delta) per event, settled claims leave the list), the
@@ -95,6 +103,7 @@ INVARIANTS: Tuple[str, ...] = (
     "trace_ring_overflow",
     "devicemem_leak",
     "resident_staleness",
+    "overload_unbounded",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -112,6 +121,7 @@ _VIOLATION_MAP: Tuple[Tuple[str, str], ...] = (
     ("orphaned", "store_cloud_drift"),
     ("intent(s) still open", "intent_age"),
     ("auditor diverged", "warm_divergence"),
+    ("unbounded backlog", "overload_unbounded"),
 )
 
 
@@ -158,24 +168,32 @@ class Watchdog:
     #                           (generous: a healthy view refreshes at its
     #                           next solve — only a view that NEVER
     #                           refreshes after an epoch bump should fire)
+    OVERLOAD_GRACE = 45.0     # sim seconds a tenant's waiting depth may
+    #                           sit above the admission budget before a
+    #                           still-growing backlog counts as unbounded
     JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
     MAX_FINDINGS = 256        # bounded finding log
 
     def __init__(self, clock, store=None, cloud=None, journal=None,
-                 warmpath=None, service=None,
+                 warmpath=None, service=None, loadgen=None,
                  interval: Optional[float] = None,
                  claim_grace: Optional[float] = None,
                  drift_grace: Optional[float] = None,
                  audit_lag_grace: Optional[float] = None,
                  starvation_s: Optional[float] = None,
                  backlog_max: Optional[int] = None,
-                 pipeline_grace: Optional[float] = None):
+                 pipeline_grace: Optional[float] = None,
+                 overload_grace: Optional[float] = None):
         self.clock = clock
         self.store = store
         self.cloud = cloud
         self.journal = journal
         self.warmpath = warmpath
         self.service = service
+        # loadgen observable: an object with overload_state() ->
+        # {tenant: {depth, oldest_age_s, budget, armed}} (the SoakRunner
+        # or a single OpenLoopSource-compatible shim)
+        self.loadgen = loadgen
         self.interval = self.INTERVAL if interval is None else interval
         self.claim_grace = (self.CLAIM_GRACE if claim_grace is None
                             else claim_grace)
@@ -190,6 +208,8 @@ class Watchdog:
                             else int(backlog_max))
         self.pipeline_grace = (self.PIPELINE_GRACE if pipeline_grace is None
                                else float(pipeline_grace))
+        self.overload_grace = (self.OVERLOAD_GRACE if overload_grace is None
+                               else float(overload_grace))
         self._lock = threading.Lock()
         self.findings: List[Finding] = []
         # ACTIVE excursions: (invariant, key) -> severity. The verdict
@@ -223,6 +243,10 @@ class Watchdog:
         # clock); stale at arm = another run's residue, excluded
         self._resident: Dict[tuple, float] = {}
         self._resident_base: frozenset = frozenset()
+        # overload excursions: tenant -> (first-seen-over-budget stamp on
+        # the watchdog clock, depth at first sight) — jump-absorbed like
+        # every other window
+        self._overload: Dict[str, Tuple[float, int]] = {}
 
     # --- arming -----------------------------------------------------------
     def arm(self, now: Optional[float] = None) -> "Watchdog":
@@ -301,6 +325,7 @@ class Watchdog:
         self._check_meters(now, fired)
         self._check_devicemem(now, fired)
         self._check_resident(now, fired)
+        self._check_overload(now, fired)
         if self._last_sweep is None or force \
                 or now - self._last_sweep >= self.CLOUD_SWEEP:
             self._last_sweep = now
@@ -318,6 +343,8 @@ class Watchdog:
         self._drift = {k: v + shift for k, v in self._drift.items()}
         self._devmem = {k: v + shift for k, v in self._devmem.items()}
         self._resident = {k: v + shift for k, v in self._resident.items()}
+        self._overload = {k: (t + shift, d)
+                          for k, (t, d) in self._overload.items()}
         if self._audit_pending is not None:
             ps, seen = self._audit_pending
             self._audit_pending = (ps, seen + shift)
@@ -653,6 +680,48 @@ class Watchdog:
                 kstr = "/".join(str(t) for t in key)
                 self._clear("resident_staleness", f"view/{kstr}")
 
+    def _check_overload(self, now: float, fired: List[Finding]) -> None:
+        """An open-loop tenant's waiting-pod depth above the admission
+        budget and still not shrinking (or its oldest parked arrival
+        still aging) past the overload grace — admission control should
+        have engaged. Aged on the watchdog's observation clock so a
+        chaos ClockJump cannot turn one slow window into a finding."""
+        lg = self.loadgen
+        if lg is None:
+            return
+        state = lg.overload_state() or {}
+        over: set = set()
+        for tenant, row in state.items():
+            depth = int(row.get("depth", 0))
+            budget = int(row.get("budget", 0) or 0)
+            if budget <= 0 or depth <= budget:
+                continue
+            over.add(tenant)
+            first = self._overload.get(tenant)
+            if first is None:
+                self._overload[tenant] = (now, depth)
+                continue
+            t0, d0 = first
+            age = now - t0
+            if age < self.overload_grace:
+                continue
+            oldest = float(row.get("oldest_age_s", 0.0))
+            if depth >= d0 or oldest >= self.overload_grace:
+                self._fire(fired, "overload_unbounded", "critical", tenant,
+                           f"tenant {tenant} waiting-pod depth {depth} "
+                           f"above the admission budget {budget} and not "
+                           f"shrinking for {age:.0f}s (grace "
+                           f"{self.overload_grace:g}s; shedding "
+                           f"{'armed' if row.get('armed') else 'DISABLED'})",
+                           now, tenant=tenant, depth=depth, budget=budget,
+                           age_s=round(age, 1),
+                           oldest_age_s=round(oldest, 1),
+                           armed=bool(row.get("armed")))
+        for tenant in list(self._overload):
+            if tenant not in over:   # backlog back under budget: re-arm
+                self._overload.pop(tenant, None)
+                self._clear("overload_unbounded", tenant)
+
     # --- firing / clearing ------------------------------------------------
     def _fire(self, fired: List[Finding], invariant: str, severity: str,
               key: str, message: str, now: float, **attrs) -> None:
@@ -764,7 +833,8 @@ class Watchdog:
                            "backlog_max": self.backlog_max,
                            "pipeline_s": self.pipeline_grace,
                            "devicemem_s": self.DEVICEMEM_GRACE,
-                           "resident_s": self.RESIDENT_GRACE},
+                           "resident_s": self.RESIDENT_GRACE,
+                           "overload_s": self.overload_grace},
                 "stats": dict(self.stats),
                 "fired": dict(self._fired),
                 "watchlist": {"claims": len(self._claims),

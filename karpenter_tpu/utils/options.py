@@ -79,6 +79,26 @@ class Options:
     # work for batch k. Results, hashes, and fault fingerprints are
     # identical either way; only read in fleet mode
     fleet_batch: bool = False
+    # long-soak serving mode (loadgen/, docs/loadgen.md): --soak drives
+    # a tenant fleet OPEN-LOOP — seeded arrival processes fire on the
+    # sim clock without waiting for drain, admission control sheds or
+    # defers load past saturation, and the run is judged by the SLO
+    # burn rates + the watchdog's overload_unbounded invariant
+    soak: bool = False
+    # soak scenario from loadgen.SOAK_SCENARIOS (soak_smoke |
+    # soak_overload | soak_diurnal); only read with --soak
+    soak_scenario: str = "soak_smoke"
+    # arrival-rate override in batches/sec per tenant (0 = the
+    # scenario's default); only read with --soak
+    arrival_rate: float = 0.0
+    # open-loop drive window in sim seconds (0 = scenario default; a
+    # shorter value never truncates scheduled arrivals — the window
+    # only ever extends); only read with --soak
+    soak_duration: float = 0.0
+    # disarm the admission controller's shed/defer verdicts (the
+    # negative harness — overload then degrades unboundedly and the
+    # watchdog must page); only read with --soak
+    soak_no_admission: bool = False
     # feature gates (reference Makefile:21-24 + settings.md)
     feature_gates: Dict[str, bool] = field(default_factory=lambda: {
         "SpotToSpotConsolidation": True,
@@ -107,7 +127,11 @@ class Options:
             flag = "--" + f.name.replace("_", "-")
             default = getattr(defaults, f.name)
             if f.type in ("bool", bool):
-                parser.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                # bare `--soak` arms the flag; `--soak false` still
+                # disarms (there are no positionals, so nargs="?" is
+                # unambiguous)
+                parser.add_argument(flag, nargs="?", const=True,
+                                    type=lambda s: s.lower() in ("1", "true", "yes"),
                                     default=None)
             elif f.type in ("float", float):
                 parser.add_argument(flag, type=float, default=None)

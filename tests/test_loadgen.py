@@ -1,0 +1,383 @@
+"""The open-loop traffic plane (loadgen/): trace-driven load
+generation, admission control/backpressure, and the long-soak serving
+mode.
+
+Contracts under test:
+
+- **Plan determinism** — one seed, one schedule: materialization,
+  weather expansion, and the fingerprint all repeat byte-for-byte.
+- **Admission verdicts** — admit below the budgets, defer past the soft
+  budget with seed-deterministic backoff, shed past the hard budget or
+  the defer allowance; `loadgen_shed_total{tenant,reason}` metered.
+- **The tier-1 soak_smoke member** — below saturation the controller
+  must stay silent (shed==0), the fleet drains, and the three repeat
+  digests (end-state hash, fault fingerprint, load fingerprint) agree
+  across `--repeat 2`.
+- **Past saturation (soak_overload)** — shedding bounds the waiting
+  depth at the budget, the admission_availability SLO burns, the
+  watchdog fires ZERO overload_unbounded findings with shedding armed
+  and fires with it disabled, and the shed/defer set repeats exactly —
+  including with the weather FaultPlan armed.
+- **Chaos parity** — a soak run in the process must not perturb the
+  chaos smoke scenario's two-digest contract (loadgen on/off parity).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.fleet.service import (AdmissionController,
+                                         SolverService)
+from karpenter_tpu.loadgen import (BurstyArrivals, DiurnalArrivals,
+                                   LoadPlan, OpenLoopSource,
+                                   PoissonArrivals, SoakRunner,
+                                   SpotWeather, TraceReplay, IceWeather,
+                                   load_trace, save_trace)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight_ring():
+    """Soak runs land slo.burn / watchdog.finding markers in the
+    process-global flight-recorder ring; give every test its own ring
+    so a soak's slow markers cannot evict another suite's evidence
+    (the ring prefers slower residents)."""
+    from karpenter_tpu.obs.tracer import TRACER, FlightRecorder
+    old = TRACER.recorder
+    TRACER.recorder = FlightRecorder(size=old.size)
+    yield
+    TRACER.recorder = old
+
+
+class TestLoadPlan:
+    RULES = [PoissonArrivals(rate=2.0, t0=0.0, t1=20.0),
+             DiurnalArrivals(rate=1.0, amplitude=0.5, period=30.0,
+                             t0=0.0, t1=30.0),
+             BurstyArrivals(every=8.0, burst=3, t0=0.0, t1=25.0)]
+
+    def test_same_seed_same_schedule_and_fingerprint(self):
+        a = LoadPlan(seed=7, rules=self.RULES).materialize()
+        b = LoadPlan(seed=7, rules=self.RULES).materialize()
+        assert a.schedule == b.schedule
+        assert a.schedule  # nonempty: the processes actually generate
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_different_seed_different_schedule(self):
+        a = LoadPlan(seed=7, rules=self.RULES).materialize()
+        b = LoadPlan(seed=8, rules=self.RULES).materialize()
+        assert a.schedule != b.schedule
+        assert a.fingerprint() != b.fingerprint()
+
+    def test_processes_respect_windows(self):
+        plan = LoadPlan(seed=1, rules=self.RULES).materialize()
+        assert all(0.0 <= a.t < 30.0 for a in plan.schedule)
+        procs = {a.process for a in plan.schedule}
+        assert {"poisson", "diurnal", "bursty"} <= procs
+        assert plan.horizon == plan.schedule[-1].t
+        assert plan.total_pods >= len(plan.schedule)
+
+    def test_ledger_entries_change_fingerprint(self):
+        a = LoadPlan(seed=3, rules=[PoissonArrivals(rate=1.0)])
+        b = LoadPlan(seed=3, rules=[PoissonArrivals(rate=1.0)])
+        assert a.fingerprint() == b.fingerprint()
+        a.record(5.0, "shed", "a000001x3:queue_depth")
+        assert a.fingerprint() != b.fingerprint()
+        assert a.shed_defer_set() == ((5.0, "shed",
+                                       "a000001x3:queue_depth"),)
+
+    def test_trace_replay_round_trip(self, tmp_path):
+        entries = [(1.0, 2, "250m", "512Mi"), (4.5, 3, "500m", "1Gi")]
+        path = str(tmp_path / "trace.jsonl")
+        save_trace(path, entries)
+        replay = load_trace(path)
+        plan = LoadPlan(seed=0, rules=[replay]).materialize()
+        assert [(a.t, a.pods, a.cpu, a.mem) for a in plan.schedule] \
+            == entries
+        assert all(a.process == "trace" for a in plan.schedule)
+
+    def test_weather_expands_into_fault_rules(self):
+        from karpenter_tpu.faults.plan import IceWindow, InterruptionBurst
+        plan = LoadPlan(seed=5, rules=[
+            SpotWeather(t0=0.0, t1=120.0, every=40.0, duration=20.0,
+                        reclaim=2),
+            IceWeather(t0=0.0, t1=100.0, every=50.0, duration=30.0,
+                       zone="zone-a")])
+        rules = plan.weather_rules()
+        ices = [r for r in rules if isinstance(r, IceWindow)]
+        bursts = [r for r in rules if isinstance(r, InterruptionBurst)]
+        assert ices and bursts
+        assert any(r.capacity_type == "spot" for r in ices)
+        assert any(r.zone == "zone-a" for r in ices)
+        # deterministic expansion: same seed, same windows
+        again = LoadPlan(seed=5, rules=[
+            SpotWeather(t0=0.0, t1=120.0, every=40.0, duration=20.0,
+                        reclaim=2),
+            IceWeather(t0=0.0, t1=100.0, every=50.0, duration=30.0,
+                       zone="zone-a")]).weather_rules()
+        assert rules == again
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(TypeError):
+            LoadPlan(seed=0, rules=[object()]).materialize()
+
+
+class TestAdmissionController:
+    def test_admit_below_budgets(self):
+        ac = AdmissionController(defer_depth=10, shed_depth=20)
+        d = ac.decide("a", pending=2, deferred=0, arriving=3)
+        assert d.action == "admit"
+        assert ac.stats["a"]["admitted"] == 3
+
+    def test_defer_past_soft_budget_with_deterministic_backoff(self):
+        ac = AdmissionController(defer_depth=10, shed_depth=100, seed=4)
+        d1 = ac.decide("a", pending=9, deferred=0, arriving=3, key="k1")
+        assert d1.action == "defer" and d1.delay > 0
+        # same (seed, key, attempt) -> same delay; next attempt longer
+        ac2 = AdmissionController(defer_depth=10, shed_depth=100, seed=4)
+        assert ac2.decide("a", 9, 0, 3, key="k1").delay == d1.delay
+        d2 = ac.decide("a", pending=9, deferred=0, arriving=3,
+                       attempts=1, key="k1")
+        assert d2.delay > d1.delay * 0.74  # exponential floor w/ jitter
+        # a different seed jitters differently
+        ac3 = AdmissionController(defer_depth=10, shed_depth=100, seed=5)
+        assert ac3.decide("a", 9, 0, 3, key="k1").delay != d1.delay
+        # batch keys are PLAN-local (every tenant's schedule starts at
+        # a000000): two tenants deferring the same key at the same
+        # attempt must NOT re-offer in lockstep
+        db = ac.decide("b", pending=9, deferred=0, arriving=3, key="k1")
+        assert db.delay != d1.delay
+
+    def test_deferred_backlog_does_not_block_reoffers(self):
+        """The soft budget reads PENDING depth only: a drained cluster
+        admits a re-offer no matter how much is still parked (the
+        waiting room must not wedge itself shut)."""
+        ac = AdmissionController(defer_depth=10, shed_depth=100)
+        d = ac.decide("a", pending=0, deferred=50, arriving=3,
+                      attempts=1, key="k1")
+        assert d.action == "admit"
+
+    def test_shed_past_hard_budget_and_defer_allowance(self):
+        from karpenter_tpu.metrics import LOADGEN_SHED
+        ac = AdmissionController(defer_depth=10, shed_depth=20,
+                                 max_defers=2)
+        before_q = LOADGEN_SHED.value(tenant="a", reason="queue_depth")
+        before_d = LOADGEN_SHED.value(tenant="a", reason="defer_budget")
+        # the hard bound is total work-in-system: pending + deferred
+        d = ac.decide("a", pending=9, deferred=10, arriving=3)
+        assert (d.action, d.reason) == ("shed", "queue_depth")
+        assert LOADGEN_SHED.value(tenant="a",
+                                  reason="queue_depth") == before_q + 3
+        d = ac.decide("a", pending=11, deferred=0, arriving=2,
+                      attempts=2)
+        assert (d.action, d.reason) == ("shed", "defer_budget")
+        assert LOADGEN_SHED.value(tenant="a",
+                                  reason="defer_budget") == before_d + 2
+
+    def test_disabled_admits_everything(self):
+        ac = AdmissionController(defer_depth=1, shed_depth=2,
+                                 enabled=False)
+        assert ac.decide("a", pending=999, deferred=0,
+                         arriving=50).action == "admit"
+
+    def test_inflight_budget_defers_on_service_queue(self):
+        svc = SolverService(FakeClock(), backend="host")
+        ac = AdmissionController(service=svc, defer_depth=100,
+                                 shed_depth=200, inflight_budget=2)
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        svc.register("a", CatalogProvider(lambda: small_catalog()))
+        for _ in range(3):
+            svc.submit("a", "solve", lambda: 1, cost=0.001)
+        d = ac.decide("a", pending=0, deferred=0, arriving=2)
+        assert (d.action, d.reason) == ("defer", "inflight")
+        svc.pump()
+        assert ac.decide("a", pending=0, deferred=0,
+                         arriving=2).action == "admit"
+
+
+class TestQueueDepthGauge:
+    def test_fleet_queue_depth_exported(self):
+        from karpenter_tpu.metrics import FLEET_QUEUE_DEPTH
+        svc = SolverService(FakeClock(), backend="host")
+        from karpenter_tpu.catalog import small_catalog
+        from karpenter_tpu.catalog.provider import CatalogProvider
+        svc.register("a", CatalogProvider(lambda: small_catalog()))
+        for _ in range(3):
+            svc.submit("a", "solve", lambda: 1, cost=0.001)
+        assert FLEET_QUEUE_DEPTH.value(tenant="a") == 3.0
+        assert svc.snapshot()["a"]["queued"] == 3
+        svc.pump()
+        assert FLEET_QUEUE_DEPTH.value(tenant="a") == 0.0
+        assert svc.snapshot()["a"]["queued"] == 0
+
+
+class TestOpenLoopSource:
+    def _sim_source(self, rules, **ac_kw):
+        from karpenter_tpu.fleet.tenant import build_shard
+        clock = FakeClock()
+        svc = SolverService(clock, backend="host")
+        ac = AdmissionController(service=svc, **ac_kw)
+        shard = build_shard("t000", clock, svc)
+        plan = LoadPlan(seed=11, rules=rules)
+        src = OpenLoopSource(plan, shard.sim, "t000", ac)
+        return clock, shard, src
+
+    def test_arrivals_become_pods_without_waiting_for_drain(self):
+        clock, shard, src = self._sim_source(
+            [BurstyArrivals(every=5.0, burst=2, t0=0.0, t1=18.0,
+                            pods_min=2, pods_max=2)],
+            defer_depth=100, shed_depth=200)
+        end = clock.now() + 25.0
+        while clock.now() < end:
+            shard.tick()
+            clock.step(0.5)
+        assert src.stats["offered_pods"] > 0
+        assert src.stats["admitted_pods"] == src.stats["offered_pods"]
+        assert src.drained()
+        # ledger carries arrive+admit entries, fingerprint is stable
+        kinds = {k for _, k, _ in src.plan.timeline}
+        assert kinds == {"arrive", "admit"}
+
+    def test_defer_parks_and_reoffers(self):
+        clock, shard, src = self._sim_source(
+            [BurstyArrivals(every=4.0, burst=6, t0=0.0, t1=10.0,
+                            pods_min=3, pods_max=3)],
+            defer_depth=6, shed_depth=500, max_defers=50)
+        end = clock.now() + 120.0
+        while clock.now() < end and not src.drained():
+            shard.tick()
+            clock.step(0.5)
+        assert src.stats["deferred_pods"] > 0
+        assert src.stats["reoffers"] > 0
+        assert src.drained()  # everything eventually re-offered in
+        assert src.stats["shed_pods"] == 0
+
+
+def _digests(rep):
+    return (rep.soak_hash, rep.fault_fingerprint, rep.load_fingerprint)
+
+
+class TestSoakSmoke:
+    """The tier-1 member: below saturation, shed must be zero."""
+
+    def test_soak_smoke_clean_below_saturation(self):
+        rep = SoakRunner("soak_smoke", seed=0).run()
+        assert rep.ok, rep.summary()
+        assert rep.converged
+        assert rep.stats["shed_pods"] == 0
+        assert rep.stats["overload_findings"] == 0
+        assert rep.stats["offered_pods"] > 0
+        assert rep.stats["admitted_pods"] == rep.stats["offered_pods"]
+
+    def test_soak_smoke_repeat_digests_identical(self):
+        a = SoakRunner("soak_smoke", seed=3).run()
+        b = SoakRunner("soak_smoke", seed=3).run()
+        assert _digests(a) == _digests(b)
+
+    def test_different_seed_different_load(self):
+        a = SoakRunner("soak_smoke", seed=0).run()
+        b = SoakRunner("soak_smoke", seed=1).run()
+        assert a.load_fingerprint != b.load_fingerprint
+
+
+class TestSoakOverload:
+    """Past saturation with the weather FaultPlan armed: bounded depth,
+    metered shedding, SLO burn, zero overload findings."""
+
+    def test_overload_bounded_and_metered(self):
+        rep = SoakRunner("soak_overload", seed=0).run()
+        assert rep.ok, rep.summary()
+        st = rep.stats
+        assert st["shed_pods"] > 0                    # past saturation
+        budget = 60                                   # scenario shed_depth
+        assert st["max_waiting_depth"] <= budget + 8  # bounded
+        assert st["overload_findings"] == 0           # budgets held
+        assert st["admission_burn_alerts"] >= 1       # the page fired
+        # weather actually flew: the fault fingerprints are armed+nonempty
+        assert any(fp for fp in rep.tenant_fault_fingerprints.values())
+        from karpenter_tpu.metrics import LOADGEN_SHED
+        assert LOADGEN_SHED.value(tenant="t000", reason="queue_depth") > 0
+
+    def test_overload_repeat_contract_with_faultplan_armed(self):
+        """Same seed => identical arrival timeline fingerprint AND
+        identical shed/defer set, with the weather FaultPlan armed."""
+        ra = SoakRunner("soak_overload", seed=5)
+        rb = SoakRunner("soak_overload", seed=5)
+        a, b = ra.run(), rb.run()
+        assert _digests(a) == _digests(b)
+        for t in ra.sources:
+            assert ra.sources[t].plan.shed_defer_set() \
+                == rb.sources[t].plan.shed_defer_set()
+            assert ra.sources[t].plan.timeline \
+                == rb.sources[t].plan.timeline
+
+    def test_shedding_disabled_trips_watchdog(self):
+        """The acceptance's negative half: with admission disarmed the
+        backlog grows unboundedly and overload_unbounded fires."""
+        rep = SoakRunner("soak_overload", seed=0, admission=False).run()
+        assert rep.stats["shed_pods"] == 0
+        assert rep.stats["overload_findings"] >= 1
+        assert rep.stats["max_waiting_depth"] > 60  # past the budget
+
+
+class TestChaosParity:
+    def test_chaos_smoke_unperturbed_by_a_soak_run(self):
+        """Loadgen on/off parity: the chaos smoke scenario's two-digest
+        contract must hold identically before and after a soak run in
+        the same process (no cross-contamination through the shared
+        registries/recorders)."""
+        from karpenter_tpu.faults.runner import ScenarioRunner
+        before = ScenarioRunner("smoke", seed=2).run()
+        SoakRunner("soak_smoke", seed=2).run()
+        after = ScenarioRunner("smoke", seed=2).run()
+        assert before.ok and after.ok
+        assert before.end_hash == after.end_hash
+        assert before.fault_fingerprint == after.fault_fingerprint
+
+
+class TestCli:
+    def test_loadgen_cli_lists_and_runs(self, capsys):
+        from karpenter_tpu.loadgen.__main__ import main
+        assert main([]) == 0
+        out = capsys.readouterr().out
+        assert "soak_smoke" in out and "soak_overload" in out
+        assert main(["soak_smoke", "--repeat", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "reproducible: 2 runs identical" in out
+
+    def test_main_soak_flags_parse(self):
+        from karpenter_tpu.utils.options import Options
+        opts = Options.parse(["--soak", "--arrival-rate", "0.7",
+                              "--soak-duration", "40",
+                              "--soak-scenario", "soak_overload",
+                              "--soak-no-admission"])
+        assert opts.soak is True
+        assert opts.arrival_rate == 0.7
+        assert opts.soak_duration == 40.0
+        assert opts.soak_scenario == "soak_overload"
+        assert opts.soak_no_admission is True
+        # bare-bool parsing stays backward compatible with valued form
+        opts2 = Options.parse(["--soak", "false"])
+        assert opts2.soak is False
+
+    def test_run_soak_wiring(self, capsys):
+        from karpenter_tpu.main import run_soak
+        from karpenter_tpu.utils.options import Options
+        opts = Options.parse(["--soak", "--soak-duration", "32"])
+        assert run_soak(opts) == 0
+        assert "soak=soak_smoke" in capsys.readouterr().out
+
+
+class TestPerfGateClassification:
+    def test_c13_keys(self):
+        from karpenter_tpu.obs.perfarchive import metric_direction
+        assert metric_direction("c13_arrivals_per_sec") == "higher"
+        assert metric_direction("c13_admitted_arrivals_per_sec") \
+            == "higher"
+        assert metric_direction("soak_arrivals_per_sec") == "higher"
+        # shed fraction is a workload property: informational, never
+        # gated in either direction
+        assert metric_direction("c13_shed_frac") is None
+        assert metric_direction("soak_shed_frac") is None
+        assert metric_direction("c13_soak_wall_ms") == "lower"
+        assert metric_direction("c13_max_waiting_depth") is None

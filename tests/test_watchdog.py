@@ -62,7 +62,7 @@ class TestArming:
             "warm_audit_lag", "warm_divergence", "fleet_starvation",
             "pipeline_stall", "profile_unattributed",
             "trace_ring_overflow", "devicemem_leak",
-            "resident_staleness")
+            "resident_staleness", "overload_unbounded")
 
 
 class TestTrips:
@@ -256,6 +256,83 @@ class TestTrips:
         wd2 = Watchdog(svc2.clock, service=svc2).arm()
         wd2.tick(force=True)
         assert not _findings(wd2, "pipeline_stall")
+
+    def test_trip_overload_unbounded(self):
+        """Seeded overload with shedding DISABLED: the open-loop backlog
+        grows past the admission budget and never shrinks — the monitor
+        must fire after the grace; the armed-side scenario run (bounded
+        depth) is the zero-findings assert in tests/test_loadgen.py."""
+
+        class _FakeLoadgen:
+            def __init__(self):
+                self.depth = 0
+
+            def overload_state(self):
+                return {"t000": {"depth": self.depth, "oldest_age_s": 0.0,
+                                 "budget": 60, "armed": False}}
+
+        lg = _FakeLoadgen()
+        clock = FakeClock()
+        wd = Watchdog(clock, loadgen=lg, overload_grace=45.0).arm()
+        # under budget: no excursion opens
+        lg.depth = 40
+        wd.tick(force=True)
+        assert not _findings(wd, "overload_unbounded")
+        # over budget but inside the grace: still quiet
+        lg.depth = 80
+        wd.tick(force=True)
+        clock.step(20.0)
+        lg.depth = 100
+        wd.tick(force=True)
+        assert not _findings(wd, "overload_unbounded")
+        # still growing past the grace: critical finding, once
+        clock.step(30.0)
+        lg.depth = 140
+        wd.tick(force=True)
+        found = _findings(wd, "overload_unbounded")
+        assert found and found[0].severity == "critical"
+        assert found[0].key == "t000"
+        assert "DISABLED" in found[0].message
+        assert wd.verdict() == "critical"
+        clock.step(10.0)
+        lg.depth = 160
+        wd.tick(force=True)
+        assert len(_findings(wd, "overload_unbounded")) == 1  # edge
+        # the backlog draining back under budget clears the excursion
+        lg.depth = 10
+        wd.tick(force=True)
+        assert wd.verdict() == "ok"
+        # a SHRINKING over-budget backlog (admission catching up) does
+        # not fire: growth is the unbounded signal, not the excursion
+        wd2 = Watchdog(FakeClock(), loadgen=lg, overload_grace=45.0).arm()
+        lg.depth = 200
+        wd2.tick(force=True)
+        wd2.clock.step(60.0)
+        lg.depth = 120
+        wd2.tick(force=True)
+        assert not _findings(wd2, "overload_unbounded")
+
+    def test_overload_jump_absorbed(self):
+        """A clock jump over an in-grace excursion must not age it into
+        a finding (the zero-false-positive contract)."""
+
+        class _FakeLoadgen:
+            depth = 100
+
+            def overload_state(self):
+                return {"t000": {"depth": self.depth, "oldest_age_s": 0.0,
+                                 "budget": 60, "armed": True}}
+
+        lg = _FakeLoadgen()
+        clock = FakeClock()
+        wd = Watchdog(clock, loadgen=lg, overload_grace=45.0,
+                      interval=5.0).arm()
+        wd.tick(force=True)          # excursion opens at t0
+        clock.step(300.0)            # one giant step = a jump, absorbed
+        lg.depth = 110
+        wd.tick(force=True)
+        assert wd.stats["jump_absorbed"] >= 1
+        assert not _findings(wd, "overload_unbounded")
 
     def test_trip_profile_unattributed(self):
         from karpenter_tpu.obs.profile import LEDGER
