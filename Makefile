@@ -6,7 +6,8 @@ PY ?= python
 .PHONY: help test e2etests scaletests benchmark docgen verify-docs \
         deflake run native trace-report profile-report obs-audit chaos \
         crash-audit warmpath-audit encode-report fleet fleet-audit \
-        perf-gate device-report resident-report soak soak-audit clean
+        perf-gate device-report resident-report soak soak-audit \
+        disrupt-report clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -64,6 +65,9 @@ fleet:  ## drive TENANTS (default 50) tenant control planes through one process 
 fleet-audit:  ## fleet reproducibility: fleet_smoke at 2 seeds x --repeat 2, identical per-tenant end-state hashes required (batched dispatch must repeat too)
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --seeds 2 --repeat 2
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --seeds 1 --repeat 2 --batch
+
+disrupt-report:  ## global disruption optimizer vs greedy: savings found, verify hit-rate, subset funnel (FLEET=squeeze|joint TILES=n)
+	$(PY) tools/disrupt_report.py --fleet $(or $(FLEET),squeeze) --tiles $(or $(TILES),2)
 
 soak:  ## open-loop long-soak serving mode (loadgen/): drive the fleet past saturation, shedding bounds the backlog (TENANTS overrides shard count)
 	$(PY) -m karpenter_tpu.loadgen soak_overload $(if $(TENANTS),--tenants $(TENANTS))

@@ -66,6 +66,16 @@ class DisruptionController:
     requeue: float = 5.0
     spot_to_spot: bool = True  # SpotToSpotConsolidation feature gate
     _pending: List[PendingDisruption] = field(default_factory=list)
+    # memoized consolidation-screen state per pool: (fingerprint,
+    # (enc, counts, ok_names, slack)) — re-screening every reconcile
+    # when nothing changed was pure waste (see _screen_state)
+    _screen_cache: Dict[str, tuple] = field(default_factory=dict)
+    # pool -> the (screen fingerprint, pending/deleting set, budget) a
+    # subset search last proved FRUITLESS on: identical state skips the
+    # search AND its exact verifies until something changes (a steady
+    # cluster must not re-pay up to VERIFY_LIMIT solves per reconcile,
+    # nor grow a fake divergence streak on unchanged state)
+    _optimizer_noop: Dict[str, tuple] = field(default_factory=dict)
     stats: Dict[str, int] = field(default_factory=lambda: {
         "empty": 0, "drift": 0, "expired": 0, "consolidated": 0,
         "multi_consolidated": 0})
@@ -436,19 +446,51 @@ class DisruptionController:
             self.stats["consolidated"] += 1
             done += 1
 
-    def _screen_order(self, pool: NodePool, candidates: List[NodeView],
-                      cat, views: List[NodeView]) -> List[NodeView]:
-        """Batched TPU screen over ALL candidates (one kernel call against
-        the WHOLE cluster's headroom), then order: screened-feasible by
-        descending price (biggest savings first), then the rest (feasible
-        only with replacements) by price."""
+    def _screen_fingerprint(self, pool: NodePool, cat,
+                            views: List[NodeView]) -> str:
+        """Content key for the memoized screen state: pool identity
+        (hash + requirements/taints — NodePool.hash() deliberately
+        excludes requirements), the DERIVED catalog view token (carries
+        nodeclass hash, catalog epoch, block gating, and the daemonset
+        overhead digest), and a per-view occupancy digest (claim name,
+        committed type, resource cum, resident pod set). Any change a
+        re-screen could observe moves the fingerprint."""
+        import hashlib
+
+        from ..ops.encode_cache import (labels_token, requirements_token,
+                                        taints_token)
+        h = hashlib.blake2b(digest_size=16)
+        h.update(self._memo_hash(pool).encode())
+        h.update(repr(requirements_token(pool.requirements)).encode())
+        h.update(repr(taints_token(pool.taints
+                                   + pool.startup_taints)).encode())
+        h.update(repr(labels_token(pool.template_labels())).encode())
+        tok = getattr(cat, "cache_token", None)
+        h.update(repr(tok).encode() if tok is not None
+                 else repr((id(cat), tuple(self.catalog.epoch))).encode())
+        for v in views:
+            h.update(v.name.encode())
+            h.update(np.int64(v.virtual.type_idx).tobytes())
+            h.update(v.virtual.cum.tobytes())
+            for p in v.pods:
+                h.update(f"|{p.namespace}/{p.name}".encode())
+        return h.hexdigest()
+
+    def _screen_state(self, pool: NodePool, cat,
+                      views: List[NodeView]):
+        """(enc, counts, ok_names, slack) for this pool pass, or None
+        (no pods / no groups / screen fault). MEMOIZED on
+        (pool fingerprint, catalog view token, occupancy digest): a
+        steady cluster reconciling every few seconds re-screened the
+        same state over and over — now only a store/catalog/occupancy
+        change pays the encode + kernel call again."""
         import numpy as np
 
         from ..ops.consolidate import consolidation_screen
         from ..ops.encode import encode_pods
         all_pods = [p for v in views for p in v.pods]
         if not all_pods:
-            return candidates
+            return None
         # the screen judges other nodes' headroom — charge daemonset
         # overhead to their allocatable exactly like the solve does
         # (shared transform), or the screen over-admits candidates the
@@ -457,12 +499,18 @@ class DisruptionController:
         template = pool.template_labels()
         cat = apply_daemonset_overhead(
             cat, list(self.store.daemonsets.values()), pool, template)
+        fp = self._screen_fingerprint(pool, cat, views)
+        hit = self._screen_cache.get(pool.name)
+        if hit is not None and hit[0] == fp:
+            self.stats["screen_cache_hits"] = (
+                self.stats.get("screen_cache_hits", 0) + 1)
+            return hit[1]
         enc = encode_pods(all_pods, cat,
                           extra_requirements=pool.requirements,
                           taints=pool.taints + pool.startup_taints,
                           template_labels=template)
         if enc.G == 0:
-            return candidates
+            return None
         sig_to_g = {g.representative.constraint_signature(): i
                     for i, g in enumerate(enc.groups)}
         counts = np.zeros((len(views), enc.G), np.int32)
@@ -472,24 +520,40 @@ class DisruptionController:
                 if gi is not None:
                     counts[i, gi] += 1
         sp = (TRACER.span("disruption.screen", nodes=len(views),
-                          candidates=len(candidates))
+                          candidates=len(views))
               if TRACER.enabled else NOOP_SPAN)
         try:
             with sp:
-                screen, _slack = consolidation_screen(
+                screen, slack = consolidation_screen(
                     cat, enc, views, counts,
                     mesh=self.solver.screen_mesh(len(views)))
-        except Exception as e:  # noqa: BLE001 — screen is best-effort:
+        except Exception:  # noqa: BLE001 — screen is best-effort:
             # a device fault here degrades to plain cost order; meter it
             # like the facade's solve fallback so the event is scrapeable
-            # (the span already carries outcome=error from its exit)
+            # (the span already carries outcome=error from its exit).
+            # NEVER cached: the next pass re-probes the device.
             from ..metrics import SOLVER_FALLBACKS
             SOLVER_FALLBACKS.inc(from_backend="screen",
                                  to_backend="cost-order")
             self.stats["screen_errors"] = (
                 self.stats.get("screen_errors", 0) + 1)
+            return None
+        ok = frozenset(v.name for i, v in enumerate(views) if screen[i])
+        state = (cat, enc, counts, ok, slack)
+        self._screen_cache[pool.name] = (fp, state)
+        return state
+
+    def _screen_order(self, pool: NodePool, candidates: List[NodeView],
+                      cat, views: List[NodeView]) -> List[NodeView]:
+        """Batched TPU screen over ALL candidates (one kernel call against
+        the WHOLE cluster's headroom, memoized across unchanged
+        reconciles), then order: screened-feasible by descending price
+        (biggest savings first), then the rest (feasible only with
+        replacements) by price."""
+        state = self._screen_state(pool, cat, views)
+        if state is None:
             return candidates
-        ok = {v.name for i, v in enumerate(views) if screen[i]}
+        _cat, _enc, _counts, ok, _slack = state
         first = [v for v in candidates if v.name in ok]
         rest = [v for v in candidates if v.name not in ok]
         first.sort(key=lambda v: -v.price)
@@ -499,6 +563,130 @@ class DisruptionController:
 
     def _multi_node(self, pool: NodePool, candidates: List[NodeView],
                     now: float, cat, views: List[NodeView]) -> bool:
+        """Multi-node consolidation. With the global optimizer armed
+        (KARPENTER_TPU_OPTIMIZER, default on) a combinatorial subset
+        search over the candidates runs FIRST — savings that require
+        joint eviction of a non-prefix subset are invisible to the
+        greedy prefix search below. The optimizer only ever EXECUTES a
+        subset that passed a real `Solver.solve()` verification under
+        the same budget/PDB gates; when it proposes nothing provable,
+        the greedy path runs unchanged, and with the flag off this
+        method IS the greedy path byte-for-byte."""
+        from ..optimizer import optimizer_enabled
+        if optimizer_enabled():
+            if self._multi_node_optimizer(pool, candidates, now, cat,
+                                          views):
+                return True
+        return self._multi_node_greedy(pool, candidates, now, cat, views)
+
+    def _multi_node_optimizer(self, pool: NodePool,
+                              candidates: List[NodeView], now: float,
+                              cat, views: List[NodeView]) -> bool:
+        """Sharded combinatorial repack search (karpenter_tpu/optimizer):
+        subset generation → one batched tournament + convex-relaxation
+        dispatch → exact verification of the ranked winners. Best-effort:
+        any fault degrades to the greedy path and meters the fallback."""
+        budget = self._budget(pool, views, "Underutilized", now)
+        if budget < 2 or len(candidates) < 2:
+            return False
+        from ..metrics import OPTIMIZER_SUBSETS, SOLVER_FALLBACKS
+        from ..optimizer import (MAX_K, VERIFY_LIMIT, OPTIMIZER,
+                                 plan_repack)
+        state = self._screen_state(pool, cat, views)
+        if state is None:
+            return False
+        scat, enc, counts, _ok, slack = state
+        name_to_i = {v.name: i for i, v in enumerate(views)}
+        cand_idx = [name_to_i[v.name] for v in candidates]
+        exclude = np.array([self._is_pending_victim(v.name)
+                            or v.claim.is_deleting() for v in views])
+        # fruitless-search memo: same screen fingerprint + same
+        # exclusions + same budget ⇒ the ranked subsets and every
+        # verify verdict would repeat — skip the whole pass
+        fp = self._screen_cache.get(pool.name, (None,))[0]
+        noop_key = (fp,
+                    frozenset(v.name for v, x in zip(views, exclude)
+                              if x),
+                    min(budget, 64))
+        if self._optimizer_noop.get(pool.name) == noop_key:
+            return False
+        use_device = self.solver.backend in ("device", "mesh")
+        mesh = (self.solver.screen_mesh(len(views)) if use_device
+                else None)
+        sp = (TRACER.span("optimizer.search", candidates=len(candidates),
+                          nodes=len(views))
+              if TRACER.enabled else NOOP_SPAN)
+        try:
+            with sp:
+                plan = plan_repack(scat, enc, views, counts, slack,
+                                   cand_idx, max_k=min(budget, MAX_K),
+                                   exclude=exclude,
+                                   use_device=use_device, mesh=mesh)
+            sp.set(scored=plan.scored, feasible=plan.feasible,
+                   backend=plan.backend)
+        except Exception:  # noqa: BLE001 — the search is an optimization;
+            # a device fault here must cost one greedy pass, not a
+            # crashed reconcile (the chaos DeviceFault seam is probed
+            # inside the device dispatch)
+            SOLVER_FALLBACKS.inc(from_backend="optimizer",
+                                 to_backend="greedy")
+            OPTIMIZER.record_fallback()
+            OPTIMIZER_SUBSETS.inc(event="fallback")
+            self.stats["optimizer_errors"] = (
+                self.stats.get("optimizer_errors", 0) + 1)
+            return False
+        if not plan.subsets:
+            self._optimizer_noop[pool.name] = noop_key
+            return False
+        vsp = (TRACER.span("optimizer.verify",
+                           ranked=len(plan.subsets))
+               if TRACER.enabled else NOOP_SPAN)
+        with vsp:
+            verified = 0
+            for subset in plan.subsets:
+                if verified >= VERIFY_LIMIT:
+                    break
+                victims = [views[i] for i in subset]
+                if len(victims) > budget:
+                    continue
+                if any(self._is_pending_victim(v.name)
+                       or v.claim.is_deleting()
+                       or v.has_do_not_disrupt() for v in victims):
+                    continue
+                if self._pdb_blocked_set(victims):
+                    continue
+                verified += 1
+                total_price = sum(v.price for v in victims)
+                # the exact-verify contract: the optimizer proposes,
+                # Solver.solve() disposes — nothing executes on the
+                # relaxation's word alone
+                out, ok = self._simulate_removal(pool, victims, cat,
+                                                 views, total_price)
+                if ok and out.launches and not all(
+                        self._spot_floor_ok(v, out, cat)
+                        for v in victims):
+                    ok = False
+                OPTIMIZER.record_verify(bool(ok))
+                OPTIMIZER_SUBSETS.inc(
+                    event="verify_pass" if ok else "verify_reject")
+                if not ok:
+                    continue
+                self._execute(pool, victims, out, "Underutilized", now,
+                              source="optimizer")
+                self._pdb_commit(victims)
+                self.stats["multi_consolidated"] += 1
+                self.stats["optimizer_consolidated"] = (
+                    self.stats.get("optimizer_consolidated", 0) + 1)
+                self._optimizer_noop.pop(pool.name, None)
+                vsp.set(verified=verified, accepted=len(subset))
+                return True
+            vsp.set(verified=verified, accepted=0)
+        self._optimizer_noop[pool.name] = noop_key
+        return False
+
+    def _multi_node_greedy(self, pool: NodePool,
+                           candidates: List[NodeView], now: float,
+                           cat, views: List[NodeView]) -> bool:
         """Binary-search the largest prefix of cost-ordered candidates whose
         pods re-solve onto the rest + at most one cheaper replacement
         (reference multi-node consolidation, disruption.md:96-103)."""
@@ -596,7 +784,7 @@ class DisruptionController:
         self.stats[stat if stat in self.stats else "drift"] += 1
 
     def _execute(self, pool: NodePool, victims: List[NodeView], out,
-                 reason: str, now: float) -> None:
+                 reason: str, now: float, source: str = "greedy") -> None:
         node_class = self.store.nodeclasses.get(pool.node_class)
         launched, failed = self.provisioner._launch(pool, node_class,
                                                     out.launches, now)
@@ -607,6 +795,15 @@ class DisruptionController:
                 self.termination.delete_nodeclaim(claim, now, "ReplacementAborted")
             return
         repl_names = [c.name for c in launched]
+        if reason == "Underutilized":
+            # realized $/hr delta of an EXECUTED consolidation, by
+            # decision source — the optimizer-vs-greedy headline bench
+            # c14 and `make disrupt-report` read
+            savings = (sum(v.price for v in victims)
+                       - sum(l.price for l in out.launches))
+            if savings > 0:
+                from ..metrics import CONSOLIDATION_SAVINGS
+                CONSOLIDATION_SAVINGS.inc(savings, source=source)
         if not out.launches:
             # no replacement needed: drain immediately
             for v in victims:

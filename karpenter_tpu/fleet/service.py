@@ -225,6 +225,15 @@ class AdmissionController:
     carrying the budgets — the watchdog reads them as the threshold the
     controller SHOULD have engaged at (the fires-with-shedding-disabled
     acceptance check).
+
+    `rate_limit` (pods per sim second, per tenant) adds a RATE budget on
+    top of the depth budgets: a token bucket refilled by sim time (burst
+    capacity `rate_burst`, default 2x the rate) charged by first offers
+    only — a tenant arriving faster than its configured rate sheds the
+    excess with reason `rate` even while its queue is empty (depths
+    bound work-in-system; rates bound work-per-second). Deterministic
+    like everything else here: the bucket advances on the caller's sim
+    clock, no RNG, so the repeat contract covers the shed set.
     """
 
     DEFER_DEPTH = 192         # waiting pods before soft backpressure
@@ -241,8 +250,22 @@ class AdmissionController:
                  max_defers: Optional[int] = None,
                  backoff_base: Optional[float] = None,
                  backoff_max: Optional[float] = None,
+                 rate_limit: Optional[float] = None,
+                 rate_burst: Optional[float] = None,
                  enabled: bool = True, seed: int = 0):
         self.service = service
+        # per-tenant arrival-rate budget (None = no rate limiting):
+        # tenant -> (tokens, last sim stamp). is-None checks throughout:
+        # rate_limit=0.0 is a legitimate "admit nothing" budget, not an
+        # unset one
+        self.rate_limit = None if rate_limit is None else float(rate_limit)
+        if rate_burst is not None:
+            self.rate_burst: Optional[float] = float(rate_burst)
+        elif self.rate_limit is not None:
+            self.rate_burst = 2.0 * self.rate_limit
+        else:
+            self.rate_burst = None
+        self._rate_buckets: Dict[str, Tuple[float, float]] = {}
         self.defer_depth = (self.DEFER_DEPTH if defer_depth is None
                             else int(defer_depth))
         self.shed_depth = (self.SHED_DEPTH if shed_depth is None
@@ -277,15 +300,36 @@ class AdmissionController:
             .digest()[:4], "big")
         return round(base * (0.75 + 0.5 * h / 0xFFFFFFFF), 6)
 
+    def _rate_exhausted(self, tenant: str, arriving: int,
+                        now: Optional[float]) -> bool:
+        """Advance the tenant's token bucket to `now` and try to charge
+        `arriving` tokens; True = the rate budget is exhausted (shed).
+        Only first offers are charged — a deferred batch paid on its
+        original arrival."""
+        if self.rate_limit is None or now is None:
+            return False
+        tokens, last = self._rate_buckets.get(
+            tenant, (self.rate_burst, None))
+        if last is not None:
+            tokens = min(self.rate_burst,
+                         tokens + (float(now) - last) * self.rate_limit)
+        if arriving > tokens:
+            self._rate_buckets[tenant] = (tokens, float(now))
+            return True
+        self._rate_buckets[tenant] = (tokens - arriving, float(now))
+        return False
+
     def decide(self, tenant: str, pending: int, deferred: int,
                arriving: int, attempts: int = 0,
-               key: str = "") -> AdmissionDecision:
+               key: str = "",
+               now: Optional[float] = None) -> AdmissionDecision:
         """Verdict for one offered batch of `arriving` pods while the
         tenant has `pending` unplaced pods in its store and `deferred`
         pods parked in the generator's waiting room (EXCLUDING this
         batch when it is a re-offer). Meters the defer/shed families;
         the caller records the canonical ledger entry (the fingerprint
-        lives with the LoadPlan)."""
+        lives with the LoadPlan). `now` (sim time) feeds the optional
+        per-tenant arrival-rate budget."""
         st = self._tstats(tenant)
         if attempts == 0:
             st["offered"] += arriving
@@ -293,6 +337,10 @@ class AdmissionController:
             st["admitted"] += arriving
             LOADGEN_ADMITTED.inc(arriving, tenant=tenant)
             return AdmissionDecision("admit")
+        if attempts == 0 and self._rate_exhausted(tenant, arriving, now):
+            st["shed"] += arriving
+            LOADGEN_SHED.inc(arriving, tenant=tenant, reason="rate")
+            return AdmissionDecision("shed", "rate")
         depth = pending + deferred + arriving
         if depth > self.shed_depth:
             st["shed"] += arriving
@@ -328,6 +376,8 @@ class AdmissionController:
                 "shed_depth": self.shed_depth,
                 "inflight_budget": self.inflight_budget,
                 "max_defers": self.max_defers,
+                "rate_limit": self.rate_limit,
+                "rate_burst": self.rate_burst,
                 "tenants": {t: dict(s)
                             for t, s in sorted(self.stats.items())}}
 

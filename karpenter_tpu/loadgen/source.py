@@ -99,7 +99,8 @@ class OpenLoopSource:
         # its own verdict
         decision = self.admission.decide(
             self.tenant, len(self.sim.store.pending_pods()),
-            self.deferred_pods(), a.pods, attempts=attempts, key=a.key)
+            self.deferred_pods(), a.pods, attempts=attempts, key=a.key,
+            now=now)
         if decision.action == "admit":
             self._admit(a)
             self.stats["admitted_pods"] += a.pods

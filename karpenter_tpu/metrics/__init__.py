@@ -475,7 +475,11 @@ LOADGEN_SHED = REGISTRY.counter(
     "Offered pods the admission controller DROPPED, by reason: "
     "'queue_depth' = the tenant's waiting-pod depth (pending + deferred) "
     "already exceeded the shed budget, 'defer_budget' = the arrival "
-    "exhausted its re-offer attempts without the backlog clearing. "
+    "exhausted its re-offer attempts without the backlog clearing, "
+    "'rate' = the tenant's per-second arrival-rate token bucket was "
+    "empty (rate limits are RATE budgets, not depth budgets — a "
+    "steady trickle above the configured rate sheds even with an "
+    "empty queue). "
     "Zero below saturation (the soak_smoke assert); nonzero past it is "
     "overload degrading PREDICTABLY — unbounded queue growth instead "
     "of shedding is the watchdog's overload_unbounded invariant",
@@ -495,6 +499,30 @@ LOADGEN_BACKLOG = REGISTRY.gauge(
     "armed; growth past that with shedding disabled is exactly the "
     "overload_unbounded excursion",
     ("tenant",), label_defaults=_TENANT)
+CONSOLIDATION_SAVINGS = REGISTRY.counter(
+    "karpenter_tpu_consolidation_savings_total",
+    "Realized $/hr price delta of EXECUTED consolidation disruptions "
+    "(victims' price minus replacements' price), by decision source: "
+    "'greedy' = the reference-style screen + prefix selection, "
+    "'optimizer' = the global subset search "
+    "(karpenter_tpu/optimizer/). Only consolidations meter here — "
+    "drift/expiration replacements are compliance, not savings. The "
+    "optimizer-vs-greedy split is the bench c14 headline: optimizer "
+    "savings above the greedy baseline are consolidations the prefix "
+    "search structurally cannot see",
+    ("source", "tenant"), label_defaults=_TENANT)
+OPTIMIZER_SUBSETS = REGISTRY.counter(
+    "karpenter_tpu_optimizer_subsets_total",
+    "Global-optimizer search funnel, by event: 'scored' = candidate "
+    "victim subsets scored by the batched repack tournament (one "
+    "dispatch scores the whole batch), 'verify_pass' / 'verify_reject' "
+    "= exact Solver.solve() verifications of ranked winners (every "
+    "executed disruption passed one — the exact-verify contract), "
+    "'fallback' = searches that degraded to the greedy path after a "
+    "fault. A growing verify_reject share is the relaxation ranking "
+    "diverging from solve semantics — the watchdog's "
+    "optimizer_divergence invariant pages on the streak",
+    ("event", "tenant"), label_defaults=_TENANT)
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "

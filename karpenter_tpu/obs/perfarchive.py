@@ -67,7 +67,7 @@ _LOWER_BETTER = re.compile(
     r"(_ms|_ms_p\d+|headline_ms|_bytes|_watermark\w*)$")
 _HIGHER_BETTER = re.compile(
     r"(_per_sec|_speedup|_vs_serial(_persistent)?|hit_rate|vs_baseline|"
-    r"_cover(age)?|kernel_vs_native_cpp|pods_per_sec)$")
+    r"_cover(age)?|kernel_vs_native_cpp|pods_per_sec|_savings_total)$")
 # informational regardless of suffix: the upload-redundancy fraction is
 # a MEASUREMENT of delta-upload headroom, not a performance quantity —
 # a workload-mix change moving it must never fail the gate in either

@@ -65,6 +65,10 @@ PHASES: Tuple[str, ...] = (
     "warm_admit",       # warm-path admission
     "journal_fsync",    # intent-journal append + fsync
     "cloud_api",        # batcher wire calls
+    "optimizer_search",  # disruption optimizer: subset generation +
+    #                     batched tournament + relaxation dispatch
+    "optimizer_verify",  # disruption optimizer: exact Solver.solve()
+    #                     verification of ranked subsets
     "reconcile_other",  # controller pass glue outside the seams above
 )
 
@@ -108,6 +112,8 @@ _SPAN_PHASE: Dict[str, str] = {
     "cloud.terminate": "cloud_api",
     "cloud.describe": "cloud_api",
     "restart.adopt": "reconcile_other",
+    "optimizer.search": "optimizer_search",
+    "optimizer.verify": "optimizer_verify",
 }
 
 COVERAGE_TARGET = 0.99

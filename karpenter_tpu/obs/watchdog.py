@@ -51,6 +51,13 @@ INCREMENTAL monitors evaluated on a sim-clock cadence:
   cannot hand them out (upload() re-keys on token mismatch), so a
   persistent stale entry is held HBM plus a latent-bug signal — the
   refresh that should have re-seeded it never ran.
+- **optimizer_divergence** — the global disruption optimizer's exact
+  verification keeps REJECTING the relaxation ranking's picks: a
+  tenant's consecutive-reject streak (optimizer/stats.py, reset by any
+  accept) crossed the divergence threshold. Every executed disruption
+  still passes a real `Solver.solve()` — the invariant polices wasted
+  exact solves and a scoring model that has drifted from solve
+  semantics, not correctness.
 - **overload_unbounded** — an open-loop tenant's waiting-pod depth
   (pending + deferred, loadgen/source.py) sits ABOVE the admission
   controller's shed budget and is still not shrinking (or its oldest
@@ -104,6 +111,7 @@ INVARIANTS: Tuple[str, ...] = (
     "devicemem_leak",
     "resident_staleness",
     "overload_unbounded",
+    "optimizer_divergence",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -171,6 +179,15 @@ class Watchdog:
     OVERLOAD_GRACE = 45.0     # sim seconds a tenant's waiting depth may
     #                           sit above the admission budget before a
     #                           still-growing backlog counts as unbounded
+    OPTIMIZER_STREAK = 12     # consecutive exact-verify rejects of the
+    #                           optimizer's ranked subsets before the
+    #                           relaxation scoring counts as diverged —
+    #                           deliberately ABOVE one pass's
+    #                           VERIFY_LIMIT (8): a single unlucky
+    #                           all-reject pass is the over-approximation
+    #                           doing its job; persisting across passes
+    #                           on CHANGED state (unchanged state skips
+    #                           the search entirely) is the divergence
     JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
     MAX_FINDINGS = 256        # bounded finding log
 
@@ -247,6 +264,9 @@ class Watchdog:
         # the watchdog clock, depth at first sight) — jump-absorbed like
         # every other window
         self._overload: Dict[str, Tuple[float, int]] = {}
+        # optimizer divergence: per-tenant reject-streak baseline at arm
+        # (pre-arm residue from another run never counts here)
+        self._optimizer_base: Dict[str, int] = {}
 
     # --- arming -----------------------------------------------------------
     def arm(self, now: Optional[float] = None) -> "Watchdog":
@@ -275,6 +295,8 @@ class Watchdog:
                                       for o in DEVICEMEM.orphans())
         from ..ops.resident import RESIDENT
         self._resident_base = frozenset(s["key"] for s in RESIDENT.stale())
+        from ..optimizer.stats import OPTIMIZER
+        self._optimizer_base = dict(OPTIMIZER.reject_streaks())
         register_debug_route("/debug/watchdog",
                              lambda wd, query: wd.payload(query),
                              owner=self)
@@ -326,6 +348,7 @@ class Watchdog:
         self._check_devicemem(now, fired)
         self._check_resident(now, fired)
         self._check_overload(now, fired)
+        self._check_optimizer(now, fired)
         if self._last_sweep is None or force \
                 or now - self._last_sweep >= self.CLOUD_SWEEP:
             self._last_sweep = now
@@ -722,6 +745,33 @@ class Watchdog:
                 self._overload.pop(tenant, None)
                 self._clear("overload_unbounded", tenant)
 
+    def _check_optimizer(self, now: float, fired: List[Finding]) -> None:
+        """The global disruption optimizer's exact-verify contract as a
+        quality monitor: a tenant whose consecutive-reject streak (the
+        relaxation ranking proposing, Solver.solve() refusing) grew past
+        the divergence threshold since arm fires a warning; any accept
+        resets the streak and clears the excursion. Counter-delta based
+        like the ring/ledger meters — no clock window to jump-absorb."""
+        from ..optimizer.stats import OPTIMIZER
+        streaks = OPTIMIZER.reject_streaks()
+        for tenant, streak in streaks.items():
+            delta = streak - self._optimizer_base.get(tenant, 0)
+            if delta >= self.OPTIMIZER_STREAK:
+                self._fire(fired, "optimizer_divergence", "warning",
+                           tenant,
+                           f"tenant {tenant}: {delta} consecutive "
+                           f"optimizer subsets rejected by exact "
+                           f"verification (threshold "
+                           f"{self.OPTIMIZER_STREAK}) — relaxation "
+                           f"scoring has diverged from solve semantics",
+                           now, tenant=tenant, streak=streak)
+            else:
+                self._clear("optimizer_divergence", tenant)
+                # a cleared excursion re-baselines: the NEXT divergence
+                # is a fresh streak, not the old one plus noise
+                if streak == 0:
+                    self._optimizer_base.pop(tenant, None)
+
     # --- firing / clearing ------------------------------------------------
     def _fire(self, fired: List[Finding], invariant: str, severity: str,
               key: str, message: str, now: float, **attrs) -> None:
@@ -834,7 +884,8 @@ class Watchdog:
                            "pipeline_s": self.pipeline_grace,
                            "devicemem_s": self.DEVICEMEM_GRACE,
                            "resident_s": self.RESIDENT_GRACE,
-                           "overload_s": self.overload_grace},
+                           "overload_s": self.overload_grace,
+                           "optimizer_streak": self.OPTIMIZER_STREAK},
                 "stats": dict(self.stats),
                 "fired": dict(self._fired),
                 "watchlist": {"claims": len(self._claims),

@@ -1,0 +1,91 @@
+"""Convex-relaxation scoring: fractional repack of victim subsets.
+
+The per-group tournament screen (tournament.py) is an over-approximation
+— it checks each pod group against the survivors' headroom SEPARATELY,
+so two groups that individually fit but jointly exceed a node's capacity
+still screen feasible, and the exact `Solver.solve()` verification then
+wastes a full re-solve rejecting them. This module scores each subset
+with the natural LP relaxation of the repack instead: place FRACTIONAL
+pods of each victim group onto surviving nodes, subject to per-node
+multi-resource capacity and per-(node, group) eligibility caps, and
+report the unplaceable fractional residue.
+
+The relaxation is solved by projected proportional fitting (a damped
+Sinkhorn-style alternation between the group-demand constraints and the
+node-capacity simplex), a fixed small number of iterations so it jits to
+one fused kernel — the CvxCluster recipe of trading an exact
+combinatorial solve for a convex surrogate that ranks candidates in
+microseconds (PAPERS.md), with `Solver.solve()` retained as the exact
+arbiter for the handful of winners.
+
+residual == 0  ⇒ the subset is fractionally repackable (cross-group
+                 contention included) — verify it first;
+residual >> 0  ⇒ the per-group screen was fooled; rank it last (and
+                 usually never spend an exact solve on it).
+
+The same function body serves NumPy (host path, tier-1) and jax.numpy
+(device path) via the `xp` module parameter — one implementation, two
+backends, no drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+RELAX_ITERS = 6
+_BIG = np.float32(1e9)
+_EPS = np.float32(1e-6)
+
+
+def relax_residuals(xp, headroom, group_req, k, masks, need,
+                    iters: int = RELAX_ITERS):
+    """Fractional-repack residue per subset.
+
+    headroom  [N, R]  survivors' free capacity (victims' rows are dead
+                      weight — their columns are zeroed via `masks`)
+    group_req [G, R]  per-pod resource vector per group
+    k         [N, G]  per-(node, group) placement cap (eligibility +
+                      single-resource fit, the screen's k)
+    masks     [S, N]  victim masks (1.0 = evicted)
+    need      [S, G]  pods of each group the subset must rehome
+
+    Returns residual [S, G] — fractional pods of each group with no
+    feasible home (all-zero row = fractionally repackable). All
+    float32, no in-place ops, safe under jit."""
+    headroom = xp.maximum(headroom, 0.0)
+    surv = 1.0 - masks                                    # [S, N]
+    cap = surv[:, :, None] * k[None, :, :]                # [S, N, G]
+    denom = cap.sum(axis=1) + _EPS                        # [S, G]
+    x = cap * (need / denom)[:, None, :]                  # proportional seed
+    for _ in range(int(iters)):
+        load = xp.einsum("sng,gr->snr", x, group_req)     # [S, N, R]
+        ratio = xp.where(load > _EPS,
+                         headroom[None, :, :] / xp.maximum(load, _EPS),
+                         _BIG)
+        scale = xp.clip(ratio.min(axis=2), 0.0, 1.0)      # [S, N]
+        x = x * scale[:, :, None]                         # capacity proj
+        deficit = xp.maximum(need - x.sum(axis=1), 0.0)   # [S, G]
+        slack = xp.maximum(cap - x, 0.0)                  # [S, N, G]
+        sden = slack.sum(axis=1) + _EPS
+        x = x + slack * (deficit / sden)[:, None, :]      # demand proj
+    # one last capacity projection, then measure what never found a home
+    load = xp.einsum("sng,gr->snr", x, group_req)
+    ratio = xp.where(load > _EPS,
+                     headroom[None, :, :] / xp.maximum(load, _EPS), _BIG)
+    scale = xp.clip(ratio.min(axis=2), 0.0, 1.0)
+    x = x * scale[:, :, None]
+    return xp.maximum(need - x.sum(axis=1), 0.0)       # [S, G]
+
+
+def replacement_lower_bound(xp, residual, per_slot):
+    """$/hr estimate of the NEW capacity a subset's fractionally
+    unplaceable residue would force open: residual pods per group
+    priced at that group's best price-per-slot — the SAME
+    price-per-pod-slot metric the exact solver opens nodes with
+    (ops/binpack solve_host step 2), so the ranking and the verdict
+    share one cost model. Exact pricing belongs to `Solver.solve()` —
+    this only decides who gets a slot in the verify budget.
+
+    residual [S, G] (relax_residuals), per_slot [G] ($/pod-slot/hr,
+    BIG where no type can host the group). Returns [S]."""
+    return residual @ per_slot                          # [S]

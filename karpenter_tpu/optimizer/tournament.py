@@ -1,0 +1,291 @@
+"""Batched repack tournament: score S victim subsets in one dispatch.
+
+The consolidation screen (ops/consolidate.py) answers "could node n's
+pods re-schedule onto the OTHERS" for every node at once; the tournament
+generalizes it to SUBSETS: for each candidate subset s with victim mask
+m_s ∈ {0,1}^N,
+
+    need_s[g]   = Σ_{n∈s} counts[n, g]          pods to rehome
+    supply_s[g] = Σ_{n∉s} k[n, g]               survivors' per-group caps
+    feasible_s  = ∀g: need_s[g] ≤ supply_s[g]
+    savings_s   = Σ_{n∈s} price[n]              (replacement-free repack)
+
+where k[n, g] is the screen's per-(node, group) placement cap — computed
+from the SAME CatalogTensors / EncodedPods encodings, so the tournament
+and the screen can never disagree about headroom. The subset axis turns
+the screen's [N, G] computation into [S, N]·[N, G] matmuls: all S
+subsets score in one kernel call, and the convex-relaxation pass
+(relax.py) rides the same dispatch to rank the feasible ones by
+cross-group contention.
+
+Two backends, byte-compatible by construction:
+
+- **host** (numpy): tier-1 and the small-cluster path — the math above
+  verbatim;
+- **device** (jit): the packed-buffer idiom of `_screen_onebuf` — node-
+  side and group-side inputs ship as two matrices (shared packing code
+  with the screen), masks+prices as one [S+1, N] matrix, ONE packed
+  [S, 3] readback. With a mesh, the SUBSET axis shards across the chips
+  exactly like the screen's node axis (parallel/mesh.py recipe): each
+  chip scores its slice of the tournament, the output replicates for
+  the single host read.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.binpack import BIG, EPS
+from .relax import RELAX_ITERS, relax_residuals, replacement_lower_bound
+
+
+def group_slot_prices(cat, enc) -> np.ndarray:
+    """[G] best $/pod-slot/hr per group — the replacement bound's rate
+    card, matching the host solver's node-opening metric: over types
+    compatible with the group, (cheapest offering surviving the group's
+    zone/captype masks) / (pods of the group the type holds). BIG where
+    no compatible available type can host the group."""
+    from ..ops.encode import align_resources
+    R = enc.requests.shape[1]
+    alloc = align_resources(cat.allocatable, R)             # [T, R]
+    req = enc.requests.astype(np.float32)                   # [G, R]
+    with_req = np.where(req > 0, req, np.float32(1.0))
+    slots = np.where(req[:, None, :] > 0,
+                     np.floor(alloc[None, :, :] / with_req[:, None, :]
+                              + EPS),
+                     np.float32(BIG)).min(axis=2)           # [G, T]
+    slots = np.where(enc.compat, np.maximum(slots, 0.0), 0.0)
+    # cheapest offering per (group, type) surviving the group's masks
+    mask = (cat.available[None, :, :, :]
+            & enc.allow_zone[:, None, :, None]
+            & enc.allow_cap[:, None, None, :])              # [G, T, Z, C]
+    price = np.where(mask, cat.price[None], np.inf)
+    price_gt = price.reshape(enc.G, cat.T, -1).min(axis=2)  # [G, T]
+    per_slot = np.where(slots > 0, price_gt / np.maximum(slots, 1.0),
+                        np.inf).min(axis=1)                 # [G]
+    return np.where(np.isfinite(per_slot), per_slot,
+                    np.float32(BIG)).astype(np.float32)
+
+
+def repack_inputs(cat, enc, views, group_counts: np.ndarray,
+                  exclude: Optional[np.ndarray] = None):
+    """Host-side tournament inputs, shared with the screen's
+    construction (`_screen_args`) so the two headroom views are
+    identical: (headroom [N, R], group_req [G, R], elig [N, G],
+    k [N, G], active [N])."""
+    from ..ops.consolidate import _screen_args
+    (alloc, avail, node_type, node_cum, node_zmask, node_cmask, active,
+     req, compat, allow_zone, allow_cap, _counts) = _screen_args(
+        cat, enc, views, group_counts)
+    active = active.copy()
+    if exclude is not None:
+        active &= ~exclude
+    talloc = alloc[node_type]                               # [N, R]
+    headroom = (talloc - node_cum).astype(np.float32)
+    ok_t = compat[:, node_type].T                           # [N, G]
+    a = avail[node_type]                                    # [N, Z, C]
+    off = np.einsum("nz,gz,nc,gc,nzc->ng",
+                    node_zmask.astype(np.float32),
+                    allow_zone.astype(np.float32),
+                    node_cmask.astype(np.float32),
+                    allow_cap.astype(np.float32),
+                    a.astype(np.float32)) > 0               # [N, G]
+    elig = ok_t & off & active[:, None]
+    req = req.astype(np.float32)
+    with_req = np.where(req > 0, req, np.float32(1.0))
+    ratios = np.where(req[None, :, :] > 0,
+                      np.floor(headroom[:, None, :] / with_req[None, :, :]
+                               + EPS),
+                      np.float32(BIG))                      # [N, G, R]
+    k = np.where(elig, np.maximum(ratios.min(axis=2), 0.0),
+                 np.float32(0.0)).astype(np.float32)
+    return headroom, req, elig, k, active
+
+
+def score_subsets_host(headroom: np.ndarray, group_req: np.ndarray,
+                       k: np.ndarray, counts: np.ndarray,
+                       prices: np.ndarray, masks: np.ndarray,
+                       per_slot: np.ndarray,
+                       iters: int = RELAX_ITERS,
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray]:
+    """NumPy tournament: (feasible [S] bool — per-group replacement-free
+    screen, savings [S] f32, residual [S] f32 — fractionally unplaced
+    pods, repl_lb [S] f32 — replacement-cost lower bound for the
+    residue)."""
+    counts = counts.astype(np.float32)
+    need = masks @ counts                                   # [S, G]
+    supply = k.sum(axis=0)[None, :] - masks @ k             # [S, G]
+    feasible = ((need <= supply + EPS) | (need == 0)).all(axis=1)
+    savings = masks @ prices.astype(np.float32)             # [S]
+    residual_g = relax_residuals(np, headroom, group_req, k, masks, need,
+                                 iters=iters)               # [S, G]
+    repl_lb = replacement_lower_bound(np, residual_g, per_slot)
+    return (feasible, savings.astype(np.float32),
+            np.asarray(residual_g.sum(axis=1), np.float32),
+            np.asarray(repl_lb, np.float32))
+
+
+# --- device path -----------------------------------------------------------
+# Packed single-dispatch tournament, mirroring ops/consolidate's onebuf
+# screen: nbuf/gbuf reuse the screen's packing helpers verbatim; mbuf
+# packs the [S, N] victim masks with the price row appended so the whole
+# subset side is ONE upload. Output is one packed [S, 3] buffer
+# (feasible, savings, residual) — one blocking read.
+
+
+def _tournament_impl(alloc, avail, nbuf, gbuf, mbuf, pslot, cols: tuple,
+                     iters: int = RELAX_ITERS):
+    import jax.numpy as jnp
+    T, Z, C = avail.shape
+    Rk = len(cols)
+    G = gbuf.shape[0]
+    cix = jnp.asarray(np.asarray(cols, np.int32))
+    alloc_k = alloc[:, cix]
+    req = gbuf[:, :Rk]
+    o = Rk
+    compat = gbuf[:, o:o + T] > 0; o += T
+    allow_zone = gbuf[:, o:o + Z] > 0; o += Z
+    allow_cap = gbuf[:, o:o + C] > 0
+    node_type = nbuf[:, 0].astype(jnp.int32)
+    o = 1
+    node_cum = nbuf[:, o:o + Rk]; o += Rk
+    node_zmask = nbuf[:, o:o + Z] > 0; o += Z
+    node_cmask = nbuf[:, o:o + C] > 0; o += C
+    active = nbuf[:, o] > 0; o += 1
+    counts = nbuf[:, o:o + G]
+    masks = mbuf[:-1]                                     # [S, N]
+    prices = mbuf[-1]                                     # [N]
+    talloc = alloc_k[node_type]
+    headroom = talloc - node_cum
+    ok_t = compat[:, node_type].T
+    a = avail[node_type]
+    off = jnp.einsum("nz,gz,nc,gc,nzc->ng",
+                     node_zmask.astype(jnp.float32),
+                     allow_zone.astype(jnp.float32),
+                     node_cmask.astype(jnp.float32),
+                     allow_cap.astype(jnp.float32),
+                     a.astype(jnp.float32)) > 0
+    elig = ok_t & off & active[:, None]
+    with_req = jnp.where(req > 0, req, 1.0)
+    ratios = jnp.where(req[None, :, :] > 0,
+                       jnp.floor(headroom[:, None, :] / with_req[None, :, :]
+                                 + EPS),
+                       jnp.asarray(BIG, jnp.float32))
+    k = jnp.where(elig, jnp.maximum(ratios.min(axis=2), 0.0), 0.0)
+    need = masks @ counts
+    supply = k.sum(axis=0)[None, :] - masks @ k
+    feasible = ((need <= supply + EPS) | (need == 0)).all(axis=1)
+    savings = masks @ prices
+    residual_g = relax_residuals(jnp, headroom, req, k, masks, need,
+                                 iters=iters)             # [S, G]
+    repl_lb = replacement_lower_bound(jnp, residual_g, pslot)
+    return jnp.stack([feasible.astype(jnp.float32), savings,
+                      residual_g.sum(axis=1), repl_lb],
+                     axis=1).reshape(-1)                  # packed [S*4]
+
+
+_jit_tournament = None
+
+
+def _tournament_fn():
+    global _jit_tournament
+    if _jit_tournament is None:
+        import jax
+        _jit_tournament = jax.jit(_tournament_impl,
+                                  static_argnames=("cols", "iters"))
+    return _jit_tournament
+
+
+# mesh-jitted tournaments, keyed on the (hashable) Mesh + cols — the
+# same bound-cache discipline as consolidate._mesh_screen_fn
+_mesh_cache: dict = {}
+_MESH_CACHE_MAX = 16
+
+
+def _mesh_tournament_fn(mesh, cols: tuple, iters: int):
+    """Subset-axis-sharded tournament: the [S+1, N] mask matrix shards
+    its subset rows over the mesh (each chip scores its slice; the
+    price row rides the last shard's padding), node/group inputs
+    replicate, output replicates for the single host read."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+    key = (mesh, cols, iters)
+    fn = _mesh_cache.get(key)
+    if fn is None:
+        if len(_mesh_cache) >= _MESH_CACHE_MAX:
+            _mesh_cache.clear()
+        fn = jax.jit(partial(_tournament_impl, cols=cols, iters=iters),
+                     out_shardings=NamedSharding(mesh, P()))
+        _mesh_cache[key] = fn
+    return fn
+
+
+def score_subsets_device(cat, enc, views, group_counts: np.ndarray,
+                         prices: np.ndarray, masks: np.ndarray,
+                         mesh=None, iters: int = RELAX_ITERS,
+                         exclude: Optional[np.ndarray] = None,
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                    np.ndarray]:
+    """Device tournament: same results as score_subsets_host, one packed
+    dispatch (optionally subset-sharded over `mesh`). `exclude` [N]
+    strikes nodes (pending victims, deleting claims) from the SUPPLY
+    side by clearing their active bit — the same `active &= ~exclude`
+    the host path applies, so the two backends agree about who may
+    absorb a repack. Probes the chaos device-fault seam like every
+    other kernel dispatch."""
+    from ..obs import devicemem as _dm
+    from ..ops import solver as _solver_mod
+    from ..ops.consolidate import (_pack_screen_groups, _pack_screen_nodes,
+                                   _screen_args)
+    from ..ops.solver import _auto_dcat, _put, _put_sharded, _read, \
+        _request_cols
+    if _solver_mod._dispatch_fault_hook is not None:
+        _solver_mod._dispatch_fault_hook("optimizer")
+    S = masks.shape[0]
+    R = enc.requests.shape[1]
+    cols = _request_cols(enc, cat)
+    (_, _, node_type, node_cum, node_zmask, node_cmask, active,
+     req, compat, allow_zone, allow_cap, counts) = _screen_args(
+        cat, enc, views, group_counts)
+    if exclude is not None:
+        active = active & ~exclude
+    nbuf_np = _pack_screen_nodes(node_type, node_cum, node_zmask,
+                                 node_cmask, active, counts, list(cols))
+    gbuf_np = _pack_screen_groups(req, compat, allow_zone, allow_cap,
+                                  list(cols))
+    pslot_np = group_slot_prices(cat, enc)
+    # masks + price row in ONE upload; pad the subset axis with zero
+    # masks (inert: need == 0 ⇒ feasible, savings 0) so the TOTAL row
+    # count Sp+1 — the price row shards with the masks — divides the
+    # mesh
+    Sp = S if mesh is None else \
+        -(-(S + 1) // int(mesh.size)) * int(mesh.size) - 1
+    mbuf_np = np.zeros((Sp + 1, len(views)), np.float32)
+    mbuf_np[:S] = masks
+    mbuf_np[-1] = prices.astype(np.float32)
+    dcat = _auto_dcat(cat, R, mesh=mesh)
+    with _dm.attributed(reason="screen_upload"):
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            nbuf = _put_sharded(nbuf_np, NamedSharding(mesh, P()))
+            gbuf = _put_sharded(gbuf_np, NamedSharding(mesh, P()))
+            pslot = _put_sharded(pslot_np, NamedSharding(mesh, P()))
+            mbuf = _put_sharded(mbuf_np,
+                                NamedSharding(mesh, P("nodes", None)))
+            buf = _read(_mesh_tournament_fn(mesh, cols, iters)(
+                dcat.alloc, dcat.avail, nbuf, gbuf, mbuf, pslot))
+        else:
+            nbuf = _put(nbuf_np)
+            gbuf = _put(gbuf_np)
+            mbuf = _put(mbuf_np)
+            pslot = _put(pslot_np)
+            buf = _read(_tournament_fn()(dcat.alloc, dcat.avail, nbuf,
+                                         gbuf, mbuf, pslot, cols=cols,
+                                         iters=iters))
+    out = buf.reshape(Sp, 4)[:S]
+    return (out[:, 0] > 0.5, out[:, 1].astype(np.float32),
+            out[:, 2].astype(np.float32), out[:, 3].astype(np.float32))

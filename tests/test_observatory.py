@@ -73,6 +73,11 @@ class TestPhaseLedgerMapping:
         # — digest diff + changed-row upload + donated scatter
         ("solve.resident_patch", {"h2d_bytes": 96, "rows": 3},
          "resident_patch"),
+        # global disruption optimizer (karpenter_tpu/optimizer/): the
+        # batched subset-search dispatch and the exact-verify re-solves
+        ("optimizer.search", {"candidates": 8, "scored": 210},
+         "optimizer_search"),
+        ("optimizer.verify", {"ranked": 12}, "optimizer_verify"),
         ("reconcile:provisioner", {}, "reconcile_other"),
     ]
 

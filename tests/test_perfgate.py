@@ -68,6 +68,18 @@ class TestDirections:
         assert metric_direction("c3_upload_redundant_frac") is None
         assert metric_direction("c12_upload_redundant_frac") is None
 
+    def test_optimizer_classification(self):
+        """ISSUE 13 satellite: realized consolidation savings gate
+        higher-better (the optimizer finding LESS than the baseline is
+        the regression), the subset-search throughput rides the
+        `_per_sec` rule, and the raw funnel counts are informational."""
+        assert metric_direction("c14_optimizer_savings_total") == "higher"
+        assert metric_direction("c14_greedy_savings_total") == "higher"
+        assert metric_direction("c14_subsets_per_sec") == "higher"
+        assert metric_direction("c14_exact_verifies") is None
+        assert metric_direction("c14_subsets_scored") is None
+        assert metric_direction("c14_joint_consolidations") is None
+
     def test_redundant_frac_never_gates(self, tmp_path):
         """A wild swing in the redundancy fraction (a workload-mix
         change) produces NO verdict; a byte-key regression does."""

@@ -62,7 +62,8 @@ class TestArming:
             "warm_audit_lag", "warm_divergence", "fleet_starvation",
             "pipeline_stall", "profile_unattributed",
             "trace_ring_overflow", "devicemem_leak",
-            "resident_staleness", "overload_unbounded")
+            "resident_staleness", "overload_unbounded",
+            "optimizer_divergence")
 
 
 class TestTrips:
@@ -311,6 +312,50 @@ class TestTrips:
         lg.depth = 120
         wd2.tick(force=True)
         assert not _findings(wd2, "overload_unbounded")
+
+    def test_trip_optimizer_divergence(self):
+        """Seeded divergence: the exact verifier rejecting the
+        optimizer's ranked subsets OPTIMIZER_STREAK times in a row with
+        no accept fires a warning for the offending tenant; an accept
+        resets the streak and clears the excursion. Pre-arm residue
+        (another run's streak) never fires."""
+        from karpenter_tpu.metrics.tenant import tenant_scope
+        from karpenter_tpu.optimizer.stats import OPTIMIZER
+        # pre-arm residue for an unrelated tenant
+        with tenant_scope("stale"):
+            for _ in range(Watchdog.OPTIMIZER_STREAK + 2):
+                OPTIMIZER.record_verify(False)
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "optimizer_divergence")  # residue
+        # healthy verify traffic: some rejects, then an accept — quiet
+        with tenant_scope("t001"):
+            for _ in range(Watchdog.OPTIMIZER_STREAK - 1):
+                OPTIMIZER.record_verify(False)
+        wd.tick(force=True)
+        assert not _findings(wd, "optimizer_divergence")
+        with tenant_scope("t001"):
+            OPTIMIZER.record_verify(True)
+        wd.tick(force=True)
+        assert not _findings(wd, "optimizer_divergence")
+        # a real divergence streak: fires once (edge), warning, keyed
+        # by the tenant
+        with tenant_scope("t001"):
+            for _ in range(Watchdog.OPTIMIZER_STREAK):
+                OPTIMIZER.record_verify(False)
+        wd.tick(force=True)
+        found = _findings(wd, "optimizer_divergence")
+        assert found and found[0].severity == "warning"
+        assert found[0].key == "t001"
+        wd.tick(force=True)
+        assert len(_findings(wd, "optimizer_divergence")) == 1
+        assert wd.verdict() == "warning"
+        # an accept repairs the ranking: excursion clears, verdict ok
+        with tenant_scope("t001"):
+            OPTIMIZER.record_verify(True)
+        wd.tick(force=True)
+        assert wd.verdict() == "ok"
 
     def test_overload_jump_absorbed(self):
         """A clock jump over an in-grace excursion must not age it into
