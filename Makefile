@@ -7,7 +7,7 @@ PY ?= python
         deflake run native trace-report profile-report obs-audit chaos \
         crash-audit warmpath-audit encode-report fleet fleet-audit \
         perf-gate device-report resident-report soak soak-audit \
-        disrupt-report clean
+        disrupt-report integrity-report clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -68,6 +68,9 @@ fleet-audit:  ## fleet reproducibility: fleet_smoke at 2 seeds x --repeat 2, ide
 
 disrupt-report:  ## global disruption optimizer vs greedy: savings found, verify hit-rate, subset funnel (FLEET=squeeze|joint TILES=n)
 	$(PY) tools/disrupt_report.py --fleet $(or $(FLEET),squeeze) --tiles $(or $(TILES),2)
+
+integrity-report:  ## solution-integrity plane: injected-vs-detected table, verdict counts, canary agreement, audit coverage (SEED=n)
+	$(PY) tools/integrity_report.py --seed $(or $(SEED),0)
 
 soak:  ## open-loop long-soak serving mode (loadgen/): drive the fleet past saturation, shedding bounds the backlog (TENANTS overrides shard count)
 	$(PY) -m karpenter_tpu.loadgen soak_overload $(if $(TENANTS),--tenants $(TENANTS))

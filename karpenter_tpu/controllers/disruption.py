@@ -641,46 +641,70 @@ class DisruptionController:
         vsp = (TRACER.span("optimizer.verify",
                            ranked=len(plan.subsets))
                if TRACER.enabled else NOOP_SPAN)
-        with vsp:
-            verified = 0
-            for subset in plan.subsets:
-                if verified >= VERIFY_LIMIT:
-                    break
-                victims = [views[i] for i in subset]
-                if len(victims) > budget:
-                    continue
-                if any(self._is_pending_victim(v.name)
-                       or v.claim.is_deleting()
-                       or v.has_do_not_disrupt() for v in victims):
-                    continue
-                if self._pdb_blocked_set(victims):
-                    continue
-                verified += 1
-                total_price = sum(v.price for v in victims)
-                # the exact-verify contract: the optimizer proposes,
-                # Solver.solve() disposes — nothing executes on the
-                # relaxation's word alone
-                out, ok = self._simulate_removal(pool, victims, cat,
-                                                 views, total_price)
-                if ok and out.launches and not all(
-                        self._spot_floor_ok(v, out, cat)
-                        for v in victims):
-                    ok = False
-                OPTIMIZER.record_verify(bool(ok))
-                OPTIMIZER_SUBSETS.inc(
-                    event="verify_pass" if ok else "verify_reject")
-                if not ok:
-                    continue
-                self._execute(pool, victims, out, "Underutilized", now,
-                              source="optimizer")
-                self._pdb_commit(victims)
-                self.stats["multi_consolidated"] += 1
-                self.stats["optimizer_consolidated"] = (
-                    self.stats.get("optimizer_consolidated", 0) + 1)
-                self._optimizer_noop.pop(pool.name, None)
-                vsp.set(verified=verified, accepted=len(subset))
-                return True
-            vsp.set(verified=verified, accepted=0)
+        executing = False
+        try:
+            with vsp:
+                verified = 0
+                for subset in plan.subsets:
+                    if verified >= VERIFY_LIMIT:
+                        break
+                    victims = [views[i] for i in subset]
+                    if len(victims) > budget:
+                        continue
+                    if any(self._is_pending_victim(v.name)
+                           or v.claim.is_deleting()
+                           or v.has_do_not_disrupt() for v in victims):
+                        continue
+                    if self._pdb_blocked_set(victims):
+                        continue
+                    verified += 1
+                    total_price = sum(v.price for v in victims)
+                    # the exact-verify contract: the optimizer proposes,
+                    # Solver.solve() disposes — nothing executes on the
+                    # relaxation's word alone
+                    out, ok = self._simulate_removal(pool, victims, cat,
+                                                     views, total_price)
+                    if ok and out.launches and not all(
+                            self._spot_floor_ok(v, out, cat)
+                            for v in victims):
+                        ok = False
+                    OPTIMIZER.record_verify(bool(ok))
+                    OPTIMIZER_SUBSETS.inc(
+                        event="verify_pass" if ok else "verify_reject")
+                    if not ok:
+                        continue
+                    executing = True
+                    self._execute(pool, victims, out, "Underutilized",
+                                  now, source="optimizer")
+                    self._pdb_commit(victims)
+                    self.stats["multi_consolidated"] += 1
+                    self.stats["optimizer_consolidated"] = (
+                        self.stats.get("optimizer_consolidated", 0) + 1)
+                    self._optimizer_noop.pop(pool.name, None)
+                    vsp.set(verified=verified, accepted=len(subset))
+                    return True
+                vsp.set(verified=verified, accepted=0)
+        except Exception:  # noqa: BLE001 — a device fault surfacing
+            # inside the verify stage (the exact solve's own dispatch,
+            # or a tournament-adjacent readback) degrades to greedy
+            # EXACTLY like a search-stage fault — and, critically, the
+            # pass must NOT be memoized as fruitless: nothing proved the
+            # ranked subsets worthless, the backend just died. The next
+            # reconcile re-runs the search against the (memoized) screen.
+            if executing:
+                # the winning subset's disruption PARTIALLY EXECUTED
+                # (victims may already be cordoned/terminated): this is
+                # not a verify-stage fault, and degrading to greedy here
+                # would re-disrupt against stale views while hiding the
+                # real bug — surface it
+                raise
+            SOLVER_FALLBACKS.inc(from_backend="optimizer",
+                                 to_backend="greedy")
+            OPTIMIZER.record_fallback()
+            OPTIMIZER_SUBSETS.inc(event="fallback")
+            self.stats["optimizer_errors"] = (
+                self.stats.get("optimizer_errors", 0) + 1)
+            return False
         self._optimizer_noop[pool.name] = noop_key
         return False
 

@@ -135,6 +135,23 @@ def device_fault_hook(plan: Optional[FaultPlan]):
 
 
 @contextlib.contextmanager
+def corruption_fault_hook(plan: Optional[FaultPlan]):
+    """Arm the silent-data-corruption seam (ops.solver.set_corruption_hook,
+    consulted by both the staged-gbuf uploads and ops/resident.py's
+    post-patch seam) for the plan's CorruptionFault rules; always
+    disarms on exit — same leak-proofing contract as the other seams."""
+    from ..ops import solver as solver_mod
+    if plan is None or not plan.has_corruption_faults:
+        yield
+        return
+    solver_mod.set_corruption_hook(plan.on_corruption)
+    try:
+        yield
+    finally:
+        solver_mod.set_corruption_hook(None)
+
+
+@contextlib.contextmanager
 def fleet_device_fault_hook(plans: dict):
     """Tenant-scoped device faults for a fleet: the ONE process-global
     dispatch seam is armed with a router that consults the CURRENT

@@ -95,6 +95,53 @@ class DeviceFault:
 
 
 @dataclass(frozen=True)
+class CorruptionFault:
+    """Silent data corruption (SDC / bit-rot) in a device buffer the
+    solve path is about to consume — the fault family the solution-
+    integrity plane (karpenter_tpu/integrity/) exists to catch. Unlike
+    DeviceFault (the backend dying loudly), nothing raises: the buffer's
+    bytes silently diverge from what the host staged, and the run only
+    stays correct if the oracle, the canary, or the resident digest
+    audit detects it BEFORE a placement commits.
+
+    target: which upload seam — "gbuf" (non-resident staged request
+    matrices: the serial path with residency disarmed, and the batched
+    dispatcher's stacked gstack; ops/solver._maybe_corrupt) or
+    "resident" (ops/resident.py buffers: request matrices, conflict
+    matrices, and the resident catalog tensors — the post-patch seam).
+    key_contains: for "resident", only corrupt uploads whose entry key
+    carries this substring (e.g. "price" rots the resident price
+    tensor, "gbuf" the request matrix); None matches every key.
+    nth/count: 1-based count of ELIGIBLE seam probes (per rule) the
+    corruption fires on — deterministic, like DeviceFault's dispatch
+    numbering. at: the rule's arming time — probes before this
+    run-relative sim instant do NOT count, so (at=30, nth=1) reads
+    "the first matching upload after t=30" regardless of how many
+    uploads the warm-up burned (and it carries the scenario's fault
+    horizon, like an IceWindow's t1).
+
+    kind: "bitflip" XORs bit 30 of every 32-bit word in the victim row
+    (exponent-scale damage — guaranteed behavioral for live rows, and
+    inverts a bool row), "zero_row" zeroes it, "stale_patch" overwrites
+    it with its successor row (a patch applied at the wrong index).
+    Every kind guarantees a REAL byte change (zero_row of an already-
+    zero row and stale_patch of an identical successor both fall back
+    to the bit flip) — a no-op injection would count against the
+    100%-detection contract while corrupting nothing. The victim row is
+    row 0 of the leading axis for "gbuf" (group 0 is always live) and a
+    plan-RNG LIVE (non-zero) row for "resident" — live rows keep the
+    damage behaviorally reachable, and the digest audit detects the rot
+    regardless."""
+
+    target: str = "gbuf"       # gbuf | resident
+    kind: str = "bitflip"      # bitflip | zero_row | stale_patch
+    nth: int = 1
+    count: int = 1
+    at: float = 0.0
+    key_contains: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class CrashPoint:
     """The operator process dies at a named commit-path cut point
     (utils/crashpoints.CUT_POINTS: mid_launch_batch, post_launch,
@@ -148,6 +195,15 @@ class FaultPlan:
             key=lambda r: r.at)
         self.device_faults = [r for r in self.rules
                               if isinstance(r, DeviceFault)]
+        self.corruption_faults = [r for r in self.rules
+                                  if isinstance(r, CorruptionFault)]
+        self._corruption_counts: dict = {}  # rule idx -> eligible probes
+        # per-FIRED-injection snapshot of the integrity plane's
+        # detection counter at injection time, in firing order — the
+        # runners' judgment matches detections to injections through
+        # these (an aggregate injected<=detected comparison would let an
+        # over-attributed early injection mask a later undetected one)
+        self._corruption_pre: List[int] = []
         self.crash_points = [r for r in self.rules
                              if isinstance(r, CrashPoint)]
         self._point_fires: dict = {}   # point -> cumulative firing count
@@ -234,6 +290,93 @@ class FaultPlan:
                 raise InjectedFault(
                     f"injected {backend} fault on dispatch "
                     f"#{self._dispatches}")
+
+    def on_corruption(self, target: str, buf, key: tuple = ()):
+        """The ops.solver/ops.resident corruption seam: returns `buf`
+        unchanged, or a silently corrupted replacement when a
+        CorruptionFault rule covers this (per-rule, 1-based) eligible
+        probe. Never raises — SDC is quiet by definition; detection is
+        the integrity plane's job."""
+        out = buf
+        now = self.clock.now() if self.clock is not None else 0.0
+        rel = now - self.origin
+        for i, r in enumerate(self.corruption_faults):
+            if r.target != target:
+                continue
+            if r.key_contains is not None and not any(
+                    r.key_contains in str(part) for part in key):
+                continue
+            if rel < r.at:
+                continue  # not armed yet: pre-`at` probes don't count
+            n = self._corruption_counts.get(i, 0) + 1
+            self._corruption_counts[i] = n
+            if not (r.nth <= n < r.nth + r.count):
+                continue
+            out = self._corrupt_buffer(out, r.kind, target)
+            detail = f"{target}:{r.kind}#{n}"
+            if r.key_contains:
+                detail += f":{r.key_contains}"
+            self.record(now, "corruption", detail)
+            from ..integrity import INTEGRITY
+            self._corruption_pre.append(INTEGRITY.detections())
+        return out
+
+    def _corrupt_buffer(self, buf, kind: str, target: str):
+        """Apply one corruption to a device buffer: read it back,
+        damage one row, re-commit. The victim row is row 0 for "gbuf"
+        (always a live group — padding rows would be inert, and an
+        inert injection breaks the 100%-detection contract) and a
+        plan-RNG LIVE row for "resident" (behaviorally reachable; the
+        digest audit sees every row either way)."""
+        import numpy as np
+        import jax.numpy as jnp
+        arr = np.array(buf)
+        rows = arr.reshape(-1, arr.shape[-1]) if arr.ndim > 1 \
+            else arr.reshape(1, -1)
+        if target == "gbuf":
+            r = 0
+        else:
+            lead = arr.shape[0] if arr.ndim > 1 else 1
+            if arr.ndim > 2:  # [T, Z, C]-style: flatten trailing axes
+                rows = arr.reshape(arr.shape[0], -1)
+            else:
+                rows = arr if arr.ndim > 1 else arr.reshape(1, -1)
+            live = np.nonzero(rows.any(axis=1))[0]
+            r = (int(live[self.rng.randrange(live.size)]) if live.size
+                 else self.rng.randrange(max(lead, 1)))
+        if kind == "zero_row":
+            if rows[r].any():
+                rows[r] = 0
+            else:  # already zero: a no-op is not an injection
+                self._flip_row(rows, r)
+        elif kind == "stale_patch":
+            src = (r + 1) % rows.shape[0]
+            if src != r and rows[src].tobytes() != rows[r].tobytes():
+                rows[r] = rows[src]
+            else:  # successor identical: degenerate no-op — keep the
+                # injection REAL by falling back to a bit flip
+                self._flip_row(rows, r)
+        else:
+            self._flip_row(rows, r)
+        return jnp.asarray(arr)
+
+    @staticmethod
+    def _flip_row(rows, r: int) -> None:
+        import numpy as np
+        if rows.dtype == bool:
+            rows[r] = ~rows[r]
+            return
+        row = rows[r]
+        if row.dtype.itemsize == 4:
+            words = row.view(np.uint32)
+            words ^= np.uint32(1 << 30)
+        else:
+            as_bytes = row.view(np.uint8)
+            as_bytes ^= np.uint8(0x40)
+
+    @property
+    def has_corruption_faults(self) -> bool:
+        return bool(self.corruption_faults)
 
     def on_crash_point(self, point: str) -> None:
         """The utils.crashpoints hook (armed by injector.crash_point_hook):

@@ -22,14 +22,90 @@ ticking past the last scheduled fault until that holds.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+
+@contextlib.contextmanager
+def scenario_env(env: Optional[dict]):
+    """Apply a scenario's env overrides for the run, restoring the
+    previous values on exit (crash or not)."""
+    if not env:
+        yield
+        return
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
 from ..models import labels as L
-from .injector import device_fault_hook
+from .injector import corruption_fault_hook, device_fault_hook
 from .plan import FaultPlan
+
+
+def _integrity_judgment(plan: FaultPlan, det0: int, wd,
+                        violations: List[str],
+                        stats: Dict[str, float]) -> None:
+    """The solution-integrity plane's run contract, shared by both
+    runners: every injected corruption detected BEFORE commit, zero
+    integrity findings on a corruption-free run (the zero-false-positive
+    contract over the existing catalog), and — found-it-first — any
+    detection must have fired the watchdog's integrity_breach invariant.
+
+    Detection is matched PER INJECTION, not by aggregate totals: the
+    plan snapshots the detection counter at each injection's firing
+    (`_corruption_pre`), and for the i-th of k injections at least
+    (k - i) new detections must land after it — a single injection that
+    was attributed twice (violating solve + forensic audit of the same
+    rotted entry) can therefore never mask a later injection that went
+    completely undetected. The flip side of the contract: scenario
+    authors must keep injections attributable (two rules rotting the
+    SAME buffer in one probe yield one detection and read as a miss —
+    the judge errs loud)."""
+    from ..integrity import INTEGRITY
+    final = INTEGRITY.detections()
+    detected = final - det0
+    injected = sum(1 for _t, kind, _d in plan.timeline
+                   if kind == "corruption")
+    stats["corruptions_injected"] = float(injected)
+    stats["corruptions_detected"] = float(detected)
+    if wd is not None and wd.armed:
+        # close the race between the last violation and the judgment —
+        # the same forced final evaluation the generic cross-check does
+        wd.tick(force=True)
+    pre = list(plan._corruption_pre)
+    if len(pre) == injected and injected > 0:
+        k = injected
+        undetected = max((k - i) - (final - p)
+                         for i, p in enumerate(pre))
+        if undetected > 0:
+            violations.append(
+                f"{undetected} of {injected} injected corruption(s) "
+                f"went undetected by the integrity plane")
+    elif injected > detected:  # pre-count ledger incomplete (a restart
+        # rebuilt hooks mid-fire): fall back to the aggregate bound
+        violations.append(
+            f"{injected - detected} of {injected} injected corruption(s) "
+            f"went undetected by the integrity plane")
+    if injected == 0 and detected > 0:
+        violations.append(
+            f"{detected} integrity violation(s) on a corruption-free run "
+            f"— the zero-false-positive contract broke")
+    if detected > 0 and wd is not None and wd.armed \
+            and not wd.fired("integrity_breach"):
+        violations.append(
+            "watchdog blind spot: integrity violations detected but the "
+            "integrity_breach monitor never fired")
 
 
 def state_hash(sim) -> str:
@@ -249,7 +325,7 @@ class ScenarioRunner:
         sim, plan = self.build()
         sc = self.scenario
         t0 = sim.clock.now()
-        horizon = self._fault_horizon(plan)
+        horizon = max(self._fault_horizon(plan), sc.horizon)
 
         def quiet() -> bool:
             if sim.clock.now() - plan.origin < horizon:
@@ -262,7 +338,10 @@ class ScenarioRunner:
                     return False
             return not len(sim.cloud.interruptions)
 
-        with device_fault_hook(plan):
+        from ..integrity import INTEGRITY
+        det0 = INTEGRITY.detections()
+        with scenario_env(sc.env), device_fault_hook(plan), \
+                corruption_fault_hook(plan):
             converged = sim.engine.run_until(quiet, timeout=sc.timeout,
                                              step=sc.step)
         violations = check_invariants(sim)
@@ -288,6 +367,9 @@ class ScenarioRunner:
                 violations.append(
                     f"warm-path auditor diverged "
                     f"{wp.stats['divergences']} time(s)")
+        _integrity_judgment(plan, det0,
+                            getattr(sim, "watchdog", None), violations,
+                            stats)
         _watchdog_cross_check(sim, violations)
         report = ScenarioReport(
             scenario=sc.name, seed=self.seed, converged=converged,
@@ -387,7 +469,7 @@ class RestartRunner:
         sc = self.scenario
         t0 = sim.clock.now()
         deadline = t0 + sc.timeout
-        horizon = ScenarioRunner._fault_horizon(plan)
+        horizon = max(ScenarioRunner._fault_horizon(plan), sc.horizon)
         self.restarts = 0
 
         def quiet() -> bool:
@@ -404,8 +486,11 @@ class RestartRunner:
                 return False
             return not len(sim.cloud.interruptions)
 
+        from ..integrity import INTEGRITY
+        det0 = INTEGRITY.detections()
         converged = False
-        with device_fault_hook(plan), crash_point_hook(plan):
+        with scenario_env(sc.env), device_fault_hook(plan), \
+                corruption_fault_hook(plan), crash_point_hook(plan):
             while True:
                 remaining = deadline - sim.clock.now()
                 if remaining <= 0:
@@ -441,6 +526,9 @@ class RestartRunner:
                     f"warm-path auditor diverged "
                     f"{sim.warmpath.stats['divergences']} time(s) "
                     f"post-restart")
+        _integrity_judgment(plan, det0,
+                            getattr(sim, "watchdog", None), violations,
+                            stats)
         # only the FINAL boot's watchdog survives — findings from
         # pre-crash stacks died with their process, so the cross-check
         # leans on the forced final evaluation (persisting conditions —

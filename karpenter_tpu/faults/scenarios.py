@@ -22,8 +22,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
-from .plan import (ApiFault, ClockJump, CrashPoint, DeviceFault, IceWindow,
-                   InterruptionBurst)
+from .plan import (ApiFault, ClockJump, CorruptionFault, CrashPoint,
+                   DeviceFault, IceWindow, InterruptionBurst)
 
 
 @dataclass(frozen=True)
@@ -47,6 +47,15 @@ class Scenario:
     # the stack on the surviving cloud/clock/journal and re-lists the
     # workload); ScenarioRunner cannot run these
     restart: bool = False
+    # env overrides applied for the duration of the run (the corruption
+    # scenarios tighten the integrity plane's audit cadence this way);
+    # the runner restores the previous values on exit
+    env: Optional[dict] = None
+    # minimum run-relative sim time the run must stay open, merged with
+    # the fault plan's own horizon — workload-driven scenarios whose
+    # arrival waves outlast their last rule's `at` set this so quiet()
+    # cannot converge before the late waves land
+    horizon: float = 0.0
 
 
 # --- workloads -------------------------------------------------------------
@@ -216,6 +225,60 @@ _register(Scenario(
     workload=_plain(12),
     backend="device",
     timeout=300.0))
+
+_register(Scenario(
+    name="sdc_storm",
+    description="Silent data corruption in staged solve buffers: seeded "
+                "zero-row and bit-flip rules corrupt the device-resident "
+                "request matrix — once at t=0 and again mid-run against "
+                "a warm-serving cluster (no exception, no fault signal). "
+                "Every injection must be caught by the feasibility "
+                "oracle BEFORE its placements commit, quarantine must "
+                "degrade only this facade's device path (host re-solve "
+                "recovers the reconcile), and the run must converge with "
+                "100% detection, zero invariant violations, and a "
+                "repeating end-hash/fingerprint pair.",
+    build_rules=lambda: [
+        # each rule fires on its first eligible resident-gbuf upload at
+        # or after `at` — the second hits whatever cold solve the
+        # mid-run waves escalate, corrupting a buffer the warm window
+        # was actively serving around
+        CorruptionFault(target="resident", kind="zero_row", nth=1,
+                        key_contains="gbuf"),
+        CorruptionFault(target="resident", kind="bitflip", nth=1, at=20.0,
+                        key_contains="gbuf"),
+    ],
+    workload=_waves(*[(10.0 * i, 8, f"p{i}") for i in range(8)]),
+    backend="device",
+    timeout=900.0,
+    horizon=80.0,
+    # the audit cadence is the backstop for an injection no later cold
+    # solve consumes (warm windows absorb steady arrivals)
+    env={"KARPENTER_TPU_INTEGRITY_AUDIT": "4"}))
+
+_register(Scenario(
+    name="resident_rot",
+    description="Device-resident catalog rot: a stale-patch rule rots "
+                "an allocatable row at first upload (over-capacity "
+                "placements the oracle must catch), then — after the "
+                "quarantine's cooldown re-seeds the catalog — a bit-flip "
+                "rots a price row whose damage is behaviorally SILENT "
+                "(feasible placements, wrong cost): the per-row digest "
+                "audit must catch what the per-solve oracle cannot, "
+                "invalidate the entry, and escalate the facade to the "
+                "host backend; 100% detection, zero false findings "
+                "after recovery.",
+    build_rules=lambda: [
+        CorruptionFault(target="resident", kind="stale_patch", nth=1,
+                        key_contains="alloc"),
+        CorruptionFault(target="resident", kind="bitflip", nth=1, at=20.0,
+                        key_contains="price"),
+    ],
+    workload=_waves(*[(10.0 * i, 8, f"p{i}") for i in range(8)]),
+    backend="device",
+    timeout=900.0,
+    horizon=80.0,
+    env={"KARPENTER_TPU_INTEGRITY_AUDIT": "2"}))
 
 _register(Scenario(
     name="clock_skew",

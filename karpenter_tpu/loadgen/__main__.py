@@ -73,7 +73,11 @@ def main(argv=None) -> int:
                     help="shared solver backend (host | native | device "
                          "| hybrid | mesh)")
     ap.add_argument("--batch", action="store_true",
-                    help="arm the service's batched+pipelined dispatch")
+                    help="arm the service's batched+pipelined dispatch "
+                         "(soak_smoke/soak_overload default to it)")
+    ap.add_argument("--no-batch", action="store_true",
+                    help="escape hatch: force the serial pump even for "
+                         "scenarios that default to batched dispatch")
     ap.add_argument("--no-admission", action="store_true",
                     help="disarm shedding/deferral — the negative "
                          "harness: the watchdog's overload_unbounded "
@@ -90,7 +94,8 @@ def main(argv=None) -> int:
     failed = run_matrix(args.scenario, seeds, repeat=args.repeat,
                         tenants=args.tenants or None,
                         backend=args.backend,
-                        batch=args.batch or None,
+                        batch=(False if args.no_batch
+                               else (args.batch or None)),
                         arrival_rate=args.arrival_rate or None,
                         duration=args.duration or None,
                         admission=False if args.no_admission else None)

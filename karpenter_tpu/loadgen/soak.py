@@ -443,6 +443,11 @@ _register(SoakScenario(
     arrival_rate=0.5,
     duration=30.0,
     drain=300.0,
+    # batched+pipelined dispatch is the DEFAULT serving engine now that
+    # batch parity is pinned (PR 9/12 follow-up): results, waits, and
+    # all three repeat-contract digests are identical to the serial
+    # pump by construction — `--no-batch` is the escape hatch
+    batch=True,
     analyze=_smoke_analyze))
 
 _register(SoakScenario(
@@ -462,6 +467,7 @@ _register(SoakScenario(
     defer_depth=24,
     shed_depth=60,
     max_defers=4,
+    batch=True,
     analyze=_overload_analyze))
 
 _register(SoakScenario(

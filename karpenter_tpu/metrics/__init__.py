@@ -526,8 +526,22 @@ OPTIMIZER_SUBSETS = REGISTRY.counter(
 FAULTS_INJECTED = REGISTRY.counter(
     "karpenter_tpu_faults_injected_total",
     "Faults injected by an armed faults.FaultPlan, by kind (ice, api, "
-    "clock_jump, device, interruption — burst flavor, incl. kills, is in "
-    "the timeline detail) — zero in production: the hooks are no-ops "
-    "unless a plan is installed", ("kind",))
+    "clock_jump, device, interruption, corruption, crash — burst flavor, "
+    "incl. kills, is in the timeline detail) — zero in production: the "
+    "hooks are no-ops unless a plan is installed", ("kind",))
+INTEGRITY_VERDICTS = REGISTRY.counter(
+    "karpenter_tpu_integrity_verdicts_total",
+    "Solution-integrity plane verdicts (karpenter_tpu/integrity/), by "
+    "check and outcome: 'ok' = the check passed (the oracle meters one "
+    "aggregate ok per validated solve under check='oracle'; canary and "
+    "resident-audit passes meter under their own check names), "
+    "'violation' = an infeasible placement, a canary cost disagreement, "
+    "or a resident-row digest mismatch — each violation quarantines the "
+    "affected facade's device path and recovers through the host "
+    "backend, 'unrecovered' = the fallback re-solve still failed the "
+    "oracle (a host/encode bug, never silent). Nonzero violations on a "
+    "healthy run are the zero-false-positive contract breaking; the "
+    "watchdog's integrity_breach invariant pages on them",
+    ("check", "outcome", "tenant"), label_defaults=_TENANT)
 
 __all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram"]

@@ -64,10 +64,11 @@ MIN_BASELINE = 2      # metrics with fewer comparable samples inform only
 # metric-name direction classification; keys matching neither are
 # informational (counts, booleans, ids) and never gate
 _LOWER_BETTER = re.compile(
-    r"(_ms|_ms_p\d+|headline_ms|_bytes|_watermark\w*)$")
+    r"(_ms|_ms_p\d+|headline_ms|_bytes|_watermark\w*|_overhead_frac)$")
 _HIGHER_BETTER = re.compile(
     r"(_per_sec|_speedup|_vs_serial(_persistent)?|hit_rate|vs_baseline|"
-    r"_cover(age)?|kernel_vs_native_cpp|pods_per_sec|_savings_total)$")
+    r"_cover(age)?|kernel_vs_native_cpp|pods_per_sec|_savings_total|"
+    r"_detection_rate)$")
 # informational regardless of suffix: the upload-redundancy fraction is
 # a MEASUREMENT of delta-upload headroom, not a performance quantity —
 # a workload-mix change moving it must never fail the gate in either
@@ -78,8 +79,14 @@ _HIGHER_BETTER = re.compile(
 # WORKLOAD property too — the scenario chooses how far past saturation
 # it drives, so neither direction is a code regression; the gated soak
 # quantities are the `*_arrivals_per_sec` throughput keys (higher-better
-# via the `_per_sec` rule below).
-_NEVER_GATES = re.compile(r"(_redundant_frac|_rows_frac|_shed_frac)$")
+# via the `_per_sec` rule below). `integrity_*_total` keys are verdict
+# COUNTS (how many checks ran/violated in a regime) — workload-shaped,
+# informational; the gated integrity quantities are
+# `c3_integrity_overhead_frac` (lower-better: the oracle's share of
+# solve wall) and `c15_sdc_detection_rate` (higher-better: injected
+# corruptions caught).
+_NEVER_GATES = re.compile(
+    r"(_redundant_frac|_rows_frac|_shed_frac|integrity_\w*_total)$")
 
 
 def metric_direction(key: str) -> Optional[str]:

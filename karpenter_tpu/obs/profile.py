@@ -69,6 +69,8 @@ PHASES: Tuple[str, ...] = (
     #                     batched tournament + relaxation dispatch
     "optimizer_verify",  # disruption optimizer: exact Solver.solve()
     #                     verification of ranked subsets
+    "integrity",        # solution-integrity plane: feasibility oracle,
+    #                     canary dual-path re-solves, resident audits
     "reconcile_other",  # controller pass glue outside the seams above
 )
 
@@ -114,6 +116,7 @@ _SPAN_PHASE: Dict[str, str] = {
     "restart.adopt": "reconcile_other",
     "optimizer.search": "optimizer_search",
     "optimizer.verify": "optimizer_verify",
+    "integrity.verify": "integrity",
 }
 
 COVERAGE_TARGET = 0.99

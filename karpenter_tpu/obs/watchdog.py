@@ -112,6 +112,7 @@ INVARIANTS: Tuple[str, ...] = (
     "resident_staleness",
     "overload_unbounded",
     "optimizer_divergence",
+    "integrity_breach",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -130,6 +131,7 @@ _VIOLATION_MAP: Tuple[Tuple[str, str], ...] = (
     ("intent(s) still open", "intent_age"),
     ("auditor diverged", "warm_divergence"),
     ("unbounded backlog", "overload_unbounded"),
+    ("integrity violation", "integrity_breach"),
 )
 
 
@@ -267,6 +269,10 @@ class Watchdog:
         # optimizer divergence: per-tenant reject-streak baseline at arm
         # (pre-arm residue from another run never counts here)
         self._optimizer_base: Dict[str, int] = {}
+        # integrity breaches: per-tenant violation-counter baseline at
+        # arm — counter-delta based like the optimizer monitor, so
+        # another run's violations never page this one
+        self._integrity_base: Dict[str, int] = {}
 
     # --- arming -----------------------------------------------------------
     def arm(self, now: Optional[float] = None) -> "Watchdog":
@@ -297,6 +303,8 @@ class Watchdog:
         self._resident_base = frozenset(s["key"] for s in RESIDENT.stale())
         from ..optimizer.stats import OPTIMIZER
         self._optimizer_base = dict(OPTIMIZER.reject_streaks())
+        from ..integrity import INTEGRITY
+        self._integrity_base = dict(INTEGRITY.violations_by_tenant())
         register_debug_route("/debug/watchdog",
                              lambda wd, query: wd.payload(query),
                              owner=self)
@@ -349,6 +357,7 @@ class Watchdog:
         self._check_resident(now, fired)
         self._check_overload(now, fired)
         self._check_optimizer(now, fired)
+        self._check_integrity(now, fired)
         if self._last_sweep is None or force \
                 or now - self._last_sweep >= self.CLOUD_SWEEP:
             self._last_sweep = now
@@ -771,6 +780,30 @@ class Watchdog:
                 # is a fresh streak, not the old one plus noise
                 if streak == 0:
                     self._optimizer_base.pop(tenant, None)
+
+    def _check_integrity(self, now: float, fired: List[Finding]) -> None:
+        """The solution-integrity plane's violation counters as a page:
+        a tenant whose oracle/canary/resident-audit violation count
+        advanced since arm fires a critical finding (an answer the
+        system was about to ship was provably wrong — the recovery path
+        contains it, the page says it happened). Counter-delta based;
+        the excursion clears once no new violations arrive and every
+        past one was recovered (the host re-solve passed the oracle) —
+        an UNRECOVERED violation holds the verdict critical."""
+        from ..integrity import INTEGRITY
+        cur = INTEGRITY.violations_by_tenant()
+        for tenant, count in cur.items():
+            delta = count - self._integrity_base.get(tenant, 0)
+            if delta > 0:
+                self._fire(fired, "integrity_breach", "critical", tenant,
+                           f"tenant {tenant}: {delta} solution-integrity "
+                           f"violation(s) since the last excursion — a "
+                           f"device-path answer failed the feasibility "
+                           f"oracle / canary / resident audit", now,
+                           tenant=tenant, violations=delta)
+                self._integrity_base[tenant] = count
+            elif INTEGRITY.unrecovered(tenant) == 0:
+                self._clear("integrity_breach", tenant)
 
     # --- firing / clearing ------------------------------------------------
     def _fire(self, fired: List[Finding], invariant: str, severity: str,

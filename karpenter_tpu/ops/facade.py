@@ -359,8 +359,15 @@ class Solver:
         # per rerouted solve, so the device path is re-probed after
         # FALLBACK_COOLDOWN solves (count-based, hence sim-deterministic)
         self._device_suspended = 0
+        # solution-integrity plane (karpenter_tpu/integrity/): the canary
+        # sampler and the resident-audit cadence counter are per facade,
+        # so quarantine only ever degrades the affected tenant's path
+        self._canary = None
+        self._integrity_solves = 0
         self.stats: Dict[str, int] = {"catalog_rebuilds": 0,
-                                      "device_fallbacks": 0}
+                                      "device_fallbacks": 0,
+                                      "integrity_violations": 0,
+                                      "integrity_recoveries": 0}
 
     @staticmethod
     def _accel_attached() -> bool:
@@ -895,6 +902,14 @@ class Solver:
                                exemplar=TRACER.current_trace_id())
         SOLVE_PODS.observe(float(enc.counts.sum()))
 
+        # solution-integrity oracle: every SolveResult — serial, a
+        # batched row, or a warm-window cold pass — is validated here
+        # BEFORE anything decodes into launches/nominations. A violation
+        # quarantines this facade's device path and recovers the solve
+        # through the fallback backend; KARPENTER_TPU_INTEGRITY=0 makes
+        # this a single env check (today's path byte-for-byte)
+        result, backend = self._verify_integrity(prep, result, backend)
+
         out = self._decode(cat, enc, result, prep.nodepool, prep.dropped)
         out = self._merge_plan(out, prep.plan, cat, prep.nodepool)
         # decision provenance: per-pod placement records + the constraint
@@ -941,6 +956,198 @@ class Solver:
         out.unschedulable = [k for k in out.unschedulable
                              if k not in retried] + second.unschedulable
         return out
+
+    # --- solution-integrity plane (karpenter_tpu/integrity/) --------------
+    def _verify_integrity(self, prep: PreparedSolve, result: SolveResult,
+                          backend: str):
+        """Feasibility oracle + canary + resident audit for one solve.
+        Returns the (possibly recovered) (result, backend). Read-only on
+        the happy path; a violation re-runs the solve on the fallback
+        backend and suspends the device path (the same never-wrong-twice
+        suspension a mid-solve device fault earns)."""
+        from ..integrity import integrity_enabled
+        if not integrity_enabled() or prep.enc is None:
+            return result, backend
+        from ..integrity import (CanarySampler, INTEGRITY, audit_every,
+                                 verify_result)
+        sp = (TRACER.span("integrity.verify", backend=backend)
+              if TRACER.enabled else NOOP_SPAN)
+        with sp:
+            violations = verify_result(prep.cat, prep.enc, result)
+            device_backed = backend in ("device", "mesh")
+            if not violations and device_backed and not prep.existing:
+                if self._canary is None:
+                    self._canary = CanarySampler()
+                if self._canary.due():
+                    violations = self._canary.check(prep.cat, prep.enc,
+                                                    result)
+            # resident-state digest audit, on a deterministic per-facade
+            # cadence: corruption found there taints THIS solve too (its
+            # inputs came off those buffers), so it recovers like an
+            # oracle violation
+            self._integrity_solves += 1
+            every = audit_every()
+            audit_violations = []
+            if (device_backed and every > 0
+                    and self._integrity_solves % every == 0):
+                audit_violations = self._audit_resident_state()
+            if not violations and not audit_violations:
+                INTEGRITY.record_ok()
+                sp.set(outcome="ok")
+                return result, backend
+            # breach accounting: the violating SOLVE is one context,
+            # and each corrupt resident ENTRY is its own — a single
+            # audit pass catching two rotted buffers must count as two
+            # detections against two injected corruptions
+            if violations:
+                INTEGRITY.record_breach_event()
+            for _ in audit_violations:
+                INTEGRITY.record_breach_event()
+            violations += audit_violations
+            self.stats["integrity_violations"] += len(violations)
+            for vio in violations:
+                INTEGRITY.record_violation(vio.check, vio.detail)
+            import logging
+            logging.getLogger("karpenter_tpu.integrity").warning(
+                "integrity violation on %s-backed solve (%s) — "
+                "quarantining the device path and recovering on the "
+                "fallback backend",
+                backend, "; ".join(str(v) for v in violations[:4]))
+            sp.set(outcome="violation",
+                   checks=",".join(sorted({v.check for v in violations})))
+            if not device_backed:
+                # the host/native result IS the ground truth path: there
+                # is no better oracle to recover through — surface the
+                # violation loudly (unrecovered outcome + watchdog
+                # breach) and ship what we have
+                INTEGRITY.record_recovery(False)
+                return result, backend
+            # forensic audit BEFORE the quarantine wipes the evidence:
+            # a violating device solve may have consumed MORE rotted
+            # buffers than the one that tripped the oracle (or than the
+            # bounded cadence slice covered), and each corrupt entry is
+            # its own breach context — invalidating everything first
+            # would erase the attribution. Runs even when the cadence
+            # audit already found entries: the manager drops corrupt
+            # entries on detection, so the sweep only ever reports
+            # NEW rot, never double-counts.
+            for vio in self._audit_resident_state(full=True):
+                INTEGRITY.record_breach_event()
+                INTEGRITY.record_violation(vio.check, vio.detail)
+                self.stats["integrity_violations"] += 1
+            self._integrity_quarantine(prep, backend)
+            fallback = self._fallback_backend(prep.cat)
+            if fallback == "native":
+                from .native import solve_native
+                recovered = solve_native(prep.cat, prep.enc, prep.existing)
+            else:
+                recovered = solve_host(prep.cat, prep.enc, prep.existing)
+            still = verify_result(prep.cat, prep.enc, recovered)
+            INTEGRITY.record_recovery(not still)
+            if still:
+                for vio in still:
+                    INTEGRITY.record_violation(vio.check, vio.detail)
+                logging.getLogger("karpenter_tpu.integrity").error(
+                    "fallback re-solve STILL fails the oracle (%s) — "
+                    "encode-level defect, shipping the host result",
+                    "; ".join(str(v) for v in still[:4]))
+            else:
+                self.stats["integrity_recoveries"] += 1
+            sp.set(recovered_backend=fallback)
+            return recovered, fallback
+
+    def _audit_resident_state(self, full: bool = False):
+        """Digest-audit this facade's device-resident views (and the
+        shared catalog entries it may be consuming). Corrupt entries are
+        dropped by the manager; the caller treats any finding as a
+        violation of the in-flight solve. `full` lifts the per-pass row
+        bound — the forensic sweep a violating solve triggers must cover
+        every entry, not a round-robin slice."""
+        from ..integrity import AUDIT_ROWS, INTEGRITY, Violation
+        from .resident import RESIDENT
+        if not RESIDENT.armed:
+            return []
+        rows = None if full else AUDIT_ROWS
+        rep = RESIDENT.audit(("facade", id(self)), max_rows=rows)
+        shared = RESIDENT.audit(("dcat",), max_rows=rows)
+        corrupt = list(rep["corrupt"]) + list(shared["corrupt"])
+        INTEGRITY.record_audit(rep["rows"] + shared["rows"], len(corrupt))
+        return [Violation("resident_audit",
+                          f"resident row digests diverged on "
+                          f"{'/'.join(str(t) for t in key)}")
+                for key in corrupt]
+
+    def _integrity_quarantine(self, prep: PreparedSolve,
+                              backend: str) -> None:
+        """Contain a device-path integrity violation: drop every device
+        buffer this facade could have consumed (its resident views, its
+        cached DeviceCatalogs, and the shared content-token variants of
+        the offending view) and suspend the device path for the standard
+        cooldown — only THIS facade degrades; co-tenants' paths are
+        untouched until their own checks say otherwise."""
+        from ..metrics import SOLVER_FALLBACKS
+        tok = prep.cat.cache_token if prep.cat is not None else None
+        self._quarantine_device_state(tok)
+        SOLVER_FALLBACKS.inc(from_backend=backend,
+                             to_backend=self._fallback_backend(prep.cat))
+        self.stats["device_fallbacks"] += 1
+
+    def _quarantine_device_state(self, tok=None) -> None:
+        """The backend-independent half of the quarantine: drop this
+        facade's resident views and cached DeviceCatalogs (both may
+        reference corrupted buffers), release the shared content-token
+        variants of the offending view, and suspend the device path for
+        the standard never-wrong-twice cooldown."""
+        from ..metrics import DEGRADED_MODE
+        from .resident import RESIDENT
+        RESIDENT.invalidate(("facade", id(self)), reason="corruption")
+        # cached DeviceCatalogs may still reference a corrupted resident
+        # buffer — the cache entries must die with the entries
+        if self._dcat_cache:
+            from ..metrics import DCAT_EVICTIONS
+            for _ in range(len(self._dcat_cache)):
+                DCAT_EVICTIONS.inc(reason="integrity")
+            self._dcat_cache.clear()
+        if tok and tok[0] == "shared":
+            from .solver import release_shared_views
+            release_shared_views(tuple(tok[:2]))
+        self._device_suspended = self.FALLBACK_COOLDOWN
+        DEGRADED_MODE.set(1, component="solver")
+
+    def warm_integrity_tick(self) -> int:
+        """Advance the per-facade audit cadence by one verified commit.
+        Cold solves tick inside _verify_integrity; warm admissions tick
+        here — without this, a fleet whose arrivals the warm path fully
+        absorbs would audit its device-resident state exactly once per
+        catalog epoch, and resident rot could sit undetected until the
+        next cold solve consumed it. Findings quarantine this facade's
+        device path (the rotted entries are already invalidated by the
+        audit itself) and return the corrupt-entry count; the warm
+        result being judged is host-computed and stays shipped."""
+        from ..integrity import INTEGRITY, audit_every, integrity_enabled
+        if not integrity_enabled():
+            return 0
+        self._integrity_solves += 1
+        every = audit_every()
+        if every <= 0 or self._integrity_solves % every:
+            return 0
+        violations = self._audit_resident_state()
+        if not violations:
+            return 0
+        self.stats["integrity_violations"] += len(violations)
+        for vio in violations:
+            INTEGRITY.record_breach_event()
+            INTEGRITY.record_violation(vio.check, vio.detail)
+        import logging
+        logging.getLogger("karpenter_tpu.integrity").warning(
+            "resident-state audit found %d corrupt device entr%s during "
+            "a warm window — quarantining this facade's device path",
+            len(violations), "y" if len(violations) == 1 else "ies")
+        self._quarantine_device_state()
+        # the corruption never reached a shipped answer (the audit got
+        # there first) — that IS the recovery
+        INTEGRITY.record_recovery(True)
+        return len(violations)
 
     def _meter_encode_rows(self, enc_ctx) -> None:
         """Refresh the resident-rows gauge after ANY cached encode —

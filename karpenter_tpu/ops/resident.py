@@ -100,7 +100,7 @@ MAX_ENTRIES = 512
 
 FALLBACK_REASONS: Tuple[str, ...] = (
     "first_sight", "token_change", "shape_change", "dtype_change",
-    "dense", "invalidated",
+    "dense", "invalidated", "corruption",
 )
 
 
@@ -170,6 +170,8 @@ class ResidentStateManager:
         # re-upload = one counter increment, never invalidated AND
         # first_sight for the same event)
         self._pending_reason: Dict[tuple, str] = {}
+        # integrity audit round-robin cursors, per audited prefix
+        self._audit_cursor: Dict[tuple, int] = {}
         self.max_entries = max_entries
         self.stats: Dict[str, int] = {
             "patches": 0, "full_uploads": 0, "clean_hits": 0,
@@ -219,17 +221,21 @@ class ResidentStateManager:
                 # invalidation reason, not as a brand-new sighting
                 reason = self._pending_reason.pop(key, reason)
         if reason is not None:
-            return self._full_upload(key, mat, token, shape_class, reason)
+            return self._corruption_seam(
+                key, self._full_upload(key, mat, token, shape_class,
+                                       reason))
         digests = dm.UploadMeter._row_digests(mat.reshape(mat.shape[0], -1))
         changed = np.nonzero(digests != ent.digests)[0]
         rows = int(mat.shape[0])
         row_bytes = mat.nbytes // max(rows, 1)
         if changed.size > rows * PATCH_MAX_FRAC:
-            return self._full_upload(key, mat, token, shape_class, "dense",
-                                     digests=digests)
+            return self._corruption_seam(
+                key, self._full_upload(key, mat, token, shape_class,
+                                       "dense", digests=digests))
         try:
-            return self._patch(ent, mat, digests, changed, row_bytes,
-                               shape_class, donate, token)
+            return self._corruption_seam(
+                key, self._patch(ent, mat, digests, changed, row_bytes,
+                                 shape_class, donate, token))
         except BaseException:
             # a device fault mid-patch (tunnel drop during the row
             # upload or the donated scatter) may have consumed the
@@ -358,6 +364,83 @@ class ResidentStateManager:
             DEVICEMEM_PATCH.inc(float(avoided), outcome="avoided")
         return new_buf
 
+    def _corruption_seam(self, key: tuple, buf):
+        """Chaos seam (faults/plan.CorruptionFault): when the process
+        corruption hook is armed it may return a REPLACEMENT device
+        buffer whose bytes silently diverge from the entry's stored row
+        digests — modeling a bit-flip/rot event the integrity plane must
+        then detect (oracle on the next solve, or the digest audit).
+        Nil-guarded: an unarmed process pays one attribute check."""
+        from . import solver as _ops
+        if _ops._corruption_hook is None:
+            return buf
+        corrupted = _ops._corruption_hook("resident", buf, key)
+        if corrupted is None or corrupted is buf:
+            return buf
+        with self._lock:
+            ent = self._entries.get(key)
+        if ent is not None and ent.buf is buf:
+            # digests deliberately NOT updated: they describe the clean
+            # bytes — exactly the divergence audit() exists to catch
+            ent.buf = corrupted
+        return corrupted
+
+    # --- the integrity plane's digest audit -------------------------------
+    def audit(self, prefix: tuple = (), max_rows: Optional[int] = None,
+              ) -> dict:
+        """Read back device-resident entries under `prefix` and compare
+        their actual row digests against the stored (host-computed)
+        ones. A mismatch is silent data corruption: the entry is dropped
+        (its next acquire re-seeds cold under the 'corruption' fallback
+        reason) and its key is reported. Bounded by `max_rows` with a
+        round-robin cursor so a steady cadence eventually covers every
+        entry without unbounded d2h per call."""
+        from . import solver as _ops
+        n = len(prefix)
+        with self._lock:
+            keys = [k for k in self._entries if k[:n] == prefix]
+            cursor = self._audit_cursor.get(prefix, 0)
+        if not keys:
+            return {"entries": 0, "rows": 0, "corrupt": []}
+        corrupt: List[tuple] = []
+        rows = 0
+        audited = 0
+        order = keys[cursor % len(keys):] + keys[:cursor % len(keys)]
+        for key in order:
+            if max_rows is not None and rows >= max_rows and audited:
+                break
+            with self._lock:
+                ent = self._entries.get(key)
+            if ent is None:
+                continue
+            try:
+                arr = _ops._read(ent.buf)
+            except BaseException:  # noqa: BLE001 — a dead device buffer
+                # is itself a corruption-class event for this entry
+                corrupt.append(key)
+                audited += 1
+                continue
+            audited += 1
+            rows += int(arr.shape[0])
+            digests = dm.UploadMeter._row_digests(
+                np.ascontiguousarray(arr).reshape(arr.shape[0], -1))
+            if digests.shape != ent.digests.shape \
+                    or (digests != ent.digests).any():
+                corrupt.append(key)
+        with self._lock:
+            self._audit_cursor[prefix] = (cursor + audited) % len(keys)
+            for key in corrupt:
+                if self._entries.pop(key, None) is not None:
+                    self._pending_reason[key] = "corruption"
+                    self.stats["invalidations"] += 1
+            self.stats["audits"] = self.stats.get("audits", 0) + 1
+            self.stats["audit_rows"] = (self.stats.get("audit_rows", 0)
+                                        + rows)
+            self.stats["audit_corrupt"] = (
+                self.stats.get("audit_corrupt", 0) + len(corrupt))
+            self._trim_pending()
+        return {"entries": audited, "rows": rows, "corrupt": corrupt}
+
     # --- invalidation -----------------------------------------------------
     def invalidate(self, prefix: tuple, reason: str = "invalidated") -> int:
         """Drop every entry whose KEY starts with `prefix` (a facade's
@@ -372,6 +455,11 @@ class ResidentStateManager:
             for k in victims:
                 del self._entries[k]
                 self._pending_reason[k] = reason
+            # audit cursors die with the views they walked — a dead
+            # facade's cursor would otherwise accumulate forever in a
+            # long-lived fleet process (the _latest-map residue class)
+            for k in [k for k in self._audit_cursor if k[:n] == prefix]:
+                del self._audit_cursor[k]
             self.stats["invalidations"] += len(victims)
             self._trim_pending()
         return len(victims)
@@ -471,6 +559,7 @@ class ResidentStateManager:
             self._entries.clear()
             self._latest.clear()
             self._pending_reason.clear()
+            self._audit_cursor.clear()
             self.stats.update({k: 0 for k in self.stats})
 
 

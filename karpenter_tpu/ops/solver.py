@@ -137,6 +137,30 @@ def set_dispatch_fault_hook(fn) -> None:
     _dispatch_fault_hook = fn
 
 
+# corruption-injection seam (faults/plan.CorruptionFault, armed via
+# faults/injector.corruption_fault_hook): when set, called with
+# (target, device_buffer) immediately after a staged upload — target
+# "gbuf" for non-resident request matrices (serial path and the batched
+# dispatcher's stacked gstack), "resident" for ops/resident.py buffers
+# (consulted there). Returns a replacement buffer (silently corrupted —
+# modeling SDC/bit-rot the integrity plane must detect) or the input
+# unchanged. None (the default) costs one identity check per upload —
+# the zero-overhead-when-disabled contract.
+_corruption_hook = None
+
+
+def set_corruption_hook(fn) -> None:
+    global _corruption_hook
+    _corruption_hook = fn
+
+
+def _maybe_corrupt(target: str, buf):
+    if _corruption_hook is None:
+        return buf
+    out = _corruption_hook(target, buf)
+    return buf if out is None else out
+
+
 def _dispatch_cache_event(key: tuple) -> str:
     """Classify a packed-kernel dispatch as 'hit'/'miss' and count it."""
     from ..metrics import COMPILE_CACHE
@@ -851,7 +875,7 @@ def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
             gbufs.extend([pad] * (Bp - B))
         with dm.attributed(reason="batch_upload", kind="batch_gbuf",
                            shape_class=first.shape_class) as grp:
-            gstack = _put(np.stack(gbufs))
+            gstack = _maybe_corrupt("gbuf", _put(np.stack(gbufs)))
             conf = None
             if track:
                 confs = [_pad_to(_pad_to(r.enc.conflict, Gp, 0), Gp, 1)
@@ -1270,7 +1294,7 @@ def _solve_device_impl(cat: CatalogTensors, enc: EncodedPods,
                 # resident path above spends
                 dm.UPLOADS.observe(("serial", id(dcat), Gp), gbuf_np)
                 with dm.attributed(shape_class=shape_class):
-                    gbuf_dev = _put(gbuf_np)
+                    gbuf_dev = _maybe_corrupt("gbuf", _put(gbuf_np))
                     conflict_dev = _put(conflict_np) if track else None
             sp.set(gbuf_shape=str(tuple(gbuf_dev.shape)),
                    h2d_bytes=transfer_bytes()[0] - b0)

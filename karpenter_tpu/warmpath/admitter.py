@@ -104,6 +104,10 @@ class WarmAdmission:
     want: Dict[str, str]               # pod key -> claim name (audit record)
     passthrough: List[List[Pod]]       # taint-dropped groups -> next pool
     escalated: List[List[Pod]]         # bundles / non-fitting -> full solver
+    # solution-integrity oracle findings on this admission's first-fit
+    # result: >0 means NOTHING was placed (the whole batch escalated to
+    # the full solver) and the engine must force the window cold
+    integrity_violations: int = 0
 
 
 class WarmAdmitter:
@@ -161,6 +165,34 @@ class WarmAdmitter:
             if rem:
                 unsched[g] = rem
         result = SolveResult(nodes=nodes, unschedulable=unsched)
+        # solution-integrity oracle on the warm first-fit, BEFORE any
+        # nomination commits — the same validation finish_solve applies
+        # to cold results (karpenter_tpu/integrity/). A violation here
+        # means the ledger's standing view produced an infeasible
+        # placement: place nothing, escalate the whole batch to the
+        # full solver, and let the engine force the window cold.
+        from ..integrity import integrity_enabled
+        if integrity_enabled():
+            from ..integrity import INTEGRITY, verify_warm_result
+            violations = verify_warm_result(cat, enc, result)
+            INTEGRITY.record_warm(len(violations))
+            # a warm commit advances the facade's resident-audit cadence
+            # too: steady-state fleets are warm-dominated, and device-
+            # resident rot must surface within one audit period, not at
+            # the next (possibly hours-away) cold solve
+            solver.warm_integrity_tick()
+            if violations:
+                INTEGRITY.record_breach_event()
+                for vio in violations:
+                    INTEGRITY.record_violation(vio.check, vio.detail)
+                import logging
+                logging.getLogger("karpenter_tpu.integrity").warning(
+                    "warm-admit integrity violation (%s) — escalating "
+                    "the batch to the full solver",
+                    "; ".join(str(v) for v in violations[:4]))
+                escalated.extend(plain)
+                return WarmAdmission({}, {}, passthrough, escalated,
+                                     integrity_violations=len(violations))
         out = solver._decode(cat, enc, result, pool, [])
 
         by_key = {_key(p): p for g in plain for p in g}

@@ -128,6 +128,11 @@ class WarmPathEngine:
                         self._occ_by_claim.setdefault(
                             claim.name, []).append(p)
                     admitted += len(pods)
+                if adm.integrity_violations:
+                    # the ledger produced a provably infeasible warm
+                    # placement: never-wrong-twice — the window goes
+                    # cold until the next full solve rebuilds it
+                    self.force_cold("integrity-violation")
                 if adm.want:
                     self.auditor.record(
                         pool.name,

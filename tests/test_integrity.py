@@ -1,0 +1,663 @@
+"""Solution-integrity plane (karpenter_tpu/integrity/) — ISSUE 14 gates.
+
+Four load-bearing contracts:
+
+1. **Trip coverage**: every check in the `integrity.CHECKS` taxonomy is
+   tripped by a seeded mutation/corruption (`test_trip_integrity_<check>`,
+   enforced by `make obs-audit`) — an oracle check no corruption can
+   trip would let real SDC ship placements behind a green badge.
+2. **Parity**: `KARPENTER_TPU_INTEGRITY=0` restores today's unverified
+   path byte-for-byte, and the ARMED plane is read-only on the happy
+   path (identical outputs, zero recoveries, zero violations).
+3. **Detection**: seeded fuzz corrupts one device-resident row
+   post-patch; the next solve must either fail the oracle or the
+   resident digest audit must catch it within one audit period — across
+   serial and batched dispatch, 4 seeds — and the shipped (recovered)
+   output must equal a cold solve of the same problem.
+4. **Containment**: a violation quarantines only the affected facade
+   (resident views + cached DeviceCatalogs dropped, device path
+   suspended for the standard cooldown) and recovers through the host
+   backend; the recovery is metered, flight-recorded, and pages the
+   watchdog's `integrity_breach` invariant (covered in
+   tests/test_watchdog.py).
+
+The satellite gates ride along: the optimizer verify-stage fault
+fallback (memo must NOT be poisoned) and the perf-gate direction
+classification for the new bench keys.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.catalog import CatalogProvider
+from karpenter_tpu.catalog.generator import small_catalog
+from karpenter_tpu.integrity import (CHECKS, INTEGRITY, AUDIT_ENV,
+                                     CANARY_ENV, INTEGRITY_ENV,
+                                     CanarySampler, audit_every,
+                                     canary_every, integrity_enabled,
+                                     verify_result, verify_warm_result)
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod, PodAffinityTerm
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.ops import solver as S
+from karpenter_tpu.ops.binpack import solve_host
+from karpenter_tpu.ops.encode import encode_catalog, encode_pods
+from karpenter_tpu.ops.facade import Solver
+from karpenter_tpu.ops.resident import RESIDENT
+
+POOL = NodePool(name="default")
+
+_CPUS = ["100m", "250m", "500m", "1", "2"]
+_MEMS = ["128Mi", "512Mi", "1Gi", "2Gi"]
+
+
+def _drop_shared_dcats():
+    """Evict the token-keyed `_dcat_auto` entries. RESIDENT.reset()
+    orphans any cached shared DeviceCatalog from its resident entries
+    (content tokens survive across tests — same catalog bytes, same
+    token), so a warm cache would serve uploads the audit plane can no
+    longer see and corruption tests would find nothing to corrupt."""
+    for k in [k for k in S._dcat_auto if isinstance(k[0], tuple)]:
+        del S._dcat_auto[k]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """RESIDENT and INTEGRITY are process-global: isolate every test.
+    The flight-recorder ring is swapped per test too (the loadgen-suite
+    discipline): corruption tests land violation markers and slow
+    recovery solves whose residency in the slowest-N ring would evict
+    other suites' evidence."""
+    from karpenter_tpu.obs.tracer import TRACER, FlightRecorder
+    old_ring = TRACER.recorder
+    TRACER.recorder = FlightRecorder(size=old_ring.size)
+    _drop_shared_dcats()
+    RESIDENT.reset()
+    INTEGRITY.reset()
+    yield
+    _drop_shared_dcats()
+    RESIDENT.reset()
+    INTEGRITY.reset()
+    S.set_corruption_hook(None)
+    TRACER.recorder = old_ring
+
+
+def mk_pods(n, prefix="p", gen=0, manifests=4, anti=False):
+    pods = []
+    for i in range(n):
+        s = (i + gen) % manifests
+        kw = dict(requests=Resources.parse(
+            {"cpu": _CPUS[s % len(_CPUS)], "memory": _MEMS[s % len(_MEMS)]}),
+            labels={"app": f"{prefix}-m{s}"})
+        if anti and s % 3 == 0:
+            kw["affinity_terms"] = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": f"{prefix}-m{s}"}, anti=True)]
+        pods.append(Pod(name=f"{prefix}-{gen}-{i}", **kw))
+    return pods
+
+
+def _solved(n=24, anti=False):
+    """A feasible (cat, enc, result) triple off the host oracle path —
+    the mutation target every trip test starts from."""
+    cat = encode_catalog(small_catalog())
+    enc = encode_pods(mk_pods(n, anti=anti), cat)
+    result = solve_host(cat, enc)
+    assert verify_result(cat, enc, result) == [], "fixture must be clean"
+    return cat, enc, result
+
+
+def _checks(violations):
+    return {v.check for v in violations}
+
+
+def _out_tuple(out):
+    return ([(l.instance_type, l.zone, l.capacity_type, l.price,
+              tuple(l.pod_keys), tuple(l.overrides)) for l in out.launches],
+            {k: tuple(v) for k, v in out.existing_placements.items()},
+            tuple(out.unschedulable))
+
+
+def _hosted_pair(result):
+    """(group, node_index) of some real placement in the result."""
+    for ni, node in enumerate(result.nodes):
+        for g, cnt in node.pods_by_group.items():
+            if cnt > 0:
+                return g, ni
+    raise AssertionError("fixture placed nothing")
+
+
+class TestOracleTrips:
+    """One seeded mutation per taxonomy check; each asserts the clean
+    side too (the check fires because of the corruption, not despite
+    it). `make obs-audit` greps for these exact function names."""
+
+    def test_trip_integrity_capacity(self):
+        cat, enc, result = _solved()
+        _, ni = _hosted_pair(result)
+        result.nodes[ni].cum[:] = result.nodes[ni].cum * 1e3 + 1e3
+        assert "capacity" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_compat(self):
+        cat, enc, result = _solved()
+        g, ni = _hosted_pair(result)
+        enc.compat[g, result.nodes[ni].type_idx] = False
+        assert "compat" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_zone(self):
+        cat, enc, result = _solved()
+        g, _ = _hosted_pair(result)
+        enc.allow_zone[g, :] = False
+        assert "zone" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_captype(self):
+        cat, enc, result = _solved()
+        g, _ = _hosted_pair(result)
+        enc.allow_cap[g, :] = False
+        assert "captype" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_conflict(self):
+        cat, enc, result = _solved()
+        g, _ = _hosted_pair(result)
+        conflict = np.zeros((enc.G, enc.G), bool)
+        conflict[g, g] = True  # self-conflict: any host collides
+        enc.conflict = conflict
+        assert "conflict" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_max_per_node(self):
+        cat, enc, result = _solved()
+        # find a node hosting >= 2 pods of one group, cap it below
+        for node in result.nodes:
+            for g, cnt in node.pods_by_group.items():
+                if cnt >= 2:
+                    enc.max_per_node[g] = 1
+                    assert "max_per_node" in _checks(
+                        verify_result(cat, enc, result))
+                    return
+        raise AssertionError("fixture never shared a node")
+
+    def test_trip_integrity_spread(self):
+        cat, enc, result = _solved()
+        # mark two genuinely-hosted groups as zone-anti-affine split
+        # rows: their nodes' zone masks overlap in the small catalog
+        hosted = sorted({g for nd in result.nodes
+                         for g, c in nd.pods_by_group.items() if c > 0})
+        assert len(hosted) >= 2, "fixture needs two groups"
+        a, b = hosted[0], hosted[1]
+        zc = np.zeros((enc.G, enc.G), bool)
+        zc[a, b] = zc[b, a] = True
+        enc.zone_conflict = zc
+        assert "spread" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_offering(self):
+        cat, enc, result = _solved()
+        cat.available[:] = False
+        assert "offering" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_price(self):
+        cat, enc, result = _solved()
+        assert result.launches, "fixture must launch"
+        t, z, c, p = result.launches[0]
+        result.launches[0] = (t, z, c, p * 3 + 1.0)
+        assert "price" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_accounting(self):
+        cat, enc, result = _solved()
+        g, ni = _hosted_pair(result)
+        result.nodes[ni].pods_by_group[g] -= 1  # a pod vanishes
+        assert "accounting" in _checks(verify_result(cat, enc, result))
+
+    def test_trip_integrity_canary(self):
+        cat, enc, result = _solved()
+        # feasible-but-wrong: inflate a launch price (the cost the
+        # device path "paid") — every feasibility check still passes
+        # because the catalog row is mutated to match
+        t, z, c, p = result.launches[0]
+        cat.price[t, z, c] = p * 7 + 3.0
+        result.launches[0] = (t, z, c, p * 7 + 3.0)
+        assert verify_result(cat, enc, result) == []  # oracle is blind
+        violations = CanarySampler.check(cat, enc, result)
+        assert _checks(violations) == {"canary"}
+        assert INTEGRITY.snapshot()["totals"]["canary_disagree"] == 1
+
+    def test_trip_integrity_resident_audit(self):
+        """Corrupt one resident row post-patch: the digest audit flags
+        the entry, drops it, and the next acquire re-seeds under the
+        'corruption' fallback reason."""
+        import jax.numpy as jnp
+        key = ("facade", 1234, "trip", "gbuf", 4)
+        mat = np.arange(24, dtype=np.float32).reshape(4, 6)
+        RESIDENT.upload(key, mat, token=("tok",))
+        clean = RESIDENT.audit(("facade", 1234))
+        assert clean["corrupt"] == [] and clean["rows"] == 4
+        ent = RESIDENT._entries[key]
+        rotten = np.array(ent.buf)
+        rotten[2, :] += 13.0  # SDC: bytes diverge, digests stay stale
+        ent.buf = jnp.asarray(rotten)
+        rep = RESIDENT.audit(("facade", 1234))
+        assert rep["corrupt"] == [key]
+        assert key not in RESIDENT._entries  # invalidated
+        from karpenter_tpu.metrics import RESIDENT_FALLBACKS
+        c0 = RESIDENT_FALLBACKS.sum(reason="corruption")
+        RESIDENT.upload(key, mat, token=("tok",))
+        assert RESIDENT_FALLBACKS.sum(reason="corruption") > c0
+
+    def test_taxonomy_is_fully_tripped(self):
+        """Meta: the CHECKS tuple and this class stay in lock-step (the
+        obs-audit grep enforces the same at the repo level)."""
+        for check in CHECKS:
+            assert hasattr(TestOracleTrips, f"test_trip_integrity_{check}")
+
+
+class TestWarmOracle:
+    def test_warm_result_with_fresh_node_is_violation(self):
+        cat, enc, result = _solved()
+        assert any(nd.existing_name is None for nd in result.nodes)
+        v = verify_warm_result(cat, enc, result)
+        assert "accounting" in _checks(v)
+
+
+class TestParity:
+    """The opt-out gate: disarmed is byte-for-byte today's path; armed
+    is read-only when every check passes."""
+
+    def test_disarmed_restores_classic_path(self, monkeypatch):
+        types = small_catalog()
+        pods = mk_pods(18, anti=True)
+        armed = Solver(CatalogProvider(lambda: types),
+                       backend="device").solve(pods, POOL)
+        monkeypatch.setenv(INTEGRITY_ENV, "0")
+        assert not integrity_enabled()
+        INTEGRITY.reset()
+        disarmed = Solver(CatalogProvider(lambda: types),
+                          backend="device").solve(pods, POOL)
+        assert _out_tuple(armed) == _out_tuple(disarmed)
+        # disarmed = NOTHING moves: no verdicts, no audits, no canaries
+        assert INTEGRITY.snapshot()["totals"] == {}
+
+    def test_armed_happy_path_is_read_only(self):
+        types = small_catalog()
+        pods = mk_pods(18)
+        f = Solver(CatalogProvider(lambda: types), backend="device")
+        out = f.solve(pods, POOL)
+        totals = INTEGRITY.snapshot()["totals"]
+        assert totals["solves_verified"] >= 1
+        assert totals["violations"] == 0
+        assert f.stats["integrity_violations"] == 0
+        assert f._device_suspended == 0
+        cold = Solver(CatalogProvider(lambda: types),
+                      backend="device").solve(pods, POOL)
+        assert _out_tuple(out) == _out_tuple(cold)
+
+
+def _corrupt_one_resident_row(rng, prefix):
+    """Mutate one live row of one resident entry IN PLACE (post-patch
+    SDC: the stored digests keep describing the clean bytes). Returns
+    the corrupted key or None when no entry carries a live row."""
+    import jax.numpy as jnp
+    keys = [k for k in RESIDENT._entries if k[:len(prefix)] == prefix]
+    rng.shuffle(keys)
+    for key in keys:
+        ent = RESIDENT._entries[key]
+        arr = np.array(ent.buf)
+        rows = arr.reshape(arr.shape[0], -1) if arr.ndim > 1 \
+            else arr.reshape(1, -1)
+        live = np.nonzero(rows.any(axis=1))[0]
+        if not live.size:
+            continue
+        r = int(live[rng.randrange(live.size)])
+        if rows.dtype == bool:
+            rows[r] = ~rows[r]
+        elif rows.dtype.itemsize == 4:
+            rows[r:r + 1].view(np.uint32)[:] ^= np.uint32(1 << 30)
+        else:
+            rows[r:r + 1].view(np.uint8)[:] ^= np.uint8(0x40)
+        ent.buf = jnp.asarray(arr)
+        return key
+    return None
+
+
+class TestCorruptionFuzz:
+    """Satellite 3: seeded fuzz — corrupt one resident row post-patch,
+    the next solve must either fail the oracle or the resident audit
+    must catch it within ONE audit period; the shipped output must
+    still equal a cold solve (the recovery path is correct, not just
+    loud). Serial and batched dispatch, 4 seeds each."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_serial_dispatch_detects_and_recovers(self, seed,
+                                                  monkeypatch):
+        monkeypatch.setenv(AUDIT_ENV, "1")  # one audit period = 1 solve
+        rng = random.Random(seed * 9173 + 11)
+        types = small_catalog()
+        f = Solver(CatalogProvider(lambda: types), backend="device")
+        pods = mk_pods(rng.randrange(12, 30), prefix=f"z{seed}",
+                       anti=rng.random() < 0.5)
+        f.solve(pods, POOL)  # seeds the resident views
+        key = _corrupt_one_resident_row(rng, ("facade", id(f)))
+        assert key is not None, "no resident entry to corrupt"
+        det0 = INTEGRITY.detections()
+        out = f.solve(pods, POOL)  # same pods: clean hit, rot persists
+        assert INTEGRITY.detections() > det0, (
+            f"seed {seed}: corruption of {key} went undetected")
+        cold = Solver(CatalogProvider(lambda: types),
+                      backend="device").solve(pods, POOL)
+        assert _out_tuple(out) == _out_tuple(cold), (
+            f"seed {seed}: recovered output diverged from cold truth")
+        # containment: the facade quarantined ITSELF
+        assert f._device_suspended > 0
+        assert RESIDENT.stats["invalidations"] >= 1
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_batched_dispatch_detects_and_recovers(self, seed,
+                                                   monkeypatch):
+        from karpenter_tpu.fleet.service import SolverService
+        from karpenter_tpu.utils.clock import FakeClock
+        monkeypatch.setenv(AUDIT_ENV, "1")
+        rng = random.Random(seed * 7621 + 3)
+        types = small_catalog()
+        svc = SolverService(FakeClock(), backend="device", batch=True)
+        clients = {name: svc.register(name,
+                                      CatalogProvider(lambda: types))
+                   for name in ("t0", "t1")}
+        podsets = {name: mk_pods(rng.randrange(10, 22), prefix=name)
+                   for name in clients}
+        tickets = {n: clients[n].solve_async(p, POOL)
+                   for n, p in podsets.items()}
+        svc.pump()
+        for t in tickets.values():
+            t.result()
+        victim = rng.choice(sorted(clients))
+        prefix = ("facade", id(clients[victim].facade))
+        key = _corrupt_one_resident_row(rng, prefix)
+        if key is None:  # batched gstacks are not resident — fall back
+            key = _corrupt_one_resident_row(rng, ("dcat",))
+        assert key is not None, "no resident entry to corrupt"
+        det0 = INTEGRITY.detections()
+        tickets = {n: clients[n].solve_async(p, POOL)
+                   for n, p in podsets.items()}
+        svc.pump()
+        outs = {n: t.result() for n, t in tickets.items()}
+        assert INTEGRITY.detections() > det0, (
+            f"seed {seed}: corruption of {key} went undetected "
+            f"(batched)")
+        for name, pods in podsets.items():
+            cold = Solver(CatalogProvider(lambda: types),
+                          backend="device").solve(pods, POOL)
+            assert _out_tuple(outs[name]) == _out_tuple(cold), (
+                f"seed {seed} tenant {name}: recovered output diverged")
+
+
+class TestQuarantine:
+    def test_violation_quarantines_only_this_facade(self, monkeypatch):
+        """Two facades share the process; rot in one's resident state
+        must suspend only that facade's device path."""
+        monkeypatch.setenv(AUDIT_ENV, "1")
+        rng = random.Random(5)
+        types = small_catalog()
+        a = Solver(CatalogProvider(lambda: types), backend="device")
+        b = Solver(CatalogProvider(lambda: types), backend="device")
+        pods = mk_pods(16)
+        a.solve(pods, POOL)
+        b.solve(pods, POOL)
+        assert _corrupt_one_resident_row(rng, ("facade", id(a)))
+        a.solve(pods, POOL)
+        assert a._device_suspended > 0
+        assert b._device_suspended == 0
+        b.solve(pods, POOL)  # the neighbor keeps its device path clean
+        assert b.stats["integrity_violations"] == 0
+
+    def test_recovery_meters_and_flight_records(self, monkeypatch):
+        from karpenter_tpu.metrics import INTEGRITY_VERDICTS
+        monkeypatch.setenv(AUDIT_ENV, "1")
+        rng = random.Random(7)
+        types = small_catalog()
+        f = Solver(CatalogProvider(lambda: types), backend="device")
+        pods = mk_pods(16)
+        f.solve(pods, POOL)
+        v0 = INTEGRITY_VERDICTS.sum(outcome="violation")
+        assert _corrupt_one_resident_row(rng, ("facade", id(f)))
+        f.solve(pods, POOL)
+        assert INTEGRITY_VERDICTS.sum(outcome="violation") > v0
+        totals = INTEGRITY.snapshot()["totals"]
+        assert totals["violations"] >= 1
+        assert totals["unrecovered"] == 0
+        # the violation marker landed in the flight-recorder ring
+        from karpenter_tpu.obs.tracer import TRACER
+        names = {s.name for t in TRACER.recorder.slowest()
+                 for s in t.spans}
+        assert "integrity.violation" in names
+
+    def test_warm_tick_audits_and_quarantines(self, monkeypatch):
+        """The warm-path cadence: a warm-dominated facade still audits
+        its resident state; findings suspend the device path without
+        touching the (host-computed) warm admission."""
+        monkeypatch.setenv(AUDIT_ENV, "2")
+        rng = random.Random(9)
+        types = small_catalog()
+        f = Solver(CatalogProvider(lambda: types), backend="device")
+        pods = mk_pods(16)
+        f.solve(pods, POOL)
+        assert _corrupt_one_resident_row(rng, ("facade", id(f)))
+        det0 = INTEGRITY.detections()
+        found = 0
+        for _ in range(2):  # within one audit period (= 2 ticks)
+            found += f.warm_integrity_tick()
+        assert found >= 1
+        assert INTEGRITY.detections() > det0
+        assert f._device_suspended > 0
+        totals = INTEGRITY.snapshot()["totals"]
+        assert totals["recovered"] >= 1  # audit-first IS the recovery
+
+
+class TestCanarySamplerCadence:
+    def test_deterministic_schedule(self, monkeypatch):
+        monkeypatch.setenv(CANARY_ENV, "4")
+        assert canary_every() == 4
+        s = CanarySampler()
+        sched = [s.due() for _ in range(12)]
+        assert sched == [False, False, False, True] * 3
+
+    def test_zero_disables(self, monkeypatch):
+        monkeypatch.setenv(CANARY_ENV, "0")
+        s = CanarySampler()
+        assert not any(s.due() for _ in range(64))
+
+    def test_agreeing_canary_meters_ok(self):
+        cat, enc, result = _solved()
+        assert CanarySampler.check(cat, enc, result) == []
+        totals = INTEGRITY.snapshot()["totals"]
+        assert totals["canary_solves"] == 1
+        assert totals["canary_agree"] == 1
+        assert INTEGRITY.canary_agreement_rate() == 1.0
+
+
+class TestOptimizerFaultFallback:
+    """Satellite 2: a device fault inside the optimizer tournament's
+    VERIFY stage degrades to greedy, meters the fallback, and must NOT
+    poison the fruitless-search memo — a faulted pass proved nothing."""
+
+    def test_verify_fault_not_memoized_as_fruitless(self, monkeypatch):
+        from karpenter_tpu.metrics import SOLVER_FALLBACKS
+        from karpenter_tpu.optimizer import OPTIMIZER_ENV
+        from karpenter_tpu.optimizer.fixtures import build_joint_fleet
+        from karpenter_tpu.sim import make_sim
+        import karpenter_tpu.controllers.disruption as D
+        monkeypatch.setenv(OPTIMIZER_ENV, "1")
+        sim = make_sim(backend="host")
+        build_joint_fleet(sim, tiles=1)
+        fb0 = SOLVER_FALLBACKS.sum(from_backend="optimizer")
+        real = D.DisruptionController._simulate_removal
+        state = {"armed": True}
+
+        def faulty(self, *a, **kw):
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("injected device fault in verify")
+            return real(self, *a, **kw)
+
+        monkeypatch.setattr(D.DisruptionController, "_simulate_removal",
+                            faulty)
+        import karpenter_tpu.optimizer as O
+        real_plan = O.plan_repack
+        searches = []
+
+        def counting_plan(*a, **kw):
+            searches.append(1)
+            return real_plan(*a, **kw)
+
+        monkeypatch.setattr(O, "plan_repack", counting_plan)
+        sim.disruption.reconcile(sim.clock.now())
+        assert SOLVER_FALLBACKS.sum(from_backend="optimizer") > fb0
+        assert sim.disruption.stats.get("optimizer_errors", 0) >= 1
+        assert len(searches) == 1
+        # the memo was NOT poisoned: the pool key is absent, so the
+        # next reconcile RE-RUNS the search (a memoized-fruitless pass
+        # would skip plan_repack entirely — the second test proves the
+        # memo still works when verify genuinely rejects)
+        assert "default" not in sim.disruption._optimizer_noop
+        sim.clock.step(20.0)
+        sim.disruption.reconcile(sim.clock.now())
+        assert len(searches) >= 2, "faulted pass was memoized as fruitless"
+
+    def test_fruitless_pass_without_fault_still_memoizes(self,
+                                                         monkeypatch):
+        """The memo itself stays functional: a pass whose subsets all
+        fail exact verify records the noop key (the regression guard
+        for the fix's other direction)."""
+        from karpenter_tpu.optimizer import OPTIMIZER_ENV
+        from karpenter_tpu.optimizer.fixtures import build_joint_fleet
+        from karpenter_tpu.sim import make_sim
+        import karpenter_tpu.controllers.disruption as D
+        monkeypatch.setenv(OPTIMIZER_ENV, "1")
+        sim = make_sim(backend="host")
+        build_joint_fleet(sim, tiles=1)
+
+        def reject(self, pool, victims, cat, views, ceiling):
+            from karpenter_tpu.ops.binpack import SolveResult
+            return SolveResult(nodes=[], unschedulable={}), False
+
+        monkeypatch.setattr(D.DisruptionController, "_simulate_removal",
+                            reject)
+        sim.disruption.reconcile(sim.clock.now())
+        assert "default" in sim.disruption._optimizer_noop
+
+
+class TestPerfGateClassification:
+    """Satellite 6: the new bench keys classify correctly — the
+    overhead fraction gates lower-better, the detection rate gates
+    higher-better, and raw verdict counts never gate."""
+
+    def test_direction_classification(self):
+        from karpenter_tpu.obs.perfarchive import metric_direction
+        assert metric_direction("c3_integrity_overhead_frac") == "lower"
+        assert metric_direction("c15_sdc_detection_rate") == "higher"
+        assert metric_direction("integrity_verdicts_total") is None
+        assert metric_direction("integrity_violations_total") is None
+        # the neighbors keep their classes (no regex bleed)
+        assert metric_direction("c8_resident_h2d_bytes") == "lower"
+        assert metric_direction("c13_arrivals_per_sec") == "higher"
+
+    def test_overhead_regression_gates(self, tmp_path):
+        """A 3x overhead-fraction jump on a comparable run fails the
+        gate; an identical re-run passes; a detection-rate DROP fails
+        (higher-better)."""
+        from karpenter_tpu.obs.perfarchive import PerfArchive, RunRecord
+
+        def rec(run_id, frac=0.01, rate=1.0):
+            return RunRecord(
+                run_id=run_id, family="bench", source="test",
+                schema_version=1, comparable=True, seed=0,
+                metrics={"c3_integrity_overhead_frac": frac,
+                         "c15_sdc_detection_rate": rate})
+
+        arch = PerfArchive(str(tmp_path / "archive.jsonl"))
+        for i in range(3):
+            arch.append(rec(f"r-{i}"))
+        arch.append(rec("r-same"))
+        same = arch.gate(candidate="r-same")
+        assert not same.regressions, same.regressions
+        arch.append(rec("r-slow", frac=0.03))
+        slow = arch.gate(candidate="r-slow")
+        assert any(v.metric == "c3_integrity_overhead_frac"
+                   for v in slow.regressions)
+        arch.append(rec("r-drop", rate=0.5))
+        drop = arch.gate(candidate="r-drop")
+        assert any(v.metric == "c15_sdc_detection_rate"
+                   for v in drop.regressions)
+
+
+class TestMeterAndDebug:
+    def test_debug_route_serves_snapshot(self):
+        import json
+        from karpenter_tpu.obs.exposition import render
+        INTEGRITY.record_ok(tenant="t7")
+        INTEGRITY.record_violation("capacity", "x", tenant="t7")
+        status, ctype, body = render("/debug/integrity")
+        assert status == 200 and "json" in ctype
+        payload = json.loads(body)
+        assert payload["armed"] is True
+        assert payload["checks"] == list(CHECKS)
+        assert payload["tenants"]["t7"]["violations"] == 1
+        assert payload["totals"]["solves_verified"] == 1
+
+    def test_violations_by_tenant_and_unrecovered(self):
+        INTEGRITY.record_violation("price", "a", tenant="t1")
+        INTEGRITY.record_violation("zone", "b", tenant="t2")
+        INTEGRITY.record_recovery(False, tenant="t2")
+        assert INTEGRITY.violations_by_tenant() == {"t1": 1, "t2": 1}
+        assert INTEGRITY.unrecovered("t2") == 1
+        assert INTEGRITY.unrecovered("t1") == 0
+
+    def test_audit_cadence_env(self, monkeypatch):
+        monkeypatch.setenv(AUDIT_ENV, "3")
+        assert audit_every() == 3
+        monkeypatch.setenv(AUDIT_ENV, "junk")
+        assert audit_every() == 16  # the default survives garbage
+
+
+class TestPerInjectionJudgment:
+    """The runners' detection contract is matched per injection: an
+    early injection attributed twice (violating solve + forensic audit
+    of the same rotted entry) must never mask a later injection that
+    went completely undetected."""
+
+    @staticmethod
+    def _judge(pre, final, injected):
+        from karpenter_tpu.faults.plan import FaultPlan
+        from karpenter_tpu.faults.runner import _integrity_judgment
+        plan = FaultPlan(seed=0, rules=[])
+        plan.timeline = [(float(i), "corruption", f"inj#{i}")
+                         for i in range(injected)]
+        plan._corruption_pre = list(pre)
+        # det0=0; pump the meter so INTEGRITY.detections() == final
+        INTEGRITY.reset()
+        for _ in range(final):
+            INTEGRITY.record_breach_event()
+        violations: list = []
+        _integrity_judgment(plan, 0, None, violations, {})
+        return violations
+
+    def test_double_attribution_cannot_mask_a_miss(self):
+        # injection 1 at pre=0 detected TWICE (final reaches 2), then
+        # injection 2 at pre=2 never detected: aggregate 2>=2 would
+        # pass, the per-injection match must flag exactly one miss
+        v = self._judge(pre=[0, 2], final=2, injected=2)
+        assert v and "1 of 2" in v[0]
+
+    def test_each_injection_detected_once_passes(self):
+        assert self._judge(pre=[0, 1], final=2, injected=2) == []
+
+    def test_overcounted_but_complete_passes(self):
+        # both injections detected, the first twice — loud, not wrong
+        assert self._judge(pre=[0, 2], final=4, injected=2) == []
+
+    def test_incomplete_precount_ledger_falls_back_to_aggregate(self):
+        # a restart rebuilt hooks mid-fire: pre-count ledger short —
+        # the aggregate bound still catches a plain undercount
+        v = self._judge(pre=[0], final=1, injected=2)
+        assert v and "1 of 2" in v[0]

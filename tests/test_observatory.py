@@ -78,6 +78,10 @@ class TestPhaseLedgerMapping:
         ("optimizer.search", {"candidates": 8, "scored": 210},
          "optimizer_search"),
         ("optimizer.verify", {"ranked": 12}, "optimizer_verify"),
+        # solution-integrity plane (karpenter_tpu/integrity/): the
+        # feasibility oracle + canary + resident audit on every solve
+        ("integrity.verify", {"backend": "device", "outcome": "ok"},
+         "integrity"),
         ("reconcile:provisioner", {}, "reconcile_other"),
     ]
 

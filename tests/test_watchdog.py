@@ -63,7 +63,7 @@ class TestArming:
             "pipeline_stall", "profile_unattributed",
             "trace_ring_overflow", "devicemem_leak",
             "resident_staleness", "overload_unbounded",
-            "optimizer_divergence")
+            "optimizer_divergence", "integrity_breach")
 
 
 class TestTrips:
@@ -356,6 +356,46 @@ class TestTrips:
             OPTIMIZER.record_verify(True)
         wd.tick(force=True)
         assert wd.verdict() == "ok"
+
+    def test_trip_integrity_breach(self):
+        """Seeded breach: a solution-integrity violation recorded for a
+        tenant fires a critical finding once (edge-triggered, keyed by
+        the tenant); the excursion clears after recovery, but an
+        UNRECOVERED violation holds the verdict critical. Pre-arm
+        residue (another run's violations) never fires."""
+        from karpenter_tpu.integrity import INTEGRITY
+        INTEGRITY.reset()
+        # pre-arm residue for an unrelated tenant
+        INTEGRITY.record_violation("capacity", "stale-run", tenant="old")
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "integrity_breach")  # residue is quiet
+        # a clean validated solve never fires
+        INTEGRITY.record_ok(tenant="t001")
+        wd.tick(force=True)
+        assert not _findings(wd, "integrity_breach")
+        # a real violation: critical, edge-triggered, tenant-keyed
+        INTEGRITY.record_violation("capacity", "node 0 over", "t001")
+        INTEGRITY.record_recovery(True, tenant="t001")
+        wd.tick(force=True)
+        found = _findings(wd, "integrity_breach")
+        assert found and found[0].severity == "critical"
+        assert found[0].key == "t001"
+        wd.tick(force=True)
+        assert len(_findings(wd, "integrity_breach")) == 1  # edge
+        # recovered + no new violations: the excursion clears
+        wd.tick(force=True)
+        assert wd.verdict() == "ok"
+        # an unrecovered violation (host path failed the oracle too)
+        # holds the verdict critical until it is resolved
+        INTEGRITY.record_violation("price", "host disagrees", "t002")
+        INTEGRITY.record_recovery(False, tenant="t002")
+        wd.tick(force=True)
+        assert _findings(wd, "integrity_breach")
+        wd.tick(force=True)
+        assert wd.verdict() == "critical"
+        INTEGRITY.reset()
 
     def test_overload_jump_absorbed(self):
         """A clock jump over an in-grace excursion must not age it into

@@ -20,7 +20,13 @@ Three invariants that otherwise rot silently:
    device-telemetry tests (tests/test_devicemem.py) — an owner kind
    nothing registers under means a device allocation path fell out of
    the accounting, which is exactly the drift the >=99%-coverage audit
-   exists to catch.
+   exists to catch;
+5. every solution-integrity check name (integrity.CHECKS) has a seeded
+   trip test in tests/test_integrity.py (`def test_trip_integrity_
+   <check>`): a mutated/corrupted input the check must flag — the same
+   mutation-style discipline as the watchdog invariants (which already
+   cover `integrity_breach` via rule 3), because an oracle check no
+   corruption can trip would let real SDC ship placements.
 
 Exit 0 = no drift. Wired into the default verify path (`make test`
 depends on this).
@@ -91,6 +97,20 @@ def audit() -> int:
                 f"transfer reason '{reason}' is in the taxonomy but "
                 f"tests/test_devicemem.py does not exercise it")
 
+    from karpenter_tpu.integrity import CHECKS
+    it_canon = os.path.join(ROOT, "tests", "test_integrity.py")
+    it_tests = open(it_canon).read() if os.path.exists(it_canon) else ""
+    if not it_tests:
+        failures.append("tests/test_integrity.py (the canonical "
+                        "solution-integrity trip tests) is missing")
+    for check in CHECKS:
+        if f"def test_trip_integrity_{check}" not in it_tests:
+            failures.append(
+                f"integrity check '{check}' has no seeded corruption "
+                f"tripping it — tests/test_integrity.py needs a "
+                f"`def test_trip_integrity_{check}` (mutation-style "
+                f"negative coverage)")
+
     if failures:
         print("obs-audit: DRIFT DETECTED")
         for f in failures:
@@ -100,7 +120,8 @@ def audit() -> int:
           f"documented, {len(PHASES)} phase buckets test-covered, "
           f"{len(INVARIANTS)} watchdog invariants trip-covered, "
           f"{len(OWNER_KINDS)} residency owner kinds + "
-          f"{len(TRANSFER_REASONS)} transfer reasons test-covered)")
+          f"{len(TRANSFER_REASONS)} transfer reasons test-covered, "
+          f"{len(CHECKS)} integrity checks trip-covered)")
     return 0
 
 
