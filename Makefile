@@ -7,13 +7,19 @@ PY ?= python
         deflake run native trace-report profile-report obs-audit chaos \
         crash-audit warmpath-audit encode-report fleet fleet-audit \
         perf-gate device-report resident-report soak soak-audit \
-        disrupt-report integrity-report clean
+        disrupt-report integrity-report lint lint-baseline clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
 
-test: obs-audit perf-gate  ## full suite + verification plane (obs drift audit, perf regression gate, slowest-test report)
+test: lint obs-audit perf-gate  ## full suite + verification plane (invariant lint, obs drift audit, perf regression gate, slowest-test report)
 	$(PY) -m pytest tests/ -q --durations=15
+
+lint:  ## graftlint: AST invariant rules (wallclock/rng/donate/seam/finalizer/jit/env) over karpenter_tpu/, stamped JSON artifact, empty-baseline gate
+	$(PY) -m tools.graftlint --artifact graftlint.json
+
+lint-baseline:  ## regenerate tools/graftlint/baseline.json from current findings (the healthy state is EMPTY — prefer fixing or reasoned inline suppressions)
+	$(PY) -m tools.graftlint --write-baseline
 
 e2etests:  ## the e2e slices (sim + subprocess remote cloud)
 	$(PY) -m pytest tests/test_e2e_slice.py tests/test_remote_cloud.py -q

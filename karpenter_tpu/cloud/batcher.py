@@ -65,7 +65,7 @@ class BatchingCloud:
         # needs a replayable run, e.g. one driven by a faults.FaultPlan)
         # pass a seeded Random.
         import random
-        self._rng = rng if rng is not None else random.Random()
+        self._rng = rng if rng is not None else random.Random()  # graftlint: disable=unseeded-rng -- full-jitter MUST be entropic across replicas (a fixed seed puts every backoff in lockstep); deterministic harnesses pass a seeded Random
         self._pending: List[str] = []      # terminate ids, insertion order
         self._pending_set: set = set()
         self._first_at = 0.0

@@ -46,9 +46,14 @@ tests/test_integrity.py is the gate).
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 from typing import Dict, List, Tuple
+
+# flight-recorder marker id sequence (unique within a process; NOT
+# derived from wall time — see IntegrityMeter._flight_record)
+_marker_seq = itertools.count(1)
 
 INTEGRITY_ENV = "KARPENTER_TPU_INTEGRITY"
 # canary cadence: 1 host re-solve per this many verified device solves
@@ -196,12 +201,15 @@ class IntegrityMeter:
     def _flight_record(check: str, detail: str) -> None:
         """integrity.violation marker in the flight-recorder ring —
         works with tracing disabled (direct offer), meter=False so a
-        rejected marker never counts against the overflow meter."""
+        rejected marker never counts against the overflow meter. The
+        timestamp comes from the tracer's injected clock (sim time when
+        a harness configured one) and the trace id from a process-local
+        sequence — a wall-clock-derived id made chaos `--repeat 2`
+        artifacts differ between byte-identical runs."""
         from ..obs.tracer import TRACER, Span, Trace
-        import time as _time
-        ts = _time.time()
+        ts = TRACER.clock()
         marker = Span(name="integrity.violation",
-                      trace_id=f"integrity-{check}-{int(ts * 1e6)}",
+                      trace_id=f"integrity-{check}-{next(_marker_seq)}",
                       span_id=0, parent_id=None, t0=0.0, t1=1e-6,
                       ts=ts, attrs={"check": check, "detail": detail[:400]})
         TRACER.recorder.offer(Trace(trace_id=marker.trace_id,

@@ -30,9 +30,16 @@ class DurationRecorder:
         self._lock = threading.Lock()
 
     def record(self, name: str, seconds: float,
-               dimensions: Optional[Dict[str, str]] = None) -> None:
+               dimensions: Optional[Dict[str, str]] = None,
+               clock=None) -> None:
+        # recorded_at takes the injected clock when one is threaded (sim
+        # runs stamp SIM time, so chaos/scale `--repeat` artifacts are
+        # byte-identical across repeats); wall time is the host-only
+        # fallback for un-clocked callers
+        recorded_at = (clock.now() if clock is not None
+                       else time.time())  # graftlint: disable=wallclock -- explicit fallback for callers with no sim clock; sim paths pass clock=
         evt = {"measure": "duration", "name": name, "seconds": round(seconds, 4),
-               "dimensions": dimensions or {}, "recorded_at": time.time()}
+               "dimensions": dimensions or {}, "recorded_at": recorded_at}
         line = json.dumps(evt) + "\n"  # serialize outside the lock
         with self._lock:
             with open(self.path, "a") as f:
@@ -54,4 +61,4 @@ class DurationRecorder:
             t1 = sim_clock.now() if sim_clock else time.perf_counter()
             dims = {k: str(v) for k, v in dimensions.items()}
             dims["outcome"] = outcome
-            self.record(name, t1 - t0, dims)
+            self.record(name, t1 - t0, dims, clock=sim_clock)

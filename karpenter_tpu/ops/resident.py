@@ -110,8 +110,8 @@ def _jit_scatter():
     def _scatter(buf, idx, rows):
         return buf.at[idx].set(rows)
 
-    donate = partial(jax.jit, donate_argnums=(0,))(_scatter)
-    plain = jax.jit(_scatter)
+    donate = partial(jax.jit, donate_argnums=(0,))(_scatter)  # graftlint: disable=jit-in-hot-path -- built exactly once; _scatter_fn memoizes both variants in module globals
+    plain = jax.jit(_scatter)  # graftlint: disable=jit-in-hot-path -- see above: one-shot construction behind _scatter_fn's None-check memo
     return donate, plain
 
 
@@ -119,9 +119,14 @@ _scatter_donate = None
 _scatter_plain = None
 
 
-def _scatter_fn(donate: bool):
+def _scatter_fn(donate: bool):  # graftlint: donates=0
     """The jitted row scatter; the donating variant only off-CPU (CPU
-    backends warn on donation, same gate as the batched dispatch)."""
+    backends warn on donation, same gate as the batched dispatch).
+    Callers: the returned callable CONSUMES its first argument (the
+    resident buffer) when donating — the `# graftlint: donates=0`
+    annotation above makes the use-after-donate rule track call sites,
+    so a read of the donated buffer between dispatch and rebind fails
+    `make lint`."""
     global _scatter_donate, _scatter_plain
     if _scatter_plain is None:
         _scatter_donate, _scatter_plain = _jit_scatter()
@@ -336,16 +341,20 @@ class ResidentStateManager:
                 idx_dev = _ops._put(changed.astype(np.int32))
                 rows_dev = _ops._put(changed_rows)
             new_buf = _scatter_fn(donate)(ent.buf, idx_dev, rows_dev)
-            # the scatter output replaces the resident buffer inside the
-            # entry's ledger group (the donated input's bytes release
-            # via its finalizer; non-donated catalog patches keep the
+            # the dispatch CONSUMED ent.buf when donating — rebind the
+            # entry to the scatter output IMMEDIATELY so no later
+            # statement can read the dead handle (use-after-donate
+            # contract; the donated input's bytes release via its
+            # finalizer, non-donated catalog patches keep the
             # predecessor alive for whoever still reads it)
+            ent.buf = new_buf
+            # the scatter output replaces the resident buffer inside the
+            # entry's ledger group
             dm.DEVICEMEM.track("resident_state", [new_buf], owner=ent,
                                shape_class=shape_class, group=ent.group)
             sp.set(h2d_bytes=dm.TRANSFERS.totals()[0] - b0)
         dm.UPLOADS.observe(ent.key + ("resident", "patch"),
                            changed_rows.reshape(changed_rows.shape[0], -1))
-        ent.buf = new_buf
         ent.digests = digests
         patched = int(changed.size) * row_bytes
         ent.stats["patches"] += 1

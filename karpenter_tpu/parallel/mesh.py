@@ -38,16 +38,31 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(mesh_devices, ("nodes",))
 
 
+# jitted sharded-solve wrappers, keyed on the (hashable) Mesh + n_max —
+# the bound-cache discipline every other mesh-jit factory in the tree
+# follows (consolidate._mesh_screen_fn, solver._mesh_fn_cache): without
+# it each call built a FRESH jit wrapper, so jax's executable cache
+# missed and every sharded solve retraced (graftlint jit-in-hot-path)
+_sharded_fn_cache: dict = {}
+_SHARDED_FN_CACHE_MAX = 16
+
+
 def sharded_solve_fn(mesh: Mesh, n_max: int):
     """jit the kernel with node-axis sharding over `mesh`; XLA partitions
     the scan body and inserts ICI collectives for cumsum/argmin."""
+    key = (mesh, n_max)
+    fn = _sharded_fn_cache.get(key)
+    if fn is not None:
+        return fn
+    if len(_sharded_fn_cache) >= _SHARDED_FN_CACHE_MAX:
+        _sharded_fn_cache.clear()
     rep = NamedSharding(mesh, P())
     nodes = NamedSharding(mesh, P("nodes"))
 
     prior = NamedSharding(mesh, P(None, "nodes"))
 
     kernel = partial(_solve_kernel, n_max=n_max)
-    return jax.jit(
+    fn = jax.jit(
         kernel,
         in_shardings=(
             rep, rep, rep,            # alloc, price, avail (catalog, replicated)
@@ -65,6 +80,8 @@ def sharded_solve_fn(mesh: Mesh, n_max: int):
         ),
         out_shardings=(nodes, nodes, nodes, nodes, nodes, rep, rep, rep, rep),
     )
+    _sharded_fn_cache[key] = fn
+    return fn
 
 
 def run_sharded_solve(mesh: Mesh, alloc, price, avail, requests, counts,

@@ -18,6 +18,49 @@ def _env_name(flag: str) -> str:
     return flag.upper().replace("-", "_")
 
 
+# Process-environment knobs that live OUTSIDE the Options dataclass —
+# subsystem gates and artifact sinks read directly from os.environ at
+# their use sites (module import order and subprocess scenarios make
+# flag plumbing the wrong seam for these). This registry is the single
+# documentation source: tools/gen_docs.py renders it into
+# docs/reference/settings.md, and graftlint's `undocumented-env` rule
+# fails the build when a KARPENTER_TPU_* literal appears in the package
+# without a row here (docs/static-analysis.md).
+# Rows: (name, default, description).
+ENV_KNOBS: tuple = (
+    ("KARPENTER_TPU_DURATIONS", "<repo>/scale_durations.jsonl",
+     "duration-event JSONL sink for the scale suite "
+     "(metrics/durations.py, the Timestream analog)"),
+    ("KARPENTER_TPU_INTEGRITY", "1",
+     "solution-integrity plane master gate — 0 restores the unverified "
+     "solve path byte-for-byte (integrity/)"),
+    ("KARPENTER_TPU_INTEGRITY_AUDIT", "16",
+     "resident-state digest-audit cadence: one readback audit per this "
+     "many verified solves (0 disables the audit)"),
+    ("KARPENTER_TPU_INTEGRITY_CANARY", "64",
+     "canary dual-path cadence: one host re-solve per this many device "
+     "solves per facade (0 disables the canary)"),
+    ("KARPENTER_TPU_OPTIMIZER", "1",
+     "global disruption optimizer gate — 0 restores greedy "
+     "consolidation byte-for-byte (optimizer/)"),
+    ("KARPENTER_TPU_PALLAS", "0",
+     "opt-in Pallas screen kernel — 1 enables when a TPU backend is "
+     "attached and the probe compiles (ops/pallas_screen.py)"),
+    ("KARPENTER_TPU_PERF_ARCHIVE", "<repo>/perf_archive.jsonl",
+     "cross-run perf archive path the bench appends to and "
+     "`make perf-gate` reads (obs/perfarchive.py)"),
+    ("KARPENTER_TPU_RESIDENT", "1",
+     "device-resident cluster state — 0 disarms the manager and every "
+     "upload falls back to the classic full-upload path (ops/resident.py)"),
+    ("KARPENTER_TPU_TRACE_DIR", "",
+     "when set, the tracer auto-enables and writes traces.jsonl here "
+     "(obs/tracer.py)"),
+    ("KARPENTER_TPU_TRACE_RING", "16",
+     "flight-recorder ring capacity (traces kept in memory for "
+     "post-mortem dumps)"),
+)
+
+
 @dataclass
 class Options:
     cluster_name: str = "karpenter-tpu"

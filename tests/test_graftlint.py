@@ -1,0 +1,610 @@
+"""graftlint: mutation-style coverage for every lint rule (obs-audit
+rule 6 enforces a `test_trip_lint_<rule>` per registered rule), engine
+mechanics (suppressions, baseline, fingerprints, JSON output, AST test
+discovery), and the two real donate-site regressions — a mutant
+re-reading a donated buffer in ops/resident.py or ops/solver.py must
+trip `use-after-donate`.
+
+Each trip test pairs a seeded bad-code snippet the rule MUST flag with a
+clean twin it must NOT — a rule that flags both is noise, a rule that
+flags neither is dead.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.graftlint import (Engine, default_rules, load_baseline,
+                             split_baselined, write_baseline)
+from tools.graftlint.discovery import test_index as index_test_file
+from tools.graftlint.rules import RULE_NAMES
+
+
+def lint(source: str, tmp_path, name: str = "mod.py"):
+    """Lint a source snippet as a standalone module (root stays the repo
+    so docs/reference/settings.md resolves for undocumented-env)."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return Engine(default_rules(), root=ROOT).lint_paths([str(p)])
+
+
+def rules_hit(run):
+    return sorted({f.rule for f in run.findings})
+
+
+# ---------------------------------------------------------------------------
+# rule trips: seeded mutant + clean twin
+# ---------------------------------------------------------------------------
+
+
+def test_trip_lint_wallclock(tmp_path):
+    bad = lint("""
+        import time as _time
+
+        def stamp(evt):
+            evt["at"] = _time.time()
+            return evt
+    """, tmp_path)
+    assert rules_hit(bad) == ["wallclock"]
+
+    clean = lint("""
+        import time
+
+        def stamp(evt, clock):
+            evt["at"] = clock.now()
+            evt["span"] = time.perf_counter()  # durations are fine
+            return evt
+    """, tmp_path)
+    assert rules_hit(clean) == []
+
+
+def test_wallclock_variants_and_allowed_file(tmp_path):
+    bad = lint("""
+        from datetime import datetime
+        import time
+
+        def f():
+            return datetime.now(), time.monotonic()
+    """, tmp_path)
+    assert [f.rule for f in bad.findings] == ["wallclock", "wallclock"]
+    # utils/clock.py is the one sanctioned wall-time source
+    run = Engine(default_rules(), root=ROOT).lint_paths(
+        [os.path.join(ROOT, "karpenter_tpu", "utils", "clock.py")])
+    assert rules_hit(run) == []
+
+
+def test_trip_lint_unseeded_rng(tmp_path):
+    bad = lint("""
+        import random
+
+        def jitter():
+            return random.uniform(0.0, 1.0)
+    """, tmp_path)
+    assert rules_hit(bad) == ["unseeded-rng"]
+
+    bad2 = lint("""
+        import random
+
+        _rng = random.Random()
+    """, tmp_path)
+    assert rules_hit(bad2) == ["unseeded-rng"]
+
+    bad3 = lint("""
+        import numpy as np
+
+        def noise(n):
+            return np.random.rand(n)
+    """, tmp_path)
+    assert rules_hit(bad3) == ["unseeded-rng"]
+
+    # seedless constructors of the SEEDED-capable types are still
+    # entropy-seeded — all three spellings trip
+    bad4 = lint("""
+        import numpy as np
+
+        _a = np.random.default_rng()
+        _b = np.random.RandomState()
+    """, tmp_path)
+    assert [f.rule for f in bad4.findings] == ["unseeded-rng"] * 2
+
+    clean = lint("""
+        import random
+        import numpy as np
+
+        def draws(seed):
+            rng = random.Random(seed)
+            g = np.random.default_rng(seed)
+            return rng.uniform(0.0, 1.0), g.random()
+    """, tmp_path)
+    assert rules_hit(clean) == []
+
+
+DONATE_MODULE = """
+    from functools import partial
+    import jax
+
+
+    def _impl(buf, idx):
+        return buf
+
+
+    _apply_donate = partial(jax.jit, donate_argnums=(0,))(_impl)
+
+
+    def go(buf, idx):
+        out = _apply_donate(buf, idx)
+        {tail}
+"""
+
+
+def test_trip_lint_use_after_donate(tmp_path):
+    bad = lint(DONATE_MODULE.format(tail="return out, buf.sum()"), tmp_path)
+    assert rules_hit(bad) == ["use-after-donate"]
+    f = bad.findings[0]
+    assert "buf" in f.message and "donate position 0" in f.message
+
+    # rebinding the name clears the taint...
+    clean = lint(DONATE_MODULE.format(
+        tail="buf = out\n    return buf.sum()"), tmp_path)
+    assert rules_hit(clean) == []
+    # ...and so does deleting it
+    clean2 = lint(DONATE_MODULE.format(
+        tail="del buf\n    return out"), tmp_path)
+    assert rules_hit(clean2) == []
+
+
+FACTORY_MODULE = """
+    def _fn(donate):  # graftlint: donates=0
+        raise NotImplementedError
+
+
+    def patch(ent, idx, rows):
+        new_buf = _fn(True)(ent.buf, idx, rows)
+        {tail}
+"""
+
+
+def test_use_after_donate_factory_annotation(tmp_path):
+    bad = lint(FACTORY_MODULE.format(tail="shape = ent.buf.shape\n"
+                                          "    ent.buf = new_buf\n"
+                                          "    return shape"), tmp_path)
+    assert rules_hit(bad) == ["use-after-donate"]
+
+    clean = lint(FACTORY_MODULE.format(tail="ent.buf = new_buf\n"
+                                            "    return ent.buf.shape"),
+                 tmp_path)
+    assert rules_hit(clean) == []
+
+
+def _mutate(path: str, anchor: str, inserted: str, tmp_path,
+            name: str, before: bool = False):
+    """Copy a real module with `inserted` planted on the line after (or
+    before) the unique anchor line, preserving the anchor's indent."""
+    lines = open(path).read().splitlines(keepends=True)
+    hits = [i for i, ln in enumerate(lines) if anchor in ln]
+    assert len(hits) == 1, f"anchor not unique in {path}: {anchor!r}"
+    i = hits[0]
+    indent = lines[i][:len(lines[i]) - len(lines[i].lstrip())]
+    lines.insert(i if before else i + 1, f"{indent}{inserted}\n")
+    out = tmp_path / name
+    out.write_text("".join(lines))
+    return str(out)
+
+
+def test_mutant_reread_trips_in_resident(tmp_path):
+    """Regression for the real donate site: a read of ent.buf planted
+    between the donated scatter dispatch and the rebind must fail lint
+    (the seeded state this PR fixed: ops/resident.py rebinds
+    immediately after the scatter)."""
+    real = os.path.join(ROOT, "karpenter_tpu", "ops", "resident.py")
+    mutant = _mutate(
+        real, "new_buf = _scatter_fn(donate)(ent.buf, idx_dev, rows_dev)",
+        "_stale = ent.buf", tmp_path, "resident_mutant.py")
+    run = Engine(default_rules(), root=ROOT).lint_paths([mutant])
+    assert "use-after-donate" in rules_hit(run)
+    hits = [f for f in run.findings if f.rule == "use-after-donate"]
+    assert any("ent.buf" in f.message for f in hits)
+    # and the unmutated module is clean
+    clean = Engine(default_rules(), root=ROOT).lint_paths([real])
+    assert rules_hit(clean) == []
+
+
+def test_mutant_reread_trips_in_solver(tmp_path):
+    """Same contract for the batched dispatch: gstack is donated at
+    position 3 of _batched_fn()'s callable; a read planted after the
+    dispatch (before the `del gstack`) must fail lint."""
+    real = os.path.join(ROOT, "karpenter_tpu", "ops", "solver.py")
+    mutant = _mutate(
+        real, "del gstack",
+        "_stale = gstack", tmp_path, "solver_mutant.py", before=True)
+    run = Engine(default_rules(), root=ROOT).lint_paths([mutant])
+    hits = [f for f in run.findings if f.rule == "use-after-donate"]
+    assert any("gstack" in f.message for f in hits)
+    clean = Engine(default_rules(), root=ROOT).lint_paths([real])
+    assert rules_hit(clean) == []
+
+
+def test_trip_lint_unguarded_seam(tmp_path):
+    bad = lint("""
+        _dispatch_fault_hook = None
+
+        def dispatch(backend):
+            _dispatch_fault_hook(backend)
+    """, tmp_path)
+    assert rules_hit(bad) == ["unguarded-seam"]
+
+    clean = lint("""
+        _dispatch_fault_hook = None
+        _corruption_hook = None
+
+        def dispatch(backend):
+            if _dispatch_fault_hook is not None:
+                _dispatch_fault_hook(backend)
+
+        def corrupt(buf):
+            if _corruption_hook is None:
+                return buf
+            return _corruption_hook(buf)
+
+        def fire(mod, point):
+            if mod._hook is not None:
+                mod._hook(point)
+    """, tmp_path)
+    assert rules_hit(clean) == []
+
+
+def test_unguarded_seam_else_branch_is_not_guarded(tmp_path):
+    bad = lint("""
+        _fault_hook = None
+
+        def f(x):
+            if _fault_hook is not None:
+                pass
+            else:
+                _fault_hook(x)
+    """, tmp_path)
+    assert rules_hit(bad) == ["unguarded-seam"]
+
+
+def test_trip_lint_finalizer_lock(tmp_path):
+    bad = lint("""
+        import threading
+        import weakref
+
+        _lock = threading.Lock()
+
+
+        def _on_death(key):
+            with _lock:
+                pass
+
+
+        def track(obj, key):
+            weakref.finalize(obj, _on_death, key)
+    """, tmp_path)
+    assert rules_hit(bad) == ["finalizer-lock"]
+
+    # one level of indirection is still caught
+    bad2 = lint("""
+        import threading
+        import weakref
+
+        _lock = threading.Lock()
+
+
+        def _meter():
+            _lock.acquire()
+
+
+        def _on_death(key):
+            _meter()
+
+
+        def track(obj, key):
+            weakref.finalize(obj, _on_death, key)
+    """, tmp_path)
+    assert rules_hit(bad2) == ["finalizer-lock"]
+
+    # the sanctioned shape: queue to a lock-free structure
+    clean = lint("""
+        import weakref
+
+        _pending = []
+
+
+        def _on_death(key):
+            _pending.append(key)
+
+
+        def track(obj, key):
+            weakref.finalize(obj, _on_death, key)
+    """, tmp_path)
+    assert rules_hit(clean) == []
+
+
+def test_trip_lint_jit_in_hot_path(tmp_path):
+    bad = lint("""
+        import jax
+
+
+        def make_fn(kernel):
+            return jax.jit(kernel)
+    """, tmp_path)
+    assert rules_hit(bad) == ["jit-in-hot-path"]
+
+    bad2 = lint("""
+        from functools import partial
+        import jax
+
+
+        def make_fn(kernel, n):
+            fn = partial(jax.jit, static_argnames=("n",))(kernel)
+            return fn
+    """, tmp_path)
+    assert rules_hit(bad2) == ["jit-in-hot-path"]
+
+    clean = lint("""
+        from functools import partial
+        import jax
+
+        _cache = {}
+        _memo = None
+
+
+        @partial(jax.jit, static_argnames=("n",))
+        def _kernel(x, n):
+            return x
+
+
+        _module_jit = jax.jit(_kernel)
+
+
+        def cached_fn(kernel, key):
+            fn = _cache.get(key)
+            if fn is None:
+                fn = jax.jit(kernel)
+                _cache[key] = fn
+            return fn
+
+
+        def global_fn(kernel):
+            global _memo
+            if _memo is None:
+                _memo = jax.jit(kernel)
+            return _memo
+    """, tmp_path)
+    assert rules_hit(clean) == []
+
+
+def test_trip_lint_undocumented_env(tmp_path):
+    bad = lint("""
+        import os
+
+        FLAG = os.environ.get("KARPENTER_TPU_BOGUS_KNOB", "0")
+    """, tmp_path)
+    assert rules_hit(bad) == ["undocumented-env"]
+
+    # a knob with a row in docs/reference/settings.md passes
+    clean = lint("""
+        import os
+
+        FLAG = os.environ.get("KARPENTER_TPU_RESIDENT", "1")
+    """, tmp_path)
+    assert rules_hit(clean) == []
+
+
+def test_trip_lint_bare_suppression(tmp_path):
+    bad = lint("""
+        import time
+
+        def f():
+            return time.time()  # graftlint: disable=wallclock
+    """, tmp_path)
+    # the wallclock finding is suppressed, but the reasonless waiver is
+    # itself a finding
+    assert rules_hit(bad) == ["bare-suppression"]
+    assert bad.suppressed == 1
+
+    clean = lint("""
+        import time
+
+        def f():
+            return time.time()  # graftlint: disable=wallclock -- host-only fallback, no sim clock exists here
+    """, tmp_path)
+    assert rules_hit(clean) == []
+    assert clean.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_only_matches_named_rule(tmp_path):
+    run = lint("""
+        import time
+
+        def f():
+            return time.time()  # graftlint: disable=unseeded-rng -- wrong rule on purpose
+    """, tmp_path)
+    assert "wallclock" in rules_hit(run)
+
+
+def test_file_level_suppression(tmp_path):
+    run = lint("""
+        # graftlint: disable-file=wallclock -- fixture module exercising both readers
+        import time
+
+        def f():
+            return time.time()
+
+        def g():
+            return time.monotonic()
+    """, tmp_path)
+    assert rules_hit(run) == []
+    assert run.suppressed == 2
+
+    # a REASONLESS file-wide waiver suppresses but is itself a finding —
+    # same contract as per-line suppressions
+    bare = lint("""
+        # graftlint: disable-file=wallclock
+        import time
+
+        def f():
+            return time.time()
+    """, tmp_path)
+    assert rules_hit(bare) == ["bare-suppression"]
+    assert bare.suppressed == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = """
+        import time
+
+        def f():
+            return time.time()
+    """
+    run = lint(src, tmp_path)
+    assert len(run.findings) == 1
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(run.findings, bl_path)
+    baseline = load_baseline(bl_path)
+    run2 = lint(src, tmp_path)
+    new, old = split_baselined(run2.findings, baseline)
+    assert new == [] and len(old) == 1
+    # a NEW finding is not absorbed by the old baseline
+    run3 = lint("""
+        import time
+
+        def f():
+            return time.time()
+
+        def g():
+            return time.monotonic()
+    """, tmp_path)
+    new3, old3 = split_baselined(run3.findings, baseline)
+    assert len(new3) == 1 and len(old3) == 1
+
+
+def test_fingerprints_survive_line_moves(tmp_path):
+    src = """
+        import time
+
+        def f():
+            return time.time()
+    """
+    fp1 = lint(src, tmp_path).findings[0].fingerprint
+    moved = "\n\n# a comment pushing everything down\n" + textwrap.dedent(src)
+    p = tmp_path / "mod.py"
+    p.write_text(moved)
+    run2 = Engine(default_rules(), root=ROOT).lint_paths([str(p)])
+    assert run2.findings[0].fingerprint == fp1
+    assert run2.findings[0].line != lint(src, tmp_path).findings[0].line or True
+
+
+def test_json_line_output(tmp_path):
+    run = lint("""
+        import time
+
+        def f():
+            return time.time()
+    """, tmp_path)
+    obj = json.loads(run.findings[0].to_json())
+    assert obj["rule"] == "wallclock"
+    assert obj["line"] == 5 and obj["fingerprint"]
+
+
+def test_checked_in_baseline_is_empty():
+    """The acceptance bar: all pre-existing findings were fixed or
+    suppressed with a reason — the baseline carries zero debt."""
+    assert load_baseline() == {}
+
+
+def test_repo_is_lint_clean():
+    """`make lint` over karpenter_tpu/ with the EMPTY baseline: the
+    engine-level gate every future PR inherits."""
+    run = Engine(default_rules(), root=ROOT).lint_paths(
+        [os.path.join(ROOT, "karpenter_tpu")])
+    assert run.files_scanned > 100
+    assert [f.render() for f in run.findings] == []
+
+
+def test_rule_registry_names():
+    assert len(RULE_NAMES) >= 7
+    assert len(set(RULE_NAMES)) == len(RULE_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# AST test discovery (the engine service obs_audit rides)
+# ---------------------------------------------------------------------------
+
+
+def test_discovery_index(tmp_path):
+    p = tmp_path / "test_sample.py"
+    p.write_text(textwrap.dedent('''
+        """module docstring mentioning phantom_bucket"""
+
+        TABLE = ["module_level_bucket"]
+
+
+        class TestThings:
+            def test_trip_alpha(self):
+                """docstring mentioning ghost_bucket"""
+                assert "alpha_bucket"
+
+
+        def test_beta():
+            x = "beta_bucket"
+            return x
+    '''))
+    idx = index_test_file(str(p))
+    assert idx.exists
+    assert idx.has_function("test_trip_alpha")
+    assert idx.has_function("test_beta")
+    assert not idx.has_function("test_gamma")
+    assert idx.exercises("alpha_bucket")
+    assert idx.exercises("beta_bucket")
+    assert idx.exercises("module_level_bucket")
+    # docstrings (module- and function-level) are NOT coverage
+    assert not idx.exercises("phantom_bucket")
+    assert not idx.exercises("ghost_bucket")
+    # a missing file indexes as empty, not as an error
+    gone = index_test_file(str(tmp_path / "nope.py"))
+    assert not gone.exists and not gone.exercises("anything")
+
+
+def test_obs_audit_is_green():
+    """The migrated audit (AST discovery + graftlint rule 6) passes on
+    the checked-in tree — the same gate `make test` runs."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "obs_audit", os.path.join(ROOT, "tools", "obs_audit.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.audit() == 0
+
+
+def test_cli_stamped_artifact(tmp_path):
+    """`make lint` writes a run-stamped JSON artifact (the PR 8 schema)
+    recording lint-clean per run."""
+    import subprocess
+    art = tmp_path / "graftlint.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         os.path.join(ROOT, "karpenter_tpu"),
+         "--artifact", str(art)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(art.read_text())
+    assert payload["findings"] == 0
+    assert payload["schema_version"] >= 1
+    assert payload["seed"] == 0 and payload["run_id"]
+    assert payload["provenance"]["tool"] == "graftlint"
+    assert payload["comparable"] is True

@@ -1,0 +1,16 @@
+"""graftlint: AST-based invariant lint engine for the determinism,
+donation, and seam contracts. `make lint` runs it over karpenter_tpu/;
+docs/static-analysis.md documents the rules, suppression syntax, and
+baseline workflow."""
+
+from .discovery import TestIndex, test_index
+from .engine import (BASELINE_PATH, Engine, Finding, ModuleContext, Rule,
+                     RunContext, load_baseline, split_baselined,
+                     write_baseline)
+from .rules import ALL_RULES, RULE_NAMES, default_rules
+
+__all__ = [
+    "ALL_RULES", "BASELINE_PATH", "Engine", "Finding", "ModuleContext",
+    "Rule", "RunContext", "RULE_NAMES", "TestIndex", "default_rules",
+    "load_baseline", "split_baselined", "test_index", "write_baseline",
+]

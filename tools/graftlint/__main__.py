@@ -1,0 +1,107 @@
+"""graftlint CLI — `make lint` / `make lint-baseline`.
+
+    python -m tools.graftlint [paths...] [--json] [--artifact PATH]
+                              [--write-baseline] [--baseline PATH]
+
+Default target is the karpenter_tpu/ package (the library whose
+contracts the rules encode; tests and tools are host-side and exempt).
+Exit codes: 0 clean (after baseline), 1 findings, 2 internal error.
+
+The `--artifact` JSON carries the PR 8 run-stamp block
+(schema_version/run_id/seed/provenance/comparable), so lint-clean is
+recorded per run the same way bench results are.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools.graftlint.engine import (BASELINE_PATH, ROOT, Engine,
+                                    load_baseline, split_baselined,
+                                    write_baseline)
+from tools.graftlint.rules import RULE_NAMES, default_rules
+
+
+def _stamp(files: int) -> dict:
+    """The uniform artifact stamp (PR 8 schema). Lint runs host-only and
+    deterministically over the working tree — always comparable."""
+    import uuid
+    try:
+        from karpenter_tpu.obs.perfarchive import SCHEMA_VERSION
+    except Exception:  # noqa: BLE001 — stamping must not depend on jax import health
+        SCHEMA_VERSION = 1
+    return {"schema_version": SCHEMA_VERSION,
+            "run_id": uuid.uuid4().hex[:12],
+            "seed": 0,
+            "provenance": {"tool": "graftlint", "files": files,
+                           "rules": list(RULE_NAMES), "comparable": True},
+            "comparable": True}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(ROOT, "karpenter_tpu")])
+    ap.add_argument("--json", action="store_true",
+                    help="JSON-line findings on stdout instead of human text")
+    ap.add_argument("--artifact", default="",
+                    help="write a run-stamped summary JSON here")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    engine = Engine(default_rules())
+    run = engine.lint_paths(args.paths)
+
+    if args.write_baseline:
+        write_baseline(run.findings, args.baseline)
+        print(f"graftlint: baseline written ({len(run.findings)} findings) "
+              f"-> {os.path.relpath(args.baseline, ROOT)}")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    new, baselined = split_baselined(run.findings, baseline)
+
+    per_rule: dict = {}
+    for f in new:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+
+    if args.json:
+        for f in new:
+            print(f.to_json())
+    else:
+        for f in new:
+            print(f.render())
+
+    if args.artifact:
+        payload = {**_stamp(run.files_scanned),
+                   "findings": len(new), "baselined": len(baselined),
+                   "suppressed": run.suppressed,
+                   "per_rule": per_rule}
+        with open(args.artifact, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if not args.json:
+        verdict = "FINDINGS" if new else "ok"
+        print(f"graftlint: {verdict} — {len(new)} finding(s) over "
+              f"{run.files_scanned} files ({len(RULE_NAMES)} rules, "
+              f"{len(baselined)} baselined, {run.suppressed} suppressed)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except SystemExit:
+        raise
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        print(f"graftlint: internal error: {exc}", file=sys.stderr)
+        raise SystemExit(2)

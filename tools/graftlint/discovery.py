@@ -1,0 +1,95 @@
+"""AST-based test discovery — the engine service `tools/obs_audit.py`
+rides instead of grepping raw text.
+
+The old audit checked coverage by substring search over test files,
+which had two failure modes the AST view closes:
+
+- a phase bucket / owner kind named only in a COMMENT kept the audit
+  green after the actual assertion was deleted;
+- a renamed or reformatted test (`def test_trip_x` split across lines,
+  aliased via parametrize) silently fell out of the text match.
+
+`test_index(path)` parses the file once and returns what the audit
+actually means to ask: which test FUNCTIONS exist (including methods on
+Test* classes), and which string CONSTANTS each one — and the module
+level — actually constructs. Docstrings are excluded: prose mentioning
+a bucket is not coverage.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Set
+
+
+@dataclass
+class TestIndex:
+    path: str
+    exists: bool = False
+    functions: Dict[str, Set[str]] = field(default_factory=dict)
+    module_strings: Set[str] = field(default_factory=set)
+
+    def has_function(self, name: str) -> bool:
+        return name in self.functions
+
+    def exercises(self, literal: str) -> bool:
+        """Is `literal` constructed as a string constant anywhere real —
+        inside any function, or at module level (tables/parametrize
+        lists)? Comments and docstrings don't count."""
+        if literal in self.module_strings:
+            return True
+        return any(literal in strs for strs in self.functions.values())
+
+
+def _docstring_nodes(fn: ast.AST) -> Set[int]:
+    """id()s of docstring Constant nodes directly under defs/modules."""
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _strings_under(fn: ast.AST, skip: Set[int]) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and id(node) not in skip:
+            out.add(node.value)
+    return out
+
+
+def test_index(path: str) -> TestIndex:
+    idx = TestIndex(path=path)
+    if not os.path.exists(path):
+        return idx
+    tree = ast.parse(open(path).read(), filename=path)
+    idx.exists = True
+    skip = _docstring_nodes(tree)
+    func_nodes = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func_nodes.append(node)
+    for fn in func_nodes:
+        strs = _strings_under(fn, skip)
+        if fn.name in idx.functions:
+            idx.functions[fn.name] |= strs
+        else:
+            idx.functions[fn.name] = strs
+    # module-level strings = everything minus what lives inside functions
+    inside: Set[int] = set()
+    for fn in func_nodes:
+        for node in ast.walk(fn):
+            inside.add(id(node))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and id(node) not in skip and id(node) not in inside:
+            idx.module_strings.add(node.value)
+    return idx

@@ -1,0 +1,367 @@
+"""graftlint core: a single-AST-walk lint engine for the repo's
+unwritten contracts (docs/static-analysis.md).
+
+The Go reference leans on `go vet` and the race detector to keep its
+reconcile loops honest; this engine is the Python port's equivalent for
+the contracts nothing used to enforce: sim-clock-only time, seeded-RNG
+determinism, use-after-donate safety, nil-guarded fault seams, lock-free
+finalizers, memoized jit construction, documented env knobs.
+
+Architecture:
+
+- each file is parsed ONCE; a single recursive walk dispatches every
+  node to the rules interested in its type (`Rule.interests`), so rule
+  count doesn't multiply parse or traversal cost;
+- rules get a shared `ModuleContext`: resolved import aliases (so
+  `_time.time()` and `time.time()` both canonicalize to "time.time"),
+  a parent map for ancestor checks, raw source lines for annotation
+  comments, and per-line suppressions;
+- suppression is per-line: `# graftlint: disable=<rule>[,<rule>] -- reason`
+  on the offending line (file-wide: `# graftlint: disable-file=<rule>`
+  in the first 10 lines). Suppressions without a ` -- reason` are
+  themselves a finding (`bare-suppression`): the baseline workflow
+  requires every waiver to say why;
+- findings carry a content-addressed fingerprint (rule + path +
+  normalized line text, occurrence-indexed), so a checked-in baseline
+  survives unrelated line moves but expires when the offending line
+  itself changes;
+- output: human `path:line:col rule message` and JSON-lines, plus a
+  run-stamped artifact (the PR 8 schema: schema_version/run_id/seed/
+  provenance/comparable) so lint-clean is recorded per run alongside
+  the bench artifacts.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,-]+)"
+                          r"(?:\s*--\s*(.+?))?\s*$")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([\w,-]+)"
+                               r"(?:\s*--\s*(.+?))?\s*$")
+_DONATES_RE = re.compile(r"#\s*graftlint:\s*donates=([\d,]+)")
+
+# scopes a walk must not cross when doing per-function dataflow
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    fingerprint: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> str:
+        return json.dumps({"rule": self.rule, "path": self.path,
+                           "line": self.line, "col": self.col,
+                           "message": self.message,
+                           "fingerprint": self.fingerprint},
+                          sort_keys=True)
+
+
+class Rule:
+    """Base rule: subclass, set `name`/`doc`/`interests`, implement
+    `visit`. Optional hooks bracket the run and each module."""
+
+    name: str = ""
+    doc: str = ""
+    interests: Tuple[type, ...] = ()
+
+    def begin_run(self, run: "RunContext") -> None:  # noqa: B027
+        pass
+
+    def begin_module(self, ctx: "ModuleContext") -> None:  # noqa: B027
+        pass
+
+    def visit(self, node: ast.AST, ctx: "ModuleContext") -> None:  # noqa: B027
+        pass
+
+    def end_module(self, ctx: "ModuleContext") -> None:  # noqa: B027
+        pass
+
+    def end_run(self, run: "RunContext") -> None:  # noqa: B027
+        pass
+
+
+@dataclass
+class RunContext:
+    """Engine-wide state shared by all rules for one lint run."""
+
+    root: str = ROOT
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    files_scanned: int = 0
+
+    def doc_text(self, relpath: str) -> str:
+        """Cached read of a repo doc (settings.md for undocumented-env)."""
+        cache = getattr(self, "_docs", None)
+        if cache is None:
+            cache = self._docs = {}
+        if relpath not in cache:
+            p = os.path.join(self.root, relpath)
+            cache[relpath] = open(p).read() if os.path.exists(p) else ""
+        return cache[relpath]
+
+
+class ModuleContext:
+    """Per-file state: tree, lines, import aliases, parents, suppressions."""
+
+    def __init__(self, path: str, source: str, run: RunContext):
+        self.path = path
+        self.run = run
+        rel = os.path.relpath(path, run.root)
+        self.relpath = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.imports = self._collect_imports()
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self.bare_suppression_lines: List[int] = []
+        self._collect_suppressions()
+        self._fp_seen: Dict[str, int] = {}
+
+    # --- imports / name resolution ------------------------------------
+    def _collect_imports(self) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        # common shorthands even without an import in this file
+        aliases.setdefault("np", "numpy")
+        aliases.setdefault("jnp", "jax.numpy")
+        return aliases
+
+    def chain(self, node: ast.AST) -> Optional[Tuple[str, ...]]:
+        """Raw dotted-name chain of a Name/Attribute expr, else None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return tuple(reversed(parts))
+        return None
+
+    def qual(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expr, import aliases resolved
+        ("_time.time" -> "time.time", "np.random.rand" ->
+        "numpy.random.rand")."""
+        parts = self.chain(node)
+        if not parts:
+            return None
+        head = self.imports.get(parts[0], parts[0])
+        return ".".join((head,) + parts[1:])
+
+    # --- suppressions --------------------------------------------------
+    def _collect_suppressions(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            if "graftlint" not in text:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self.suppressions[i] = set(m.group(1).split(","))
+                if not m.group(2):
+                    self.bare_suppression_lines.append(i)
+            if i <= 10:
+                mf = _SUPPRESS_FILE_RE.search(text)
+                if mf:
+                    self.file_suppressions |= set(mf.group(1).split(","))
+                    if not mf.group(2):
+                        # file-wide waivers need reasons too — same
+                        # contract as per-line suppressions
+                        self.bare_suppression_lines.append(i)
+
+    def donates_annotation(self, lineno: int) -> Optional[Tuple[int, ...]]:
+        """`# graftlint: donates=<pos[,pos]>` on a def line marks the
+        function as a donating-callable FACTORY: arguments at those
+        positions of the returned callable are consumed by dispatch."""
+        if 1 <= lineno <= len(self.lines):
+            m = _DONATES_RE.search(self.lines[lineno - 1])
+            if m:
+                return tuple(int(p) for p in m.group(1).split(","))
+        return None
+
+    # --- reporting ------------------------------------------------------
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        rules_here = self.suppressions.get(line, set())
+        if rule in rules_here or rule in self.file_suppressions:
+            self.run.suppressed += 1
+            return
+        text = (self.lines[line - 1].strip()
+                if 1 <= line <= len(self.lines) else "")
+        base = f"{rule}:{self.relpath}:{text}"
+        n = self._fp_seen.get(base, 0)
+        self._fp_seen[base] = n + 1
+        fp = hashlib.sha1(f"{base}#{n}".encode()).hexdigest()[:16]
+        self.run.findings.append(Finding(rule=rule, path=self.relpath,
+                                         line=line, col=col,
+                                         message=message, fingerprint=fp))
+
+    # --- scope helpers shared by rules ---------------------------------
+    def enclosing_function(self, node: ast.AST):
+        """Nearest FunctionDef/AsyncFunctionDef executing this node at
+        CALL time — an expr reached via a def's decorator_list (or
+        default args) evaluates at module/class scope, not inside the
+        function, so those hops don't count."""
+        child = node
+        parent = self.parents.get(child)
+        while parent is not None:
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_body = any(self._contains(stmt, child)
+                              for stmt in parent.body)
+                if in_body:
+                    return parent
+            child = parent
+            parent = self.parents.get(child)
+        return None
+
+    @staticmethod
+    def _contains(tree: ast.AST, target: ast.AST) -> bool:
+        if tree is target:
+            return True
+        return any(n is target for n in ast.walk(tree))
+
+
+def scope_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's own scope: yields every node in its body
+    WITHOUT descending into nested function/class/lambda scopes."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _SCOPE_NODES):
+                stack.append(child)
+
+
+class BareSuppressionRule(Rule):
+    """Engine-level hygiene: every `# graftlint: disable=` must carry a
+    ` -- reason`. A waiver that doesn't say why is exactly the silent
+    rot this engine exists to stop."""
+
+    name = "bare-suppression"
+    doc = "graftlint disable comment without a ` -- reason`"
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        for line in ctx.bare_suppression_lines:
+            marker = ast.Module(body=[], type_ignores=[])
+            marker.lineno, marker.col_offset = line, 0
+            ctx.report(self.name, marker,
+                       "suppression without a reason — append "
+                       "` -- <why this is safe>`")
+
+
+class Engine:
+    def __init__(self, rules: Sequence[Rule], root: str = ROOT):
+        self.rules = list(rules)
+        self.run = RunContext(root=root)
+        self._by_type: Dict[type, List[Rule]] = {}
+        for rule in self.rules:
+            for t in rule.interests:
+                self._by_type.setdefault(t, []).append(rule)
+
+    def lint_paths(self, paths: Sequence[str]) -> RunContext:
+        for rule in self.rules:
+            rule.begin_run(self.run)
+        for path in sorted(set(self._expand(paths))):
+            self._lint_file(path)
+        for rule in self.rules:
+            rule.end_run(self.run)
+        self.run.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self.run
+
+    def _expand(self, paths: Sequence[str]) -> Iterable[str]:
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    for f in filenames:
+                        if f.endswith(".py"):
+                            yield os.path.join(dirpath, f)
+            elif p.endswith(".py"):
+                yield p
+
+    def _lint_file(self, path: str) -> None:
+        try:
+            source = open(path).read()
+            ctx = ModuleContext(path, source, self.run)
+        except (OSError, SyntaxError) as exc:
+            self.run.findings.append(Finding(
+                rule="parse-error", path=os.path.relpath(
+                    path, self.run.root).replace(os.sep, "/"),
+                line=getattr(exc, "lineno", 1) or 1, col=0,
+                message=f"cannot lint: {exc}", fingerprint=""))
+            return
+        self.run.files_scanned += 1
+        for rule in self.rules:
+            rule.begin_module(ctx)
+        for node in ast.walk(ctx.tree):
+            for rule in self._by_type.get(type(node), ()):
+                rule.visit(node, ctx)
+        for rule in self.rules:
+            rule.end_module(ctx)
+
+
+# --- baseline ---------------------------------------------------------------
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def load_baseline(path: str = BASELINE_PATH) -> Dict[str, str]:
+    if not os.path.exists(path):
+        return {}
+    data = json.loads(open(path).read())
+    return dict(data.get("findings", {}))
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: str = BASELINE_PATH) -> None:
+    payload = {
+        "version": 1,
+        "note": ("accepted pre-existing findings; regenerate with "
+                 "`make lint-baseline`. An EMPTY baseline is the healthy "
+                 "state — every entry here is debt with a fingerprint."),
+        "findings": {f.fingerprint: f.render() for f in findings},
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def split_baselined(findings: Sequence[Finding],
+                    baseline: Dict[str, str]) -> Tuple[List[Finding],
+                                                       List[Finding]]:
+    """(new, baselined): a finding whose fingerprint the baseline holds
+    doesn't fail the run but is still reported as carried debt."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint in baseline else new).append(f)
+    return new, old

@@ -1,0 +1,540 @@
+"""The rule catalog: ~7 invariants this repo's PRs keep re-promising in
+comments and docstrings, now machine-checked (docs/static-analysis.md
+has the table; tests/test_graftlint.py has a seeded mutant per rule).
+
+Every rule is grounded in a real contract already in the tree:
+
+- wallclock         utils/clock.py is the ONLY wall-time source; sim
+                    paths must take the injected Clock seam or chaos
+                    `--repeat 2` artifacts embed nondeterministic
+                    timestamps (found live: metrics/durations.py,
+                    integrity/__init__.py).
+- unseeded-rng      FaultPlan/LoadPlan determinism: every draw comes
+                    from a seeded `random.Random(seed)` instance —
+                    module-global `random.*` / `np.random.*` draws (or
+                    an unseeded `random.Random()`) break the repeat
+                    contract silently.
+- use-after-donate  a name passed at a `donate_argnums` position of a
+                    jitted callable is CONSUMED by dispatch (XLA may
+                    reuse its bytes for the output); reading it later in
+                    the same scope is undefined off-CPU and invisible on
+                    the CPU test backend (ops/solver.py gstack,
+                    ops/resident.py scatter).
+- unguarded-seam    fault-injection hooks are nil-guarded for zero
+                    unarmed overhead (`if _hook is not None: _hook(x)`,
+                    utils/crashpoints.py pattern) — an unguarded call
+                    crashes every un-armed process.
+- finalizer-lock    weakref.finalize callbacks run inside GC, which can
+                    fire on a thread already holding the lock the
+                    callback wants (PR 10 discipline: queue to a
+                    lock-free deque, drain from caller context —
+                    ops/solver._finalize_dcat, obs/devicemem).
+- jit-in-hot-path   jax.jit / partial(jax.jit, ...) constructed inside a
+                    function body without memoization retraces per call
+                    (~100ms+ compile against a ~2-3ms kernel); the
+                    sanctioned shapes are a module-level jit, a bound
+                    cache dict, or a global-declared memo
+                    (consolidate._mesh_screen_fn pattern).
+- undocumented-env  every KARPENTER_TPU_* knob must appear in
+                    docs/reference/settings.md (generated from
+                    utils/options.ENV_KNOBS via `make docgen`) — an
+                    undocumented env read is an invisible production
+                    behavior switch.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import (BareSuppressionRule, ModuleContext, Rule, RunContext,
+                     scope_walk)
+
+# ---------------------------------------------------------------------------
+
+
+class WallclockRule(Rule):
+    name = "wallclock"
+    doc = ("no time.time()/time.monotonic()/datetime.now() outside "
+           "utils/clock.py — take the injected Clock seam")
+    interests = (ast.Call,)
+
+    ALLOWED_FILES = ("karpenter_tpu/utils/clock.py",)
+    BANNED = {
+        "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+        "datetime.datetime.now", "datetime.datetime.utcnow",
+        "datetime.datetime.today", "datetime.date.today",
+    }
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> None:
+        if ctx.relpath in self.ALLOWED_FILES:
+            return
+        q = ctx.qual(node.func)
+        if q in self.BANNED:
+            ctx.report(self.name, node,
+                       f"wall-clock read `{q}()` outside utils/clock.py — "
+                       f"sim paths must take the injected Clock seam "
+                       f"(nondeterministic artifacts under chaos --repeat)")
+
+
+# ---------------------------------------------------------------------------
+
+
+class UnseededRngRule(Rule):
+    name = "unseeded-rng"
+    doc = ("no module-global random.*/np.random.* draws — every draw "
+           "comes from a seeded random.Random(seed) (FaultPlan/LoadPlan "
+           "determinism contract)")
+    interests = (ast.Call,)
+
+    # draws/mutations on the process-global `random` singleton
+    GLOBAL_DRAWS = {
+        "random", "randint", "randrange", "choice", "choices", "shuffle",
+        "sample", "uniform", "gauss", "normalvariate", "lognormvariate",
+        "expovariate", "betavariate", "gammavariate", "paretovariate",
+        "weibullvariate", "vonmisesvariate", "triangular", "getrandbits",
+        "randbytes", "seed",
+    }
+    NUMPY_ALLOWED = {"default_rng", "Generator", "PCG64", "Philox",
+                     "SeedSequence", "RandomState", "BitGenerator"}
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> None:
+        q = ctx.qual(node.func)
+        if q is None:
+            return
+        if q.startswith("random."):
+            attr = q[len("random."):]
+            if attr in self.GLOBAL_DRAWS:
+                ctx.report(self.name, node,
+                           f"`{q}()` draws from the process-global RNG — "
+                           f"use a seeded `random.Random(seed)` instance "
+                           f"(the FaultPlan/LoadPlan repeat contract)")
+            elif attr == "Random" and not node.args and not node.keywords:
+                ctx.report(self.name, node,
+                           "`random.Random()` without a seed is "
+                           "entropy-seeded — thread a seed (or suppress "
+                           "with the reason jitter MUST be entropic here)")
+        elif q.startswith("numpy.random."):
+            attr = q.split(".")[2] if q.count(".") >= 2 else ""
+            if attr not in self.NUMPY_ALLOWED:
+                ctx.report(self.name, node,
+                           f"`{q}()` uses numpy's global RNG — use "
+                           f"`numpy.random.default_rng(seed)`")
+            elif attr in ("default_rng", "RandomState") \
+                    and not node.args and not node.keywords:
+                ctx.report(self.name, node,
+                           f"`{q}()` without a seed is entropy-seeded — "
+                           f"pass a seed")
+
+
+# ---------------------------------------------------------------------------
+
+
+def _donate_positions_of_jit(call: ast.Call,
+                             ctx: ModuleContext) -> Optional[Tuple[int, ...]]:
+    """Positions from `jax.jit(f, donate_argnums=...)` or
+    `partial(jax.jit, ..., donate_argnums=...)`, else None."""
+    q = ctx.qual(call.func)
+    is_jit = q == "jax.jit"
+    is_partial_jit = (q == "functools.partial" and call.args
+                      and ctx.qual(call.args[0]) == "jax.jit")
+    if not (is_jit or is_partial_jit):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                return tuple(out) if out else None
+    return None
+
+
+class UseAfterDonateRule(Rule):
+    """Intraprocedural dataflow: a name (or attribute chain) passed at a
+    donated position of a jitted callable must not be read again in the
+    same scope — rebinding or `del` clears it. Donating callables are
+    discovered from module-level `X = jax.jit(f, donate_argnums=...)` /
+    `X = partial(jax.jit, ..., donate_argnums=...)(f)` assignments;
+    factory functions RETURNING a donating callable carry a
+    `# graftlint: donates=<pos[,pos]>` annotation on their def line
+    (ops/solver._batched_fn, ops/resident._scatter_fn)."""
+
+    name = "use-after-donate"
+    doc = ("a name passed at a donate_argnums position must not be read "
+           "after dispatch — rebind or del it")
+    interests = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        donating: Dict[str, Tuple[int, ...]] = {}
+        factories: Dict[str, Tuple[int, ...]] = {}
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call):
+                v = stmt.value
+                pos = _donate_positions_of_jit(v, ctx)
+                if pos is None and isinstance(v.func, ast.Call):
+                    # partial(jax.jit, donate_argnums=...)(impl)
+                    pos = _donate_positions_of_jit(v.func, ctx)
+                if pos:
+                    donating[stmt.targets[0].id] = pos
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pos = ctx.donates_annotation(node.lineno)
+                if pos:
+                    factories[node.name] = pos
+        ctx._donating = donating          # type: ignore[attr-defined]
+        ctx._donate_factories = factories  # type: ignore[attr-defined]
+
+    def _callee_positions(self, call: ast.Call,
+                          ctx: ModuleContext) -> Optional[Tuple[int, ...]]:
+        donating = getattr(ctx, "_donating", {})
+        factories = getattr(ctx, "_donate_factories", {})
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in donating:
+            return donating[f.id]
+        if isinstance(f, ast.Attribute) and f.attr in donating:
+            return donating[f.attr]
+        if isinstance(f, ast.Call):
+            g = f.func
+            gname = g.id if isinstance(g, ast.Name) else (
+                g.attr if isinstance(g, ast.Attribute) else None)
+            if gname in factories:
+                return factories[gname]
+        return None
+
+    def visit(self, fn: ast.AST, ctx: ModuleContext) -> None:
+        consumptions: List[Tuple[Tuple[str, ...], ast.Call, int, str]] = []
+        events: List[Tuple[int, int, Tuple[str, ...], str, ast.AST]] = []
+        for node in scope_walk(fn):
+            if isinstance(node, ast.Call):
+                pos = self._callee_positions(node, ctx)
+                if pos:
+                    callee = ctx.qual(node.func) or "<donating callable>"
+                    for p in pos:
+                        if p < len(node.args):
+                            chain = ctx.chain(node.args[p])
+                            if chain:
+                                consumptions.append((chain, node, p, callee))
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                chain = ctx.chain(node)
+                if chain is None:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    kind = "store"
+                elif isinstance(node.ctx, ast.Del):
+                    kind = "del"
+                else:
+                    kind = "load"
+                events.append((node.lineno, node.col_offset, chain, kind,
+                               node))
+        if not consumptions:
+            return
+        events.sort(key=lambda e: (e[0], e[1]))
+        for chain, call, p, callee in consumptions:
+            end = (getattr(call, "end_lineno", call.lineno),
+                   getattr(call, "end_col_offset", call.col_offset))
+            for line, col, ev_chain, kind, node in events:
+                if (line, col) <= end:
+                    continue
+                if ev_chain[:len(chain)] != chain:
+                    continue
+                # a longer chain (x.buf.shape) is a read of x.buf no
+                # matter the ctx; an exact-chain store/del clears it
+                if len(ev_chain) == len(chain) and kind in ("store", "del"):
+                    break
+                ctx.report(self.name, node,
+                           f"`{'.'.join(chain)}` was donated to "
+                           f"`{callee}` (donate position {p}) at line "
+                           f"{call.lineno} and is read again here — "
+                           f"dispatch consumed its buffer; rebind or "
+                           f"del the name after the call")
+                break
+
+
+# ---------------------------------------------------------------------------
+
+
+class UnguardedSeamRule(Rule):
+    """Fault-injection seams are module globals named `_*hook`, None
+    until a chaos harness arms them; every call site must probe first
+    (`if _hook is not None: _hook(x)` or an `if _hook is None: return`
+    early-out) so an un-armed process pays one attribute check."""
+
+    name = "unguarded-seam"
+    doc = "fault-hook call sites must probe-before-call (nil-guarded seam)"
+    interests = (ast.Call,)
+
+    SEAM_RE = re.compile(r"^_\w*hook$")
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> None:
+        chain = ctx.chain(node.func)
+        if not chain or not self.SEAM_RE.match(chain[-1]):
+            return
+        if self._guarded(node, chain, ctx):
+            return
+        ctx.report(self.name, node,
+                   f"`{'.'.join(chain)}` called without a nil-guard — "
+                   f"probe the seam first (`if {'.'.join(chain)} is not "
+                   f"None:`); un-armed processes hold None here")
+
+    def _guarded(self, node: ast.AST, chain: Tuple[str, ...],
+                 ctx: ModuleContext) -> bool:
+        # (a) an ancestor if/ternary tests the seam
+        cur = node
+        parent = ctx.parents.get(cur)
+        while parent is not None:
+            if isinstance(parent, (ast.If, ast.IfExp)) \
+                    and self._test_guards(parent.test, chain, ctx):
+                # the call must live in the truthy branch
+                in_else = (isinstance(parent, ast.If)
+                           and any(ModuleContext._contains(s, node)
+                                   for s in parent.orelse))
+                if not in_else:
+                    return True
+            if isinstance(parent, ast.BoolOp) and isinstance(parent.op,
+                                                             ast.And):
+                for v in parent.values:
+                    if v is cur:
+                        break
+                    if self._test_guards(v, chain, ctx):
+                        return True
+            cur = parent
+            parent = ctx.parents.get(cur)
+        # (b) an earlier top-level `if seam is None: return/raise` in the
+        # enclosing function body (ops/solver._maybe_corrupt pattern)
+        fn = ctx.enclosing_function(node)
+        if fn is not None:
+            for stmt in fn.body:
+                if stmt.lineno >= node.lineno:
+                    break
+                if isinstance(stmt, ast.If) \
+                        and self._is_none_test(stmt.test, chain, ctx) \
+                        and stmt.body \
+                        and isinstance(stmt.body[-1],
+                                       (ast.Return, ast.Raise,
+                                        ast.Continue, ast.Break)):
+                    return True
+        return False
+
+    def _test_guards(self, test: ast.AST, chain: Tuple[str, ...],
+                     ctx: ModuleContext) -> bool:
+        """`seam is not None`, bare-truthy `seam`, or an `and` of either."""
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            return any(self._test_guards(v, chain, ctx)
+                       for v in test.values)
+        if ctx.chain(test) == chain:
+            return True
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and isinstance(test.ops[0], ast.IsNot) \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value is None:
+            return ctx.chain(test.left) == chain
+        return False
+
+    def _is_none_test(self, test: ast.AST, chain: Tuple[str, ...],
+                      ctx: ModuleContext) -> bool:
+        return (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Is)
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None
+                and ctx.chain(test.left) == chain)
+
+
+# ---------------------------------------------------------------------------
+
+
+class FinalizerLockRule(Rule):
+    """`weakref.finalize` callbacks run inside GC — possibly on a thread
+    already holding the lock the callback wants (non-reentrant metric
+    locks included). The discipline (PR 10): finalizers do lock-free
+    work only (dict pops, deque appends) and defer the rest to caller
+    context. Checks the callback body (and, one level deep, module
+    functions it calls) for `with *lock*:` / `.acquire()`."""
+
+    name = "finalizer-lock"
+    doc = "weakref.finalize callbacks may not acquire locks (GC reentrancy)"
+    interests = (ast.Call,)
+
+    LOCK_NAME_RE = re.compile(r"lock", re.IGNORECASE)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        ctx._module_defs = defs  # type: ignore[attr-defined]
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> None:
+        if ctx.qual(node.func) != "weakref.finalize" or len(node.args) < 2:
+            return
+        cb = node.args[1]
+        defs = getattr(ctx, "_module_defs", {})
+        body: Optional[ast.AST] = None
+        cb_name = "<callback>"
+        if isinstance(cb, ast.Lambda):
+            body, cb_name = cb, "<lambda>"
+        elif isinstance(cb, ast.Name) and cb.id in defs:
+            body, cb_name = defs[cb.id], cb.id
+        if body is None:
+            return  # unresolvable (bound method etc.) — trust the author
+        hit = self._lock_use(body, defs, ctx, depth=2, seen=set())
+        if hit is not None:
+            ctx.report(self.name, node,
+                       f"finalizer callback `{cb_name}` acquires a lock "
+                       f"({hit}) — finalizers run inside GC, possibly on "
+                       f"a thread already holding it; queue to a "
+                       f"lock-free structure and drain from caller "
+                       f"context instead")
+
+    def _lock_use(self, fn: ast.AST, defs: Dict[str, ast.AST],
+                  ctx: ModuleContext, depth: int,
+                  seen: Set[str]) -> Optional[str]:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    chain = ctx.chain(item.context_expr)
+                    if chain and self.LOCK_NAME_RE.search(chain[-1]):
+                        return f"`with {'.'.join(chain)}:` at line " \
+                               f"{node.lineno}"
+            if isinstance(node, ast.Call):
+                chain = ctx.chain(node.func)
+                if chain and chain[-1] == "acquire":
+                    return f"`{'.'.join(chain)}()` at line {node.lineno}"
+                if depth > 1 and chain and len(chain) == 1 \
+                        and chain[0] in defs and chain[0] not in seen:
+                    seen.add(chain[0])
+                    hit = self._lock_use(defs[chain[0]], defs, ctx,
+                                         depth - 1, seen)
+                    if hit is not None:
+                        return f"via `{chain[0]}()`: {hit}"
+        return None
+
+
+# ---------------------------------------------------------------------------
+
+
+class JitInHotPathRule(Rule):
+    """jax.jit (or partial(jax.jit, ...)) constructed inside a function
+    body retraces per call unless memoized. Sanctioned shapes: store the
+    jitted callable into a cache subscript (`_cache[key] = fn`), assign
+    it to a `global`-declared memo, or decorate the factory with
+    functools.lru_cache/cache."""
+
+    name = "jit-in-hot-path"
+    doc = ("jax.jit constructed inside a function body without "
+           "memoization — per-call retrace")
+    interests = (ast.Call,)
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> None:
+        q = ctx.qual(node.func)
+        if q == "functools.partial":
+            if not (node.args and ctx.qual(node.args[0]) == "jax.jit"):
+                return
+        elif q != "jax.jit":
+            return
+        # partial(jax.jit, ...) inside partial(jax.jit, ...)(impl): only
+        # report the OUTER construction site once
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            node = parent
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            return  # module-level construction compiles once per import
+        if self._memoized(node, fn, ctx):
+            return
+        ctx.report(self.name, node,
+                   f"jax.jit constructed inside `{fn.name}()` without "
+                   f"memoization — every call retraces/recompiles; cache "
+                   f"the jitted callable (module cache dict keyed on the "
+                   f"statics, or a global memo)")
+
+    def _memoized(self, node: ast.AST, fn: ast.AST,
+                  ctx: ModuleContext) -> bool:
+        for dec in getattr(fn, "decorator_list", []):
+            dq = ctx.qual(dec.func if isinstance(dec, ast.Call) else dec)
+            if dq in ("functools.lru_cache", "functools.cache"):
+                return True
+        # the assignment consuming the jit value
+        assign = node
+        parent = ctx.parents.get(assign)
+        while parent is not None and not isinstance(parent, ast.Assign):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False  # e.g. `return jax.jit(...)` — no memo
+            assign, parent = parent, ctx.parents.get(parent)
+        if parent is None:
+            return False
+        target = parent.targets[0] if len(parent.targets) == 1 else None
+        if isinstance(target, ast.Subscript):
+            return True  # cache[key] = jax.jit(...)
+        if not isinstance(target, ast.Name):
+            return False
+        name = target.id
+        globals_declared: Set[str] = set()
+        for n in scope_walk(fn):
+            if isinstance(n, ast.Global):
+                globals_declared.update(n.names)
+        if name in globals_declared:
+            return True  # the `global _memo; _memo = jax.jit(...)` shape
+        for n in scope_walk(fn):
+            if isinstance(n, ast.Assign) \
+                    and isinstance(n.targets[0], ast.Subscript) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == name:
+                return True  # fn = jax.jit(...); cache[key] = fn
+        return False
+
+
+# ---------------------------------------------------------------------------
+
+
+class UndocumentedEnvRule(Rule):
+    """Every KARPENTER_TPU_* literal in the package must appear in
+    docs/reference/settings.md (generated from utils/options.ENV_KNOBS
+    by `make docgen`) — an env knob nobody can discover is an invisible
+    behavior switch."""
+
+    name = "undocumented-env"
+    doc = ("every KARPENTER_TPU_* env read must appear in "
+           "docs/reference/settings.md")
+    interests = (ast.Constant,)
+
+    ENV_RE = re.compile(r"^KARPENTER_TPU_[A-Z0-9_]+$")
+    DOC = "docs/reference/settings.md"
+
+    def visit(self, node: ast.Constant, ctx: ModuleContext) -> None:
+        v = node.value
+        if not isinstance(v, str) or not self.ENV_RE.match(v):
+            return
+        if f"`{v}`" in ctx.run.doc_text(self.DOC):
+            return
+        ctx.report(self.name, node,
+                   f"env var `{v}` is used but undocumented — add it to "
+                   f"utils/options.ENV_KNOBS and run `make docgen` "
+                   f"(docs/reference/settings.md)")
+
+
+# ---------------------------------------------------------------------------
+
+ALL_RULES = (
+    WallclockRule,
+    UnseededRngRule,
+    UseAfterDonateRule,
+    UnguardedSeamRule,
+    FinalizerLockRule,
+    JitInHotPathRule,
+    UndocumentedEnvRule,
+    BareSuppressionRule,
+)
+
+RULE_NAMES: Tuple[str, ...] = tuple(r.name for r in ALL_RULES)
+
+
+def default_rules() -> List[Rule]:
+    return [cls() for cls in ALL_RULES]

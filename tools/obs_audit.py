@@ -1,15 +1,15 @@
 """Observability drift audit — `make obs-audit`.
 
-Three invariants that otherwise rot silently:
+Six invariants that otherwise rot silently:
 
 1. every metric family registered at import time appears in
    docs/reference/metrics.md (the generated page a new family is easy
    to forget to regenerate — `make docgen` fixes a failure);
 2. every phase bucket in the ledger taxonomy (obs/profile.PHASES) is
-   exercised by the canonical mapping tests — the grep is restricted to
+   exercised by the canonical mapping tests — restricted to
    tests/test_observatory.py on purpose: common-word buckets ("launch",
    "commit", "dispatch"...) appear all over tests/ for unrelated
-   reasons, and a repo-wide grep would keep this check green after the
+   reasons, and a repo-wide search would keep this check green after the
    actual bucket tests were deleted;
 3. every watchdog invariant (obs/watchdog.INVARIANTS) has MUTATION-
    STYLE negative coverage in tests/test_watchdog.py: a seeded fault
@@ -17,16 +17,21 @@ Three invariants that otherwise rot silently:
    nothing can trip is dead code wearing a green badge;
 4. every residency-ledger owner kind (obs/devicemem.OWNER_KINDS) and
    transfer reason (TRANSFER_REASONS) is exercised by the canonical
-   device-telemetry tests (tests/test_devicemem.py) — an owner kind
-   nothing registers under means a device allocation path fell out of
-   the accounting, which is exactly the drift the >=99%-coverage audit
-   exists to catch;
+   device-telemetry tests (tests/test_devicemem.py);
 5. every solution-integrity check name (integrity.CHECKS) has a seeded
    trip test in tests/test_integrity.py (`def test_trip_integrity_
-   <check>`): a mutated/corrupted input the check must flag — the same
-   mutation-style discipline as the watchdog invariants (which already
-   cover `integrity_breach` via rule 3), because an oracle check no
-   corruption can trip would let real SDC ship placements.
+   <check>`);
+6. every graftlint rule (tools/graftlint/rules.RULE_NAMES) has a seeded
+   bad-code mutant that TRIPS it in tests/test_graftlint.py
+   (`def test_trip_lint_<rule>`) — a lint rule no mutant can trip
+   guards nothing.
+
+Coverage is judged on the AST, not raw text (tools/graftlint/
+discovery.py): a bucket or owner kind counts as exercised only when a
+test FUNCTION (or a module-level table) constructs it as a string
+CONSTANT, and trip tests are discovered as function DEFINITIONS — so a
+name that survives only in a comment/docstring, or a test renamed or
+reformatted out of a substring match, can no longer green the audit.
 
 Exit 0 = no drift. Wired into the default verify path (`make test`
 depends on this).
@@ -47,6 +52,8 @@ def audit() -> int:
     from karpenter_tpu.obs.devicemem import OWNER_KINDS, TRANSFER_REASONS
     from karpenter_tpu.obs.profile import PHASES
     from karpenter_tpu.obs.watchdog import INVARIANTS
+    from tools.graftlint.discovery import test_index
+    from tools.graftlint.rules import RULE_NAMES
 
     failures = []
 
@@ -58,58 +65,69 @@ def audit() -> int:
                 f"metric family `{m.name}` is registered but missing from "
                 f"docs/reference/metrics.md — run `make docgen`")
 
-    canon = os.path.join(ROOT, "tests", "test_observatory.py")
-    tests = open(canon).read() if os.path.exists(canon) else ""
-    if not tests:
+    obs_idx = test_index(os.path.join(ROOT, "tests", "test_observatory.py"))
+    if not obs_idx.exists:
         failures.append("tests/test_observatory.py (the canonical ledger "
                         "bucket tests) is missing")
     for phase in PHASES:
-        if f'"{phase}"' not in tests and f"'{phase}'" not in tests:
+        if not obs_idx.exercises(phase):
             failures.append(
-                f"ledger phase bucket '{phase}' is in the taxonomy but "
-                f"tests/test_observatory.py does not exercise it")
+                f"ledger phase bucket '{phase}' is in the taxonomy but no "
+                f"test function in tests/test_observatory.py constructs it "
+                f"(comments/docstrings don't count)")
 
-    wd_canon = os.path.join(ROOT, "tests", "test_watchdog.py")
-    wd_tests = open(wd_canon).read() if os.path.exists(wd_canon) else ""
-    if not wd_tests:
+    wd_idx = test_index(os.path.join(ROOT, "tests", "test_watchdog.py"))
+    if not wd_idx.exists:
         failures.append("tests/test_watchdog.py (the canonical watchdog "
                         "trip tests) is missing")
     for inv in INVARIANTS:
-        if f"def test_trip_{inv}" not in wd_tests:
+        if not wd_idx.has_function(f"test_trip_{inv}"):
             failures.append(
                 f"watchdog invariant '{inv}' has no seeded fault scenario "
                 f"tripping it — tests/test_watchdog.py needs a "
                 f"`def test_trip_{inv}` (mutation-style negative coverage)")
 
-    dm_canon = os.path.join(ROOT, "tests", "test_devicemem.py")
-    dm_tests = open(dm_canon).read() if os.path.exists(dm_canon) else ""
-    if not dm_tests:
+    dm_idx = test_index(os.path.join(ROOT, "tests", "test_devicemem.py"))
+    if not dm_idx.exists:
         failures.append("tests/test_devicemem.py (the canonical device-"
                         "telemetry tests) is missing")
     for kind in OWNER_KINDS:
-        if f'"{kind}"' not in dm_tests and f"'{kind}'" not in dm_tests:
+        if not dm_idx.exercises(kind):
             failures.append(
                 f"residency-ledger owner kind '{kind}' is in the taxonomy "
-                f"but tests/test_devicemem.py does not exercise it")
+                f"but no test function in tests/test_devicemem.py "
+                f"constructs it")
     for reason in TRANSFER_REASONS:
-        if f'"{reason}"' not in dm_tests and f"'{reason}'" not in dm_tests:
+        if not dm_idx.exercises(reason):
             failures.append(
-                f"transfer reason '{reason}' is in the taxonomy but "
-                f"tests/test_devicemem.py does not exercise it")
+                f"transfer reason '{reason}' is in the taxonomy but no "
+                f"test function in tests/test_devicemem.py constructs it")
 
     from karpenter_tpu.integrity import CHECKS
-    it_canon = os.path.join(ROOT, "tests", "test_integrity.py")
-    it_tests = open(it_canon).read() if os.path.exists(it_canon) else ""
-    if not it_tests:
+    it_idx = test_index(os.path.join(ROOT, "tests", "test_integrity.py"))
+    if not it_idx.exists:
         failures.append("tests/test_integrity.py (the canonical "
                         "solution-integrity trip tests) is missing")
     for check in CHECKS:
-        if f"def test_trip_integrity_{check}" not in it_tests:
+        if not it_idx.has_function(f"test_trip_integrity_{check}"):
             failures.append(
                 f"integrity check '{check}' has no seeded corruption "
                 f"tripping it — tests/test_integrity.py needs a "
                 f"`def test_trip_integrity_{check}` (mutation-style "
                 f"negative coverage)")
+
+    gl_idx = test_index(os.path.join(ROOT, "tests", "test_graftlint.py"))
+    if not gl_idx.exists:
+        failures.append("tests/test_graftlint.py (the canonical lint-rule "
+                        "trip tests) is missing")
+    for rule in RULE_NAMES:
+        fn = f"test_trip_lint_{rule.replace('-', '_')}"
+        if not gl_idx.has_function(fn):
+            failures.append(
+                f"graftlint rule '{rule}' has no seeded bad-code mutant "
+                f"tripping it — tests/test_graftlint.py needs a "
+                f"`def {fn}` (a snippet the rule must flag, plus a clean "
+                f"twin it must not)")
 
     if failures:
         print("obs-audit: DRIFT DETECTED")
@@ -121,7 +139,8 @@ def audit() -> int:
           f"{len(INVARIANTS)} watchdog invariants trip-covered, "
           f"{len(OWNER_KINDS)} residency owner kinds + "
           f"{len(TRANSFER_REASONS)} transfer reasons test-covered, "
-          f"{len(CHECKS)} integrity checks trip-covered)")
+          f"{len(CHECKS)} integrity checks trip-covered, "
+          f"{len(RULE_NAMES)} lint rules trip-covered)")
     return 0
 
 
