@@ -7,7 +7,8 @@ PY ?= python
         deflake run native trace-report profile-report obs-audit chaos \
         crash-audit warmpath-audit encode-report fleet fleet-audit \
         perf-gate device-report resident-report soak soak-audit \
-        disrupt-report integrity-report lint lint-baseline clean
+        disrupt-report integrity-report recompute-report lint \
+        lint-baseline clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -77,6 +78,9 @@ disrupt-report:  ## global disruption optimizer vs greedy: savings found, verify
 
 integrity-report:  ## solution-integrity plane: injected-vs-detected table, verdict counts, canary agreement, audit coverage (SEED=n)
 	$(PY) tools/integrity_report.py --seed $(or $(SEED),0)
+
+recompute-report:  ## work-provenance headroom table: per-stage fresh/redundant/delta-served units, redundant wall, attribution coverage (PODS=n ROUNDS=n)
+	$(PY) tools/recompute_report.py --pods $(or $(PODS),600) --rounds $(or $(ROUNDS),4)
 
 soak:  ## open-loop long-soak serving mode (loadgen/): drive the fleet past saturation, shedding bounds the backlog (TENANTS overrides shard count)
 	$(PY) -m karpenter_tpu.loadgen soak_overload $(if $(TENANTS),--tenants $(TENANTS))

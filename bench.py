@@ -1031,6 +1031,114 @@ def main() -> None:
         round(min(c15_det, c15_inj) / c15_inj, 4) if c15_inj else 1.0)
     detail["c15_wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
 
+    progress("c16: steady-state recompute observatory (1% churn/tick, "
+             "warm path + residency armed)")
+    # --- config 16 (ISSUE 16): the work-provenance regime. A standing
+    # warm cluster churning 1% of its residents per tick while the
+    # RecomputeLedger classifies every stage's work fresh / redundant /
+    # delta-served: the per-stage redundancy fractions below are the
+    # measured headroom table ROADMAP item 3's delta layer will spend,
+    # and c16_recompute_coverage is the ≥99% attribution invariant over
+    # the traced reconcile wall. c16_full_reconcile_p50_ms (forced-cold,
+    # the recompute-everything ceiling) vs c16_warm_admit_floor_ms (the
+    # delta-served floor) brackets what zero-recompute is worth.
+    # *_redundant_frac keys are perf-gate-informational by name;
+    # coverage gates higher-better.
+    from karpenter_tpu.obs.recompute import COVERAGE_TARGET as _COV16
+    from karpenter_tpu.obs.recompute import RECOMPUTE as _RC16
+    from karpenter_tpu.obs.recompute import STAGES as _ST16
+    _n16 = 1000 if _prov8().get("cpu_fallback", True) else 100_000
+    _churn16 = max(8, _n16 // 100)
+    _man16 = max(64, _n16 // 50)
+    sim16 = make_sim(warmpath=True, warm_audit_every=64,
+                     cloud_config=FakeCloudConfig(
+                         node_ready_delay=1.0, register_delay=0.5,
+                         create_fleet_rate=1e6, create_fleet_burst=10**6))
+
+    def _mk16(i, gen=0):
+        s = (i + 131 * gen) % _man16
+        kw = dict(requests=Resources.parse({"cpu": "100m",
+                                            "memory": "128Mi"}),
+                  labels={"app": f"svc16-{s % 16}"})
+        if s % 3 == 0:
+            kw["topology_spread"] = [TopologySpreadConstraint(
+                topology_key=L.ZONE, max_skew=1)]
+        return Pod(name=f"c16-{gen}-{i}", **kw)
+
+    # the standing fleet: one anti-affinity pod pins each node (the c8
+    # idiom — also keeps the conflict stage hot), churnable residents
+    # ride the spare headroom
+    for i in range(max(32, _n16 // 10)):
+        sim16.store.add_pod(Pod(
+            name=f"c16-standing-{i}", labels={"app": "standing16"},
+            requests=Resources.parse({"cpu": "500m", "memory": "512Mi"}),
+            affinity_terms=[PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": "standing16"}, anti=True)]))
+    live16 = [_mk16(i) for i in range(_n16)]
+    for p in live16:
+        sim16.store.add_pod(p)
+    ok16 = sim16.engine.run_until(
+        lambda: all(p.node_name for p in sim16.store.pods.values()),
+        timeout=900.0, step=1.0)
+    detail["c16_fleet_settled"] = bool(ok16)
+    detail["c16_resident_pods"] = len(sim16.store.pods)
+    _RC16.reset()  # measure the steady state, not the build-up
+    TRACER.configure(enabled=True)
+    warm16, cold16 = [], []
+    rnd16 = 0
+    for phase16, reps16, times16 in (("warm", 6, warm16),
+                                     ("cold", 3, cold16)):
+        for _ in range(reps16):
+            rnd16 += 1
+            for p in live16[:_churn16]:   # 1% leaves...
+                sim16.store.delete_pod(p.namespace, p.name)
+            fresh16 = [_mk16(i, gen=rnd16) for i in range(_churn16)]
+            for p in fresh16:             # ...and 1% arrives
+                sim16.store.add_pod(p)
+            live16 = live16[_churn16:] + fresh16
+            if phase16 == "cold":
+                sim16.warmpath.force_cold("bench-c16")
+            t0 = time.perf_counter()
+            with TRACER.trace("reconcile.profile", config="c16_steady",
+                              phase=phase16):
+                sim16.provisioner.reconcile(sim16.clock.now())
+                sim16.disruption.reconcile(sim16.clock.now())
+            times16.append((time.perf_counter() - t0) * 1e3)
+    # no-change passes: the reconcile cadence of a QUIET cluster — the
+    # screen memo serves (delta), the drift pass re-grinds an unchanged
+    # candidate set (redundant: exactly the headroom signal)
+    for _ in range(4):
+        with TRACER.trace("reconcile.profile", config="c16_quiet"):
+            sim16.disruption.reconcile(sim16.clock.now())
+    TRACER.configure(enabled=False)
+    snap16 = _RC16.snapshot()
+    for st in _ST16:
+        row16 = snap16["stages"].get(st)
+        if row16 is None:
+            progress(f"C16 STAGE UNOBSERVED: no '{st}' work classified — "
+                     "a call site lost its RECOMPUTE.classify()")
+        detail[f"c16_{st}_redundant_frac"] = round(
+            row16["redundant_frac"], 4) if row16 else 0.0
+    detail["c16_recompute_coverage"] = snap16["coverage"]
+    detail["c16_redundant_wall_ms"] = round(
+        sum(r["ms"].get("redundant", 0.0)
+            for r in snap16["stages"].values()), 3)
+    detail["c16_recompute_unattributed_ms"] = snap16["unattributed_ms"]
+    detail["c16_full_reconcile_p50_ms"] = round(
+        statistics.median(cold16), 3)
+    detail["c16_warm_admit_floor_ms"] = round(
+        statistics.median(warm16), 3)
+    if snap16["coverage"] < _COV16:
+        progress(f"C16 RECOMPUTE ATTRIBUTION GAP: coverage "
+                 f"{snap16['coverage']:.4f} < {_COV16:g} — stage work ran "
+                 "with no provenance classification in its trace")
+    recompute_path = os.path.join(trace_dir, "recompute_bench.json")
+    with open(recompute_path, "w") as f:
+        json.dump({**stamp, "snapshot": snap16}, f, indent=1)
+    detail["c16_artifact"] = recompute_path
+    print(_RC16.report(), file=sys.stderr)
+
     progress("profile: writing profile_bench.json (phase attribution)")
     # --- the phase-attribution artifact (obs/profile.py): everything the
     # traced windows above fed the ledger (c7 solve, c8 warm+cold

@@ -209,6 +209,19 @@ class DisruptionController:
                  if v.claim.nodepool == pool.name]
         if not views:
             return
+        # work provenance of the drift/expiry/candidate classification
+        # pass: one unit per node view, fingerprinted by everything the
+        # pass's verdicts depend on — an unchanged candidate set
+        # re-classified every reconcile is the redundant disrupt work
+        # ROADMAP item 3's delta layer would skip
+        from ..obs.recompute import RECOMPUTE, fingerprint
+        RECOMPUTE.classify("disrupt", fingerprint(
+            pool.name,
+            self._memo_hash(node_class) if node_class is not None else "",
+            self._memo_hash(pool), self.catalog.epoch,
+            tuple(sorted((v.name, len(v.pods),
+                          v.claim.is_deleting()) for v in views))),
+            units=len(views))
         budget_for = lambda reason: self._budget(pool, views, reason, now)
         # PDB gate for voluntary disruption (reference: candidates with
         # blocking PDBs are excluded from the disruption passes).
@@ -499,11 +512,13 @@ class DisruptionController:
         template = pool.template_labels()
         cat = apply_daemonset_overhead(
             cat, list(self.store.daemonsets.values()), pool, template)
+        from ..obs.recompute import RECOMPUTE, fingerprint_bytes
         fp = self._screen_fingerprint(pool, cat, views)
         hit = self._screen_cache.get(pool.name)
         if hit is not None and hit[0] == fp:
             self.stats["screen_cache_hits"] = (
                 self.stats.get("screen_cache_hits", 0) + 1)
+            RECOMPUTE.classify("optimizer", served=True)
             return hit[1]
         enc = encode_pods(all_pods, cat,
                           extra_requirements=pool.requirements,
@@ -541,6 +556,7 @@ class DisruptionController:
         ok = frozenset(v.name for i, v in enumerate(views) if screen[i])
         state = (cat, enc, counts, ok, slack)
         self._screen_cache[pool.name] = (fp, state)
+        RECOMPUTE.classify("optimizer", fingerprint_bytes(fp.encode()))
         return state
 
     def _screen_order(self, pool: NodePool, candidates: List[NodeView],
@@ -609,6 +625,8 @@ class DisruptionController:
                               if x),
                     min(budget, 64))
         if self._optimizer_noop.get(pool.name) == noop_key:
+            from ..obs.recompute import RECOMPUTE
+            RECOMPUTE.classify("optimizer", served=True)
             return False
         use_device = self.solver.backend in ("device", "mesh")
         mesh = (self.solver.screen_mesh(len(views)) if use_device
@@ -624,6 +642,9 @@ class DisruptionController:
                                    use_device=use_device, mesh=mesh)
             sp.set(scored=plan.scored, feasible=plan.feasible,
                    backend=plan.backend)
+            from ..obs.recompute import RECOMPUTE, fingerprint
+            RECOMPUTE.classify("optimizer", fingerprint(
+                noop_key[0], tuple(sorted(noop_key[1])), noop_key[2]))
         except Exception:  # noqa: BLE001 — the search is an optimization;
             # a device fault here must cost one greedy pass, not a
             # crashed reconcile (the chaos DeviceFault seam is probed

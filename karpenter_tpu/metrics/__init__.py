@@ -543,5 +543,38 @@ INTEGRITY_VERDICTS = REGISTRY.counter(
     "healthy run are the zero-false-positive contract breaking; the "
     "watchdog's integrity_breach invariant pages on them",
     ("check", "outcome", "tenant"), label_defaults=_TENANT)
+RECOMPUTE_WORK = REGISTRY.counter(
+    "karpenter_tpu_recompute_work_total",
+    "Work-provenance units classified by the recompute observatory "
+    "(obs/recompute.py), by taxonomy stage (encode, conflict, affinity, "
+    "spread, solve, optimizer, disrupt) and outcome: 'fresh' = an input "
+    "fingerprint the stage had not seen, 'redundant' = the same "
+    "fingerprint recomputed from scratch (the measured headroom a memo/"
+    "cache/residency layer can spend — ROADMAP item 3's target), "
+    "'delta_served' = the work was answered by an existing cache, memo, "
+    "or warm admission instead of recomputed",
+    ("stage", "outcome", "tenant"), label_defaults=_TENANT)
+REDUNDANT_WORK_FRAC = REGISTRY.gauge(
+    "karpenter_tpu_redundant_work_frac",
+    "Redundant share of each recompute-taxonomy stage's classified work "
+    "units (redundant / total, cumulative). Above 0.9 and rising past a "
+    "sim-time grace trips the watchdog's recompute_runaway invariant — "
+    "a stage grinding the same inputs every reconcile with no layer "
+    "serving the delta", ("stage",))
+REDUNDANT_WORK_MS = REGISTRY.counter(
+    "karpenter_tpu_redundant_work_ms_total",
+    "Traced wall attributed to REDUNDANT stage work, per taxonomy "
+    "stage: each ledger-material trace's per-stage self-time is split "
+    "across the outcomes that trace classified, proportionally by "
+    "units. This is the headroom table's ms column — the reconcile "
+    "wall a delta-aware layer would delete", ("stage",))
+RECOMPUTE_UNATTRIBUTED_MS = REGISTRY.counter(
+    "karpenter_tpu_recompute_unattributed_ms_total",
+    "Traced taxonomy-stage wall the recompute plane could NOT attribute "
+    "to any classified work (the stage's spans ran but no classify() "
+    "call landed in that trace), per stage. The ≥99% coverage "
+    "invariant's gap meter: growth means a code path does stage work "
+    "without registering its input fingerprint — each gap also lands a "
+    "recompute.unattributed marker in the flight recorder", ("stage",))
 
 __all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram"]

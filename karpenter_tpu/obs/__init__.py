@@ -11,10 +11,13 @@ from .tracer import (NOOP_SPAN, TRACER, FlightRecorder, Span, Trace, Tracer,
 from .devicemem import DEVICEMEM, TRANSFERS, UPLOADS
 from .explain import RECORDER
 from .profile import LEDGER, PHASES, PhaseLedger
+from .recompute import OUTCOMES, RECOMPUTE, RecomputeLedger
+from .recompute import STAGES as RECOMPUTE_STAGES
 from .watchdog import INVARIANTS, Finding, Watchdog
 
 __all__ = ["TRACER", "Tracer", "Span", "Trace", "FlightRecorder",
            "NOOP_SPAN", "to_chrome_events", "write_chrome_trace",
            "summarize", "LEDGER", "PHASES", "PhaseLedger", "RECORDER",
            "Watchdog", "Finding", "INVARIANTS", "DEVICEMEM", "TRANSFERS",
-           "UPLOADS"]
+           "UPLOADS", "RECOMPUTE", "RecomputeLedger", "RECOMPUTE_STAGES",
+           "OUTCOMES"]

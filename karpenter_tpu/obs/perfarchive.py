@@ -175,13 +175,28 @@ class GateReport:
     def summary(self) -> str:
         lines = [f"perf-gate: candidate={self.candidate or '-'} "
                  f"({self.reason})"]
+        awaiting = []
         for v in sorted(self.verdicts,
                         key=lambda v: (v.status != "regression",
                                        v.metric)):
-            if v.status != "pass":
+            if v.status == "insufficient-baseline":
+                awaiting.append(v)
+            elif v.status != "pass":
                 lines.append("  " + v.line())
         gated = [v for v in self.verdicts
                  if v.status in ("pass", "regression", "improvement")]
+        if awaiting:
+            # keys too new to gate — surfaced explicitly instead of
+            # silently skipped: a metric stuck here across many runs
+            # means its earlier runs weren't comparable (or the key was
+            # renamed) and nothing will ever gate it
+            lines.append(f"  awaiting first comparable run "
+                         f"({len(awaiting)} metric(s) with no gateable "
+                         f"baseline yet — they gate once a second "
+                         f"comparable run lands):")
+            for v in awaiting:
+                lines.append(f"    {v.metric:<38} value={v.value:g} "
+                             f"(baseline n={v.n})")
         lines.append(f"  {len(gated)} metric(s) gated, "
                      f"{len(self.regressions)} regression(s)")
         lines.append("perf-gate: " + ("PASS" if self.ok else "FAIL"))

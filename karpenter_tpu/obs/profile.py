@@ -131,6 +131,34 @@ def _encode_bucket(span: Span) -> str:
     return "encode_cached" if (misses == 0 and hits > 0) else "encode_cold"
 
 
+def span_bucket(span: Span, trace: Trace) -> Optional[str]:
+    """Static span -> phase bucket classification (no ancestor
+    inheritance — callers walk parent chains themselves). Shared by the
+    PhaseLedger and the RecomputeLedger (obs/recompute.py) so the two
+    planes can never disagree about which bucket a span's self-time
+    lands in."""
+    if span.name == "encode.lower":
+        return _encode_bucket(span)
+    if span.name == "solve.encode":
+        # inherit the classification of its lowering child
+        for c in trace.spans:
+            if (c.parent_id == span.span_id
+                    and c.name == "encode.lower"):
+                return _encode_bucket(c)
+        return "encode_cold"
+    if span.name == "solve.run":
+        backend = span.attrs.get("backend", "")
+        return ("solve_host" if backend in ("host", "native")
+                else "solver_overhead")
+    if span.name.startswith("reconcile:"):
+        return "reconcile_other"
+    if span.name.startswith("disruption."):
+        return "reconcile_other"
+    if span.name.startswith("fault."):
+        return "reconcile_other"
+    return _SPAN_PHASE.get(span.name)
+
+
 class PhaseLedger:
     """Aggregates finished traces into per-(tenant, kind, phase) wall
     time. `kind` is "solve" for bare solve-rooted traces and "reconcile"
@@ -195,26 +223,7 @@ class PhaseLedger:
                                           + s.duration)
 
         def bucket_of(span: Span) -> Optional[str]:
-            if span.name == "encode.lower":
-                return _encode_bucket(span)
-            if span.name == "solve.encode":
-                # inherit the classification of its lowering child
-                for c in trace.spans:
-                    if (c.parent_id == span.span_id
-                            and c.name == "encode.lower"):
-                        return _encode_bucket(c)
-                return "encode_cold"
-            if span.name == "solve.run":
-                backend = span.attrs.get("backend", "")
-                return ("solve_host" if backend in ("host", "native")
-                        else "solver_overhead")
-            if span.name.startswith("reconcile:"):
-                return "reconcile_other"
-            if span.name.startswith("disruption."):
-                return "reconcile_other"
-            if span.name.startswith("fault."):
-                return "reconcile_other"
-            return _SPAN_PHASE.get(span.name)
+            return span_bucket(span, trace)
 
         def tenant_of(span: Span) -> str:
             """Per-span tenant: the span's own `tenant` attr, else the

@@ -113,6 +113,7 @@ INVARIANTS: Tuple[str, ...] = (
     "overload_unbounded",
     "optimizer_divergence",
     "integrity_breach",
+    "recompute_runaway",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -190,6 +191,18 @@ class Watchdog:
     #                           doing its job; persisting across passes
     #                           on CHANGED state (unchanged state skips
     #                           the search entirely) is the divergence
+    RECOMPUTE_FRAC = 0.9      # a stage's redundant work fraction above
+    #                           this arms a runaway excursion
+    RECOMPUTE_GRACE = 900.0   # sim seconds the fraction may sit above
+    #                           RECOMPUTE_FRAC before a STILL-RISING
+    #                           fraction fires (a steady warm cluster
+    #                           legitimately plateaus high — only
+    #                           unbounded growth is the runaway)
+    RECOMPUTE_RISE = 0.005    # the fraction must have risen by at least
+    #                           this much over the grace window to count
+    #                           as rising, not noise
+    RECOMPUTE_MIN_UNITS = 256  # classified units (since arm) a stage
+    #                           needs before its fraction is meaningful
     JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
     MAX_FINDINGS = 256        # bounded finding log
 
@@ -273,6 +286,11 @@ class Watchdog:
         # arm — counter-delta based like the optimizer monitor, so
         # another run's violations never page this one
         self._integrity_base: Dict[str, int] = {}
+        # recompute runaway: stage -> (first-seen-above-frac stamp on the
+        # watchdog clock, redundant fraction at that stamp); unit
+        # baselines at arm so another run's classified work never counts
+        self._recompute: Dict[str, Tuple[float, float]] = {}
+        self._recompute_base: Dict[str, Dict[str, int]] = {}
 
     # --- arming -----------------------------------------------------------
     def arm(self, now: Optional[float] = None) -> "Watchdog":
@@ -305,6 +323,8 @@ class Watchdog:
         self._optimizer_base = dict(OPTIMIZER.reject_streaks())
         from ..integrity import INTEGRITY
         self._integrity_base = dict(INTEGRITY.violations_by_tenant())
+        from .recompute import RECOMPUTE
+        self._recompute_base = RECOMPUTE.stage_units()
         register_debug_route("/debug/watchdog",
                              lambda wd, query: wd.payload(query),
                              owner=self)
@@ -358,6 +378,7 @@ class Watchdog:
         self._check_overload(now, fired)
         self._check_optimizer(now, fired)
         self._check_integrity(now, fired)
+        self._check_recompute(now, fired)
         if self._last_sweep is None or force \
                 or now - self._last_sweep >= self.CLOUD_SWEEP:
             self._last_sweep = now
@@ -377,6 +398,8 @@ class Watchdog:
         self._resident = {k: v + shift for k, v in self._resident.items()}
         self._overload = {k: (t + shift, d)
                           for k, (t, d) in self._overload.items()}
+        self._recompute = {k: (t + shift, f)
+                           for k, (t, f) in self._recompute.items()}
         if self._audit_pending is not None:
             ps, seen = self._audit_pending
             self._audit_pending = (ps, seen + shift)
@@ -805,6 +828,52 @@ class Watchdog:
             elif INTEGRITY.unrecovered(tenant) == 0:
                 self._clear("integrity_breach", tenant)
 
+    def _check_recompute(self, now: float, fired: List[Finding]) -> None:
+        """A recompute-taxonomy stage whose REDUNDANT work fraction sits
+        above RECOMPUTE_FRAC and is still RISING past the grace window —
+        the stage is grinding identical inputs every reconcile and no
+        memo/cache/residency layer is serving the delta. A warm steady
+        cluster legitimately plateaus high (that plateau IS the measured
+        headroom, not a fault), so a steady fraction never fires: only
+        growth beyond RECOMPUTE_RISE over the grace does. Unit counts
+        baseline at arm (another run's classified residue never counts)
+        and the excursion stamp is jump-absorbed like every window."""
+        from .recompute import RECOMPUTE
+        units = RECOMPUTE.stage_units()
+        for stage, row in units.items():
+            base = self._recompute_base.get(stage, {})
+            total = red = 0
+            for outcome, n in row.items():
+                d = n - base.get(outcome, 0)
+                total += d
+                if outcome == "redundant":
+                    red += d
+            if total < self.RECOMPUTE_MIN_UNITS:
+                continue
+            frac = red / total
+            if frac <= self.RECOMPUTE_FRAC:
+                self._recompute.pop(stage, None)
+                self._clear("recompute_runaway", stage)
+                continue
+            first = self._recompute.get(stage)
+            if first is None:
+                self._recompute[stage] = (now, frac)
+                continue
+            t0, f0 = first
+            age = now - t0
+            if age >= self.RECOMPUTE_GRACE and frac > f0 + self.RECOMPUTE_RISE:
+                self._fire(fired, "recompute_runaway", "warning", stage,
+                           f"stage {stage}: redundant work fraction "
+                           f"{frac:.3f} above {self.RECOMPUTE_FRAC:g} and "
+                           f"still rising (was {f0:.3f} {age:.0f}s ago, "
+                           f"grace {self.RECOMPUTE_GRACE:g}s) over "
+                           f"{total} classified units — the stage "
+                           f"recomputes unchanged inputs every pass and "
+                           f"nothing serves the delta", now,
+                           stage=stage, frac=round(frac, 4),
+                           first_frac=round(f0, 4), units=total,
+                           age_s=round(age, 1))
+
     # --- firing / clearing ------------------------------------------------
     def _fire(self, fired: List[Finding], invariant: str, severity: str,
               key: str, message: str, now: float, **attrs) -> None:
@@ -918,7 +987,9 @@ class Watchdog:
                            "devicemem_s": self.DEVICEMEM_GRACE,
                            "resident_s": self.RESIDENT_GRACE,
                            "overload_s": self.overload_grace,
-                           "optimizer_streak": self.OPTIMIZER_STREAK},
+                           "optimizer_streak": self.OPTIMIZER_STREAK,
+                           "recompute_s": self.RECOMPUTE_GRACE,
+                           "recompute_frac": self.RECOMPUTE_FRAC},
                 "stats": dict(self.stats),
                 "fired": dict(self._fired),
                 "watchlist": {"claims": len(self._claims),

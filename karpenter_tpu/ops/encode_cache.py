@@ -242,10 +242,15 @@ class EncodeContext:
         consumer reads it (splits/rebuilds derive NEW matrices), and the
         write lock turns a future in-place mutation into a loud error
         instead of silent cross-solve corruption."""
+        from ..obs.recompute import RECOMPUTE, fingerprint
         hit = self._conflict_memo
         if hit is not None and hit[0] == key:
+            RECOMPUTE.classify("conflict", served=True)
             return hit[1]
-        m = build()
+        from ..obs.tracer import TRACER
+        with TRACER.span("encode.conflicts", groups=len(key)):
+            m = build()
+        RECOMPUTE.classify("conflict", fingerprint(key))
         if m is not None:
             m.setflags(write=False)
         self._conflict_memo = (key, m)

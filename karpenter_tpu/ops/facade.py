@@ -725,6 +725,11 @@ class Solver:
                 occupancy += [
                     (self._zone_of(name, existing, cat), placed)
                     for name, placed in plan.existing_placements.items()]
+        from ..obs.recompute import RECOMPUTE, encoded_fingerprint, fingerprint
+        occ_sig = tuple(sorted((zone, len(placed))
+                               for zone, placed in occupancy))
+        RECOMPUTE.classify("affinity",
+                           fingerprint(encoded_fingerprint(enc), occ_sig))
         sp = (TRACER.span("solve.spread") if TRACER.enabled else NOOP_SPAN)
         with sp:
             asp = (TRACER.span("encode.affinity") if TRACER.enabled
@@ -734,6 +739,8 @@ class Solver:
             enc = split_spread_groups(
                 enc, cat, self._spread_constraints(enc, cat, occupancy))
             sp.set(groups=int(enc.G))
+        post_fp = encoded_fingerprint(enc)
+        RECOMPUTE.classify("spread", fingerprint(post_fp, occ_sig, "spread"))
         if enc.G == 0:
             out = self._merge_plan(SolveOutput([], {}, dropped), plan,
                                    cat, nodepool)
@@ -751,6 +758,11 @@ class Solver:
             # the C++ FFD takes a flat [T, R] allocatable; zone-varying
             # reservations need the masked-max path — host oracle instead
             backend = "host"
+        # the gbuf identity a solve dispatch is about to grind: an
+        # unchanged fingerprint re-solved from scratch is the redundant
+        # solve work a warm admission / residency layer should serve
+        RECOMPUTE.classify("solve", fingerprint(
+            post_fp, self._last_cat_key, backend, int(enc.counts.sum())))
         return PreparedSolve(
             cat=cat, cat_key=self._last_cat_key, enc=enc,
             existing=existing, plan=plan, dropped=dropped,
@@ -1221,12 +1233,19 @@ class Solver:
         self._meter_encode_rows(enc_ctx)
         self._apply_min_values_caps(enc, cat, nodepool.requirements)
         dropped = enc.dropped_keys  # split_spread_groups rebuilds the enc
+        from ..obs.recompute import RECOMPUTE, encoded_fingerprint, fingerprint
+        occ_sig = tuple(sorted((zone, len(placed))
+                               for zone, placed in occupancy))
+        RECOMPUTE.classify("affinity",
+                           fingerprint(encoded_fingerprint(enc), occ_sig))
         asp = (TRACER.span("encode.affinity", warm=True) if TRACER.enabled
                else NOOP_SPAN)
         with asp:
             enc = apply_zone_affinity(enc, cat, occupancy)
         enc = split_spread_groups(
             enc, cat, self._spread_constraints(enc, cat, occupancy))
+        RECOMPUTE.classify("spread", fingerprint(
+            encoded_fingerprint(enc), occ_sig, "spread"))
         enc.dropped_keys = dropped
         if enc.G:
             self._relax_infeasible_preferences(enc, cat)

@@ -216,4 +216,9 @@ class WarmAdmitter:
             ledger.nodes = nodes
             for c, pods in placements.items():
                 ledger.existing_pods.setdefault(c, []).extend(pods)
+            # solve work the standing ledger answered without a gbuf
+            # dispatch — the delta-served outcome the c16 regime's
+            # warm-admit floor measures
+            from ..obs.recompute import RECOMPUTE
+            RECOMPUTE.classify("solve", served=True, units=len(want))
         return WarmAdmission(placements, want, passthrough, escalated)

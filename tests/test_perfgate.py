@@ -205,6 +205,30 @@ class TestGate:
         assert all(v.status == "insufficient-baseline"
                    for v in report.verdicts)
 
+    def test_awaiting_baseline_rendered_explicitly(self, tmp_path):
+        """ISSUE 16 satellite: keys with no comparable baseline yet
+        (first run of a new bench regime, e.g. the c16 keys) render as
+        an explicit 'awaiting first comparable run' section with the
+        candidate value — not silently dropped from the summary."""
+        runs = [_run(f"r{i}", dict(BASE)) for i in range(3)]
+        runs.append(_run("cand", dict(
+            BASE, c16_full_reconcile_p50_ms=12.5)))
+        report = _archive(tmp_path, runs).gate()
+        assert report.ok
+        text = report.summary()
+        assert "awaiting first comparable run" in text
+        assert "c16_full_reconcile_p50_ms" in text
+        assert "value=12.5" in text
+        # gated metrics never land in the awaiting section
+        assert "headline_ms" not in text.split(
+            "awaiting first comparable run", 1)[1]
+
+    def test_all_gated_summary_has_no_awaiting_section(self, tmp_path):
+        runs = [_run(f"r{i}", dict(BASE)) for i in range(4)]
+        report = _archive(tmp_path, runs).gate()
+        assert report.ok
+        assert "awaiting first comparable run" not in report.summary()
+
     def test_noise_within_mad_floor_passes(self, tmp_path):
         """A dead-stable baseline (MAD 0) still tolerates timer noise:
         the MAD floor keeps a 1.05x wiggle from flagging."""

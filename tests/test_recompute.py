@@ -1,0 +1,363 @@
+"""Recompute observatory (obs/recompute.py): the work-provenance
+ledger — fingerprint classification (fresh/redundant/delta_served),
+ms/bytes attribution riding the PhaseLedger span buckets, the coverage
+invariant, and the /debug/recompute route.
+
+The stage/outcome tables in TestClassifyTaxonomy are the canonical test
+coverage of the recompute taxonomy — `make obs-audit` requires every
+STAGES and OUTCOMES name to appear in this file as a string constant,
+so a new stage without a row here fails the audit."""
+
+import json
+import time
+import types
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.obs.recompute import (COVERAGE_TARGET, OUTCOMES,
+                                         RECOMPUTE, STAGES,
+                                         RecomputeLedger,
+                                         encoded_fingerprint, fingerprint,
+                                         fingerprint_bytes,
+                                         fingerprint_fold,
+                                         fingerprint_rows, format_report)
+from karpenter_tpu.obs.tracer import TRACER, FlightRecorder
+
+EMPTY_FP = 0x9E3779B97F4A7C15
+
+
+@pytest.fixture
+def ring():
+    """Swap the global flight-recorder ring (gap markers land there)
+    and restore after."""
+    saved = TRACER.recorder
+    TRACER.recorder = FlightRecorder(8)
+    yield TRACER.recorder
+    TRACER.recorder = saved
+
+
+@pytest.fixture
+def armed():
+    """The singleton with the global tracer enabled: classification
+    pending rides TRACER.current_trace_id(), so attribution tests must
+    classify on RECOMPUTE inside real TRACER traces. Reset both ways."""
+    saved = TRACER.enabled
+    RECOMPUTE.reset()
+    TRACER.configure(enabled=True)
+    yield RECOMPUTE
+    TRACER.configure(enabled=saved)
+    RECOMPUTE.reset()
+
+
+class TestFingerprints:
+    def test_deterministic_and_input_sensitive(self):
+        assert fingerprint("a", 1) == fingerprint("a", 1)
+        assert fingerprint("a", 1) != fingerprint("a", 2)
+        assert fingerprint("a", 1) != fingerprint("a1")
+        fp = fingerprint_bytes(b"x")
+        assert 0 <= fp < 2**64
+        assert fingerprint_bytes(b"") == EMPTY_FP
+
+    def test_row_fingerprints_are_per_row(self):
+        m = np.asarray([[1.0, 2.0], [3.0, 4.0], [1.0, 2.0]],
+                       dtype=np.float32)
+        fps = fingerprint_rows(m)
+        assert fps.shape == (3,)
+        assert int(fps[0]) == int(fps[2]) != int(fps[1])
+        # aligned matrices combine per logical row
+        z = np.zeros(3, dtype=np.float32)  # 1-D is accepted
+        combined = fingerprint_rows(m, z)
+        assert combined.shape == (3,)
+        assert int(combined[0]) != int(fps[0])
+
+    def test_fold_is_order_sensitive(self):
+        assert fingerprint_fold([1, 2, 3]) == fingerprint_fold([1, 2, 3])
+        assert fingerprint_fold([1, 2, 3]) != fingerprint_fold([3, 2, 1])
+        assert fingerprint_fold([]) == EMPTY_FP
+
+    def _enc(self, seed=0.0):
+        return types.SimpleNamespace(
+            G=2,
+            requests=np.asarray([[1.0 + seed, 2.0], [3.0, 4.0]],
+                                dtype=np.float32),
+            compat=np.asarray([[1.0, 0.0], [0.0, 1.0]], dtype=np.float32),
+            allow_zone=np.ones((2, 3), dtype=np.float32),
+            allow_cap=np.ones((2, 2), dtype=np.float32),
+            counts=np.asarray([4, 8], dtype=np.int32))
+
+    def test_encoded_fingerprint_tracks_solve_content(self):
+        assert encoded_fingerprint(self._enc()) == \
+            encoded_fingerprint(self._enc())
+        assert encoded_fingerprint(self._enc()) != \
+            encoded_fingerprint(self._enc(seed=0.5))
+        changed = self._enc()
+        changed.counts = np.asarray([4, 9], dtype=np.int32)
+        assert encoded_fingerprint(changed) != \
+            encoded_fingerprint(self._enc())
+        assert encoded_fingerprint(types.SimpleNamespace(G=0)) == EMPTY_FP
+
+
+class TestClassifyTaxonomy:
+    # one classification row per taxonomy stage — the obs-audit
+    # contract: every STAGES name appears here as a string constant.
+    STAGE_CASES = [
+        ("encode", 11),      # pod->tensor lowering, per signature group
+        ("conflict", 22),    # anti-affinity conflict-matrix build
+        ("affinity", 33),    # zone-affinity pre-pass
+        ("spread", 44),      # topology-spread split
+        ("solve", 55),       # gbuf dispatch / warm admission
+        ("optimizer", 66),   # consolidation screen + subset search
+        ("disrupt", 77),     # drift/expiry/candidate classification
+    ]
+    # ...and every OUTCOMES name.
+    OUTCOME_CASES = ["fresh", "redundant", "delta_served"]
+
+    def test_tables_cover_taxonomy_exactly(self):
+        assert [s for s, _ in self.STAGE_CASES] == list(STAGES)
+        assert self.OUTCOME_CASES == list(OUTCOMES)
+
+    def test_every_stage_walks_all_outcomes(self):
+        led = RecomputeLedger()
+        for stage, fp in self.STAGE_CASES:
+            assert led.classify(stage, fp) == "fresh"
+            assert led.classify(stage, fp) == "redundant"
+            assert led.classify(stage, served=True) == "delta_served"
+        units = led.stage_units()
+        for stage, _fp in self.STAGE_CASES:
+            assert units[stage] == {"fresh": 1, "redundant": 1,
+                                    "delta_served": 1}
+            assert led.redundant_frac(stage) == pytest.approx(1 / 3)
+
+    def test_classify_rows_batches_under_one_lock(self):
+        led = RecomputeLedger()
+        fresh, redundant = led.classify_rows(
+            "encode", np.asarray([1, 2, 3, 1, 2], dtype=np.uint64))
+        assert (fresh, redundant) == (3, 2)
+        assert led.stage_units()["encode"] == {"fresh": 3, "redundant": 2}
+
+    def test_zero_units_never_recorded(self):
+        led = RecomputeLedger()
+        led.classify("solve", 1, units=0)
+        led.classify("solve", served=True, units=-3)
+        assert led.stage_units() == {}
+
+    def test_seen_lru_is_bounded(self):
+        led = RecomputeLedger(seen_cap=4)
+        for fp in range(1, 9):
+            assert led.classify("encode", fp) == "fresh"
+        # 5..8 survive; 1 was evicted and counts as fresh work again
+        assert led.classify("encode", 8) == "redundant"
+        assert led.classify("encode", 1) == "fresh"
+        assert led.snapshot()["seen_cap"] == 4
+
+    def test_tenant_scoped_fingerprint_memory(self):
+        led = RecomputeLedger()
+        assert led.classify("solve", 9, tenant="a") == "fresh"
+        assert led.classify("solve", 9, tenant="b") == "fresh"
+        assert led.classify("solve", 9, tenant="a") == "redundant"
+        assert {"a", "b"} <= set(led.snapshot()["tenants"])
+
+    def test_repeat_determinism(self):
+        """The chaos contract's unit half: the same call sequence
+        yields an identical snapshot (no Python hash(), no wall time
+        in the unit counters)."""
+        def drive(led):
+            for stage, fp in self.STAGE_CASES:
+                led.classify(stage, fingerprint(stage, fp))
+                led.classify(stage, fingerprint(stage, fp))
+                led.classify(stage, served=True, units=2)
+            led.classify_rows("encode",
+                             fingerprint_rows(np.eye(3, dtype=np.float32)))
+            return led.snapshot()
+
+        assert drive(RecomputeLedger()) == drive(RecomputeLedger())
+
+    def test_metric_families_move(self):
+        from karpenter_tpu.metrics import (RECOMPUTE_WORK,
+                                           REDUNDANT_WORK_FRAC)
+        led = RecomputeLedger()
+        base = RECOMPUTE_WORK.value(stage="spread", outcome="fresh",
+                                    tenant="metric-probe")
+        led.classify("spread", 5, tenant="metric-probe")
+        led.classify("spread", 5, tenant="metric-probe")
+        assert RECOMPUTE_WORK.value(stage="spread", outcome="fresh",
+                                    tenant="metric-probe") == base + 1
+        assert RECOMPUTE_WORK.value(stage="spread", outcome="redundant",
+                                    tenant="metric-probe") >= 1
+        assert REDUNDANT_WORK_FRAC.value(stage="spread") == 0.5
+
+
+class TestAttribution:
+    def test_ms_split_proportionally_by_outcome_units(self, armed, ring):
+        with TRACER.trace("engine.tick"):
+            with TRACER.span("encode.lower", cache_hits=0,
+                             cache_misses=1):
+                time.sleep(0.01)
+                armed.classify("encode", fingerprint("g1"))
+                armed.classify("encode", fingerprint("g1"))  # redundant
+            with TRACER.span("solve.run", backend="host"):
+                time.sleep(0.005)
+                armed.classify("solve", fingerprint("batch"))
+        snap = armed.snapshot()
+        enc = snap["stages"]["encode"]
+        assert enc["wall_ms"] >= 10.0
+        assert enc["unattributed_ms"] == 0.0
+        assert enc["ms"]["fresh"] == pytest.approx(enc["ms"]["redundant"])
+        assert snap["stages"]["solve"]["ms"]["fresh"] >= 5.0
+        assert snap["coverage"] >= COVERAGE_TARGET
+        assert armed.coverage() >= COVERAGE_TARGET
+        assert not [t for t in ring.slowest()
+                    if t.root.name == "recompute.unattributed"]
+
+    def test_transfer_bytes_ride_the_outcome_mix(self, armed):
+        with TRACER.trace("engine.tick"):
+            with TRACER.span("solve.device_put", h2d_bytes=512):
+                armed.classify("solve", fingerprint("up"))
+            with TRACER.span("solve.readback", d2h_bytes=128):
+                pass
+        b = armed.snapshot()["stages"]["solve"]["bytes"]
+        assert b["fresh"] == 512 + 128
+        assert b["redundant"] == 0
+
+    def test_unattributed_gap_metered_and_flight_recorded(self, armed,
+                                                          ring):
+        """Taxonomy-stage wall with no classification in its trace:
+        coverage drops below target, the gap counter moves, and a
+        recompute.unattributed marker lands in the ring naming the
+        unclassified stage."""
+        with TRACER.trace("engine.tick"):
+            with TRACER.span("encode.lower", cache_hits=0,
+                             cache_misses=1):
+                time.sleep(0.02)  # nothing classified
+        assert armed.coverage() < COVERAGE_TARGET
+        assert armed.unattributed_ms() >= 15.0
+        markers = [t for t in ring.slowest()
+                   if t.root.name == "recompute.unattributed"]
+        assert markers, "gap must be flight-recorded"
+        attrs = markers[0].root.attrs
+        assert attrs["coverage"] < COVERAGE_TARGET
+        assert attrs["gap_ms"] >= 15.0
+        assert attrs["source_trace"] and "encode" in attrs["stages"]
+
+    def test_glue_buckets_outside_coverage_denominator(self, armed,
+                                                       ring):
+        """Decision-output glue (launch/bind/commit...) is not taxonomy
+        work: a glue-only trace neither opens a gap nor grows a stage."""
+        with TRACER.trace("engine.tick"):
+            with TRACER.span("provision.launch"):
+                time.sleep(0.01)
+        assert armed.coverage() == 1.0
+        assert armed.snapshot()["stages"] == {}
+        assert not [t for t in ring.slowest()
+                    if t.root.name == "recompute.unattributed"]
+
+    def test_unmapped_child_inherits_stage(self, armed):
+        with TRACER.trace("engine.tick"):
+            with TRACER.span("optimizer.search", candidates=2):
+                armed.classify("optimizer", fingerprint("subset"))
+                with TRACER.span("totally.unmapped.child"):
+                    time.sleep(0.005)
+        st = armed.snapshot()["stages"]["optimizer"]
+        assert st["wall_ms"] >= 5.0
+        assert st["unattributed_ms"] == 0.0
+
+    def test_conflict_span_maps_to_conflict_stage(self, armed):
+        with TRACER.trace("engine.tick"):
+            with TRACER.span("encode.conflicts", groups=3):
+                armed.classify("conflict", fingerprint("key"))
+        st = armed.snapshot()["stages"]["conflict"]
+        assert st["wall_ms"] > 0 and st["unattributed_ms"] == 0.0
+
+    def test_disruption_spans_split_screen_from_classification(self,
+                                                               armed):
+        with TRACER.trace("reconcile:disruption"):
+            with TRACER.span("disruption.screen"):
+                armed.classify("optimizer", served=True)
+            with TRACER.span("disruption.candidates"):
+                armed.classify("disrupt", fingerprint("pool"))
+        snap = armed.snapshot()
+        assert snap["stages"]["optimizer"]["units"]["delta_served"] == 1
+        assert snap["stages"]["disrupt"]["units"]["fresh"] == 1
+        assert snap["stages"]["optimizer"]["wall_ms"] > 0
+        assert snap["stages"]["disrupt"]["wall_ms"] > 0
+
+    def test_adhoc_roots_are_not_ledger_material(self, armed):
+        with TRACER.trace("my-adhoc-trace"):
+            with TRACER.span("encode.lower", cache_hits=0,
+                             cache_misses=1):
+                armed.classify("encode", fingerprint("x"))
+                time.sleep(0.002)
+        assert armed.traces == 0
+        assert armed.coverage() == 1.0
+        # the pending entry is consumed even for non-material roots
+        assert armed._pending == {}
+
+    def test_reset_clears_everything(self, armed):
+        with TRACER.trace("engine.tick"):
+            with TRACER.span("solve.run", backend="host"):
+                armed.classify("solve", fingerprint("r"))
+        armed.reset()
+        snap = armed.snapshot()
+        assert snap["stages"] == {} and snap["traces"] == 0
+        assert armed.coverage() == 1.0 and armed.unattributed_ms() == 0.0
+
+
+class TestReadSide:
+    def test_debug_recompute_route(self, armed):
+        from karpenter_tpu.obs.exposition import render
+        armed.classify("affinity", fingerprint("zone"),
+                       tenant="route-probe")
+        status, ctype, body = render("/debug/recompute")
+        assert status == 200 and "json" in ctype
+        doc = json.loads(body)
+        assert doc["taxonomy"] == list(STAGES)
+        assert doc["outcomes"] == list(OUTCOMES)
+        assert "route-probe" in doc["tenants"]
+
+    def test_format_report_renders_headroom_table(self):
+        led = RecomputeLedger()
+        led.classify("encode", 1)
+        led.classify("encode", 1)
+        led.classify("solve", served=True)
+        txt = led.report()
+        assert "recompute observatory" in txt
+        assert "encode" in txt and "coverage" in txt
+        assert "(no work observed)" in txt  # unexercised stages named
+        assert format_report({}).startswith(
+            "recompute report: no work classified yet")
+
+    def test_ingest_is_defensive(self):
+        led = RecomputeLedger()
+        led.classify("disrupt", 3)
+        led.ingest(object())  # not a Trace — must not raise
+        assert led.errors == 1
+        assert "WARNING" in led.report()
+
+
+class TestEndToEnd:
+    def test_real_reconcile_classifies_encode_and_solve(self):
+        """The wiring half: a plain sim reconcile moves the singleton's
+        encode and solve stages without any test-side classification."""
+        from karpenter_tpu.models.pod import Pod
+        from karpenter_tpu.models.resources import Resources
+        from karpenter_tpu.sim import make_sim
+        RECOMPUTE.reset()
+        try:
+            sim = make_sim()
+            for i in range(12):
+                sim.store.add_pod(Pod(
+                    name=f"rc-{i}",
+                    requests=Resources.parse({"cpu": "500m",
+                                              "memory": "1Gi"})))
+            ok = sim.engine.run_until(
+                lambda: all(p.node_name
+                            for p in sim.store.pods.values()),
+                timeout=60)
+            assert ok
+            units = RECOMPUTE.stage_units()
+            assert "encode" in units and "solve" in units
+            assert sum(units["encode"].values()) > 0
+            assert sum(units["solve"].values()) > 0
+        finally:
+            RECOMPUTE.reset()

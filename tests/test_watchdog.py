@@ -63,7 +63,8 @@ class TestArming:
             "pipeline_stall", "profile_unattributed",
             "trace_ring_overflow", "devicemem_leak",
             "resident_staleness", "overload_unbounded",
-            "optimizer_divergence", "integrity_breach")
+            "optimizer_divergence", "integrity_breach",
+            "recompute_runaway")
 
 
 class TestTrips:
@@ -396,6 +397,107 @@ class TestTrips:
         wd.tick(force=True)
         assert wd.verdict() == "critical"
         INTEGRITY.reset()
+
+    def test_trip_recompute_runaway(self):
+        """Seeded runaway: a stage whose redundant work fraction sits
+        above RECOMPUTE_FRAC and keeps RISING past the grace fires a
+        warning once (edge-triggered, keyed by the stage); a steady
+        plateau — however high — never fires (the plateau is measured
+        headroom, not a fault), and pre-arm residue never counts."""
+        from karpenter_tpu.obs.recompute import RECOMPUTE
+        RECOMPUTE.reset()
+        # pre-arm residue: an all-redundant stage from "another run"
+        RECOMPUTE.classify("encode", 1)
+        for _ in range(600):
+            RECOMPUTE.classify("encode", 1)
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "recompute_runaway")  # residue is quiet
+        # below the unit floor: fraction is meaningless, quiet
+        for _ in range(Watchdog.RECOMPUTE_MIN_UNITS // 4):
+            RECOMPUTE.classify("solve", 7)
+        _age(wd, 30)
+        assert not _findings(wd, "recompute_runaway")
+        # a real runaway: just past 90% redundant and RISING across the
+        # grace. Seed the excursion stamp at ~0.91...
+        for i in range(29):
+            RECOMPUTE.classify("solve", 100 + i)  # fresh variety
+        for _ in range(240):
+            RECOMPUTE.classify("solve", 7)
+        wd.tick(force=True)
+        assert not _findings(wd, "recompute_runaway")  # stamped, quiet
+        # ...age THROUGH the grace while the fraction keeps growing
+        # (pure redundant grinding every window)
+        for _ in range(300):
+            RECOMPUTE.classify("solve", 7)
+        _age(wd, Watchdog.RECOMPUTE_GRACE + 30)
+        found = _findings(wd, "recompute_runaway")
+        assert found and found[0].severity == "warning"
+        assert found[0].key == "solve"
+        assert found[0].attrs["frac"] > Watchdog.RECOMPUTE_FRAC
+        wd.tick(force=True)
+        assert len(_findings(wd, "recompute_runaway")) == 1  # edge
+        assert wd.verdict() == "warning"
+        # fresh work dilutes the fraction under the bar: clears
+        for i in range(2000):
+            RECOMPUTE.classify("solve", 10_000 + i)
+        wd.tick(force=True)
+        assert wd.verdict() == "ok"
+        RECOMPUTE.reset()
+
+    def test_recompute_steady_plateau_never_fires(self):
+        """The false-positive side: a warm steady cluster legitimately
+        plateaus at a HIGH redundant fraction — above the bar but not
+        rising beyond RECOMPUTE_RISE, the monitor stays quiet forever."""
+        from karpenter_tpu.obs.recompute import RECOMPUTE
+        RECOMPUTE.reset()
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        # establish a high plateau: ~95% redundant disrupt work
+        for i in range(25):
+            RECOMPUTE.classify("disrupt", i)
+        for _ in range(475):
+            RECOMPUTE.classify("disrupt", 1)
+        wd.tick(force=True)
+        # keep the MIX steady while aging far past the grace: every
+        # window adds the same redundant:fresh ratio, so the fraction
+        # converges (rises less than RECOMPUTE_RISE) — never fires
+        for window in range(10):
+            _age(wd, Watchdog.RECOMPUTE_GRACE / 2)
+            for i in range(2):
+                RECOMPUTE.classify("disrupt", 50_000 + 100 * window + i)
+            for _ in range(38):
+                RECOMPUTE.classify("disrupt", 1)
+        assert not _findings(wd, "recompute_runaway")
+        assert wd.verdict() == "ok"
+        RECOMPUTE.reset()
+
+    def test_recompute_jump_absorbed(self):
+        """A chaos ClockJump mid-excursion must not fast-forward the
+        grace window: the excursion stamp shifts with the jump and the
+        monitor stays quiet until genuine aging crosses the grace."""
+        from karpenter_tpu.obs.recompute import RECOMPUTE
+        RECOMPUTE.reset()
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        for i in range(30):
+            RECOMPUTE.classify("spread", 100 + i)  # fresh variety
+        for _ in range(290):
+            RECOMPUTE.classify("spread", 1)  # ~0.90 at the stamp
+        wd.tick(force=True)  # stamps the excursion
+        # keep the fraction rising so only TIME separates quiet/fire
+        for _ in range(200):
+            RECOMPUTE.classify("spread", 1)
+        clock.step(Watchdog.RECOMPUTE_GRACE + 120)  # one giant jump
+        wd.tick()
+        assert not _findings(wd, "recompute_runaway")  # absorbed
+        assert wd.stats["jump_absorbed"] >= 1
+        for _ in range(200):
+            RECOMPUTE.classify("spread", 1)
+        _age(wd, Watchdog.RECOMPUTE_GRACE + 30)  # genuine aging fires
+        assert _findings(wd, "recompute_runaway")
+        RECOMPUTE.reset()
 
     def test_overload_jump_absorbed(self):
         """A clock jump over an in-grace excursion must not age it into

@@ -15,6 +15,10 @@ Six invariants that otherwise rot silently:
    STYLE negative coverage in tests/test_watchdog.py: a seeded fault
    scenario that TRIPS it (`def test_trip_<invariant>`) — a monitor
    nothing can trip is dead code wearing a green badge;
+   3b. every recompute-taxonomy stage and outcome (obs/recompute.STAGES /
+   OUTCOMES) is exercised by the canonical work-provenance tests
+   (tests/test_recompute.py) — same rationale as the phase buckets:
+   a stage nothing classifies is a headroom table row nobody measured;
 4. every residency-ledger owner kind (obs/devicemem.OWNER_KINDS) and
    transfer reason (TRANSFER_REASONS) is exercised by the canonical
    device-telemetry tests (tests/test_devicemem.py);
@@ -87,6 +91,24 @@ def audit() -> int:
                 f"tripping it — tests/test_watchdog.py needs a "
                 f"`def test_trip_{inv}` (mutation-style negative coverage)")
 
+    from karpenter_tpu.obs.recompute import OUTCOMES, STAGES
+    rc_idx = test_index(os.path.join(ROOT, "tests", "test_recompute.py"))
+    if not rc_idx.exists:
+        failures.append("tests/test_recompute.py (the canonical work-"
+                        "provenance tests) is missing")
+    for stage in STAGES:
+        if not rc_idx.exercises(stage):
+            failures.append(
+                f"recompute stage '{stage}' is in the taxonomy but no "
+                f"test function in tests/test_recompute.py constructs it "
+                f"(comments/docstrings don't count)")
+    for outcome in OUTCOMES:
+        if not rc_idx.exercises(outcome):
+            failures.append(
+                f"recompute outcome '{outcome}' is in the taxonomy but "
+                f"no test function in tests/test_recompute.py "
+                f"constructs it")
+
     dm_idx = test_index(os.path.join(ROOT, "tests", "test_devicemem.py"))
     if not dm_idx.exists:
         failures.append("tests/test_devicemem.py (the canonical device-"
@@ -137,6 +159,8 @@ def audit() -> int:
     print(f"obs-audit: ok ({len(M.REGISTRY._metrics)} metric families "
           f"documented, {len(PHASES)} phase buckets test-covered, "
           f"{len(INVARIANTS)} watchdog invariants trip-covered, "
+          f"{len(STAGES)} recompute stages + {len(OUTCOMES)} outcomes "
+          f"test-covered, "
           f"{len(OWNER_KINDS)} residency owner kinds + "
           f"{len(TRANSFER_REASONS)} transfer reasons test-covered, "
           f"{len(CHECKS)} integrity checks trip-covered, "

@@ -1,0 +1,124 @@
+"""Recompute headroom report — `make recompute-report`.
+
+A CPU-friendly probe of the work-provenance plane (obs/recompute.py):
+drives a small warm steady-state cluster — settle, then a few 1%-churn
+reconcile rounds plus quiet no-change disruption passes — with tracing
+on, and renders the per-stage headroom table the ROADMAP item 3 builder
+spends:
+
+- units of work per taxonomy stage (encode, conflict, affinity, spread,
+  solve, optimizer, disrupt) split fresh / redundant / delta-served,
+- the redundant fraction and the redundant traced wall per stage (the
+  measured win of making that stage delta-aware),
+- the attribution coverage over the traced taxonomy wall (the ≥99%
+  invariant; the gap per stage is work no classify() call owned).
+
+Prints one human table and one JSON line, so it serves both a terminal
+spot-check and scripted regression tracking.
+
+Usage:
+    python tools/recompute_report.py [--pods 600] [--rounds 4]
+                                     [--quiet-passes 3] [--json-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", type=int, default=600,
+                    help="churnable resident pods in the probe cluster")
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="1%%-churn reconcile rounds after settle")
+    ap.add_argument("--quiet-passes", type=int, default=3,
+                    help="no-change disruption passes (the unchanged-"
+                         "candidate-set redundancy signal)")
+    ap.add_argument("--json-only", action="store_true",
+                    help="suppress the human table")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from karpenter_tpu.cloud.fake import FakeCloudConfig
+    from karpenter_tpu.models import labels as L
+    from karpenter_tpu.models.pod import (Pod, PodAffinityTerm,
+                                          TopologySpreadConstraint)
+    from karpenter_tpu.models.resources import Resources
+    from karpenter_tpu.obs.recompute import RECOMPUTE, format_report
+    from karpenter_tpu.obs.tracer import TRACER
+    from karpenter_tpu.sim import make_sim
+
+    sim = make_sim(warmpath=True,
+                   cloud_config=FakeCloudConfig(
+                       node_ready_delay=1.0, register_delay=0.5,
+                       create_fleet_rate=1e6, create_fleet_burst=10**6))
+    manifests = max(16, args.pods // 20)
+
+    def mk(i: int, gen: int = 0) -> Pod:
+        s = (i + 131 * gen) % manifests
+        kw = dict(requests=Resources.parse({"cpu": "100m",
+                                            "memory": "128Mi"}),
+                  labels={"app": f"svc-{s % 8}"})
+        if s % 3 == 0:
+            kw["topology_spread"] = [TopologySpreadConstraint(
+                topology_key=L.ZONE, max_skew=1)]
+        return Pod(name=f"rc-{gen}-{i}", **kw)
+
+    for i in range(max(16, args.pods // 10)):
+        sim.store.add_pod(Pod(
+            name=f"rc-standing-{i}", labels={"app": "standing"},
+            requests=Resources.parse({"cpu": "500m", "memory": "512Mi"}),
+            affinity_terms=[PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": "standing"}, anti=True)]))
+    live = [mk(i) for i in range(args.pods)]
+    for p in live:
+        sim.store.add_pod(p)
+    sim.engine.run_until(
+        lambda: all(p.node_name for p in sim.store.pods.values()),
+        timeout=600.0, step=1.0)
+    RECOMPUTE.reset()  # steady state only, not the build-up
+    churn = max(1, args.pods // 100)
+    TRACER.configure(enabled=True)
+    try:
+        for rnd in range(1, args.rounds + 1):
+            for p in live[:churn]:
+                sim.store.delete_pod(p.namespace, p.name)
+            fresh = [mk(i, gen=rnd) for i in range(churn)]
+            for p in fresh:
+                sim.store.add_pod(p)
+            live = live[churn:] + fresh
+            with TRACER.trace("reconcile.profile", config="recompute_report"):
+                sim.provisioner.reconcile(sim.clock.now())
+                sim.disruption.reconcile(sim.clock.now())
+        for _ in range(args.quiet_passes):
+            with TRACER.trace("reconcile.profile", config="recompute_quiet"):
+                sim.disruption.reconcile(sim.clock.now())
+    finally:
+        TRACER.configure(enabled=False)
+
+    snap = RECOMPUTE.snapshot()
+    if not args.json_only:
+        print(f"probe: {args.pods} resident pods, {args.rounds} churn "
+              f"round(s) ({churn}/round), {args.quiet_passes} quiet "
+              f"pass(es)\n")
+        print(format_report(snap))
+        print()
+    print(json.dumps({
+        "pods": args.pods, "rounds": args.rounds,
+        "quiet_passes": args.quiet_passes,
+        "coverage": snap["coverage"],
+        "unattributed_ms": snap["unattributed_ms"],
+        "stages": snap["stages"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
