@@ -8,7 +8,7 @@ PY ?= python
         crash-audit warmpath-audit encode-report fleet fleet-audit \
         perf-gate device-report resident-report soak soak-audit \
         disrupt-report integrity-report recompute-report lint \
-        lint-baseline clean
+        lint-baseline federation federation-audit federation-report clean
 
 help:
 	@grep -E '^[a-z0-9-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -72,6 +72,18 @@ fleet:  ## drive TENANTS (default 50) tenant control planes through one process 
 fleet-audit:  ## fleet reproducibility: fleet_smoke at 2 seeds x --repeat 2, identical per-tenant end-state hashes required (batched dispatch must repeat too)
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --seeds 2 --repeat 2
 	$(PY) -m karpenter_tpu.fleet fleet_smoke --seeds 1 --repeat 2 --batch
+
+federation:  ## federation plane: fleet buckets over the wire (embedded server + in-memory transport), digests must match the in-process run
+	$(PY) -m karpenter_tpu.fleet federation_smoke --tenants $(or $(TENANTS),50) --batch
+	$(PY) -m karpenter_tpu.fleet federation_smoke --tenants $(or $(TENANTS),50) --federate
+	$(PY) -m karpenter_tpu.fleet fleet_noisy_neighbor --federate
+
+federation-audit:  ## federation reproducibility: federation_smoke at 2 seeds x --repeat 2 through the wire (identical digests required)
+	$(PY) -m karpenter_tpu.fleet federation_smoke --seeds 2 --repeat 2 --federate
+	$(PY) -m karpenter_tpu.fleet federation_smoke --seeds 1 --repeat 2 --batch
+
+federation-report:  ## federation wire economics: per-process throughput, catalog-share hit rate, wire bytes vs tensor bytes (TENANTS=n PROCS=n)
+	$(PY) tools/federation_report.py --tenants $(or $(TENANTS),24) --processes $(or $(PROCS),3)
 
 disrupt-report:  ## global disruption optimizer vs greedy: savings found, verify hit-rate, subset funnel (FLEET=squeeze|joint TILES=n)
 	$(PY) tools/disrupt_report.py --fleet $(or $(FLEET),squeeze) --tiles $(or $(TILES),2)

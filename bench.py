@@ -1139,6 +1139,86 @@ def main() -> None:
     detail["c16_artifact"] = recompute_path
     print(_RC16.report(), file=sys.stderr)
 
+    progress("c17: federation regime (multi-process fleet over the wire, "
+             "one shared solver server)")
+    # --- config 17 (ISSUE 18): the federation plane. Several fleet
+    # processes (modeled as sequential FleetRunner universes with
+    # distinct process names) share ONE SolverServer through the
+    # in-memory transport — every payload round-trips the JSON codec,
+    # so the wire-bytes and catalog-protocol numbers are the real
+    # protocol cost, minus only socket latency.
+    # c17_catalog_uploads_per_cluster is the contract key: the
+    # content-token protocol must ship catalog tensors once per DISTINCT
+    # view per cluster, not once per process. c17_wire_overhead_frac is
+    # the fraction of wire bytes that is framing (base64 + envelope)
+    # rather than tensor payload — informational by name, like the
+    # redundancy fractions. c17_mesh_batch_capacity = mesh devices x the
+    # largest padded batch one call carried (batch capacity scales with
+    # slice size; 1-device hosts report the plain batch bucket).
+    from karpenter_tpu.federation import build_federated_service as _bfs17
+    from karpenter_tpu.federation.server import SolverServer as _FedSrv17
+    from karpenter_tpu.fleet.runner import FleetRunner as _FR17
+    from karpenter_tpu.metrics import FEDERATION_WIRE_BYTES as _FWB17
+    _procs17 = 3
+    # CPU fallback keeps the regime honest but small; an attached slice
+    # runs the 100+ tenant shape the federation plane is sized for
+    _ten17 = 12 if _prov8().get("cpu_fallback", True) else 120
+    import jax as _jax17
+    _mesh17 = None
+    if len(_jax17.devices()) > 1:
+        from karpenter_tpu.parallel.mesh import make_batch_mesh as _mbm17
+        _mesh17 = _mbm17()
+    _fsrv17 = _FedSrv17(run_id="bench-c17", mesh=_mesh17)
+    _w0_17 = (_FWB17.value(direction="sent"),
+              _FWB17.value(direction="received"))
+    _disp17 = _wall17 = 0.0
+    _tens17 = _fail17 = 0
+    _ok17 = True
+    t0 = time.perf_counter()
+    for _p17 in range(_procs17):
+        _proc17 = f"p{_p17:03d}"
+
+        def _factory17(clock, kw, _proc=_proc17):
+            return _bfs17(clock, run_id="bench-c17", process=_proc,
+                          shared_server=_fsrv17, **kw)
+
+        _r17 = _FR17("federation_smoke", tenants=_ten17 // _procs17,
+                     seed=0, backend="device", service_factory=_factory17)
+        _tp17 = time.perf_counter()
+        _rep17 = _r17.run()
+        _wall17 += time.perf_counter() - _tp17
+        _ok17 = _ok17 and _rep17.ok
+        _disp17 += float(_r17.service.stats["dispatched"])
+        _cs17 = _r17.service.fed.stats
+        _tens17 += (_cs17["tensor_bytes_sent"]
+                    + _cs17["tensor_bytes_received"])
+        _fail17 += _r17.service.federation_state()["failures"]
+    _wire17 = ((_FWB17.value(direction="sent") - _w0_17[0])
+               + (_FWB17.value(direction="received") - _w0_17[1]))
+    detail["c17_fleet_settled"] = bool(_ok17)
+    detail["c17_federated_solves_per_sec"] = round(
+        _disp17 / _wall17, 1) if _wall17 > 0 else 0.0
+    detail["c17_catalog_uploads_per_cluster"] = int(
+        _fsrv17.stats["catalog_uploads"])
+    detail["c17_wire_overhead_frac"] = round(
+        1.0 - _tens17 / _wire17, 4) if _wire17 else 0.0
+    detail["c17_mesh_batch_capacity"] = int(
+        (int(_mesh17.size) if _mesh17 is not None else 1)
+        * _fsrv17.stats["max_bucket_rows"])
+    detail["c17_wire_buckets"] = int(_fsrv17.stats["buckets"])
+    detail["c17_wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    if not _ok17:
+        progress("C17 FEDERATED RUN FAILED its fleet invariants — see "
+                 "the scenario analyze verdicts")
+    if _fail17:
+        progress(f"C17 WIRE FAILURES: {_fail17} bucket(s) degraded to "
+                 "the local path in a fault-free regime")
+    if (_fsrv17.stats["catalog_uploads"] > _procs17):
+        progress(f"C17 CATALOG RE-SHIPPING: "
+                 f"{_fsrv17.stats['catalog_uploads']} uploads for "
+                 f"{_procs17} processes — the token-announce protocol "
+                 "is not deduplicating content")
+
     progress("profile: writing profile_bench.json (phase attribution)")
     # --- the phase-attribution artifact (obs/profile.py): everything the
     # traced windows above fed the ledger (c7 solve, c8 warm+cold

@@ -33,6 +33,32 @@ from .provider import (AlreadyExistsError, CapacityTypeUnfulfillableError,
                        UnauthorizedError, ZoneExhaustedError)
 
 # ---------------------------------------------------------------------------
+# wire schema negotiation
+# ---------------------------------------------------------------------------
+# Bumped whenever the codec's envelope shapes change incompatibly (a new
+# type tag, a field rename in a registered dataclass, an error-envelope
+# shape change). Negotiated ONCE per connection instead of discovered
+# mid-payload: without the handshake a drifted peer fails deep inside
+# decode() with a KeyError/TypeError that looks like data corruption —
+# with it, the mismatch is an explicit WireVersionError naming both
+# versions before any RPC body crosses.
+WIRE_SCHEMA_VERSION = 1
+
+
+class WireVersionError(CloudError):
+    """The two ends of the wire speak different codec schema versions.
+    NOT retryable — a version skew never heals by waiting, so this
+    deliberately does not subclass ServerError (the batcher/backoff
+    machinery must surface it, not spin on it)."""
+
+    def __init__(self, ours: int, theirs) -> None:
+        self.ours, self.theirs = ours, theirs
+        super().__init__(
+            f"wire schema mismatch: local speaks v{ours}, peer speaks "
+            f"v{theirs} — upgrade the older end before reconnecting")
+
+
+# ---------------------------------------------------------------------------
 # wire codec
 # ---------------------------------------------------------------------------
 
@@ -136,7 +162,7 @@ def decode(obj):
 def encode_error(e: CloudError) -> dict:
     env: dict = {"type": type(e).__name__, "msg": str(e)}
     for attr in ("offerings", "zones", "capacity_types", "reservation_id",
-                 "retry_after"):
+                 "retry_after", "ours", "theirs"):
         if getattr(e, attr, None) is not None:
             env[attr] = encode(getattr(e, attr))
     return env
@@ -146,11 +172,16 @@ _ERROR_TYPES = {c.__name__: c for c in (
     CloudError, NotFoundError, AlreadyExistsError, RateLimitedError,
     ServerError, UnauthorizedError, InsufficientCapacityError,
     ReservationExceededError, ZoneExhaustedError,
-    CapacityTypeUnfulfillableError)}
+    CapacityTypeUnfulfillableError, WireVersionError)}
 
 
 def decode_error(env: dict) -> CloudError:
     cls = _ERROR_TYPES.get(env.get("type", ""), ServerError)
+    if cls is WireVersionError:
+        # envelope is authored by the REJECTING end: its "ours" is our
+        # peer's version, so swap perspective on reconstruction
+        return WireVersionError(env.get("theirs", WIRE_SCHEMA_VERSION),
+                                env.get("ours", "?"))
     if cls is InsufficientCapacityError:
         return InsufficientCapacityError(
             [tuple(o) for o in decode(env.get("offerings", []))],
@@ -170,6 +201,8 @@ def decode_error(env: dict) -> CloudError:
 
 
 def _http_status(e: CloudError) -> int:
+    if isinstance(e, WireVersionError):
+        return 426  # Upgrade Required — the protocol itself is wrong
     if isinstance(e, NotFoundError):
         return 404
     if isinstance(e, UnauthorizedError):
@@ -233,7 +266,10 @@ def make_server(cloud, host: str = "127.0.0.1", port: int = 0,
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"ok": True})
+                # the handshake rides the connectivity probe: clients read
+                # wire_schema here BEFORE issuing any /rpc body
+                self._send(200, {"ok": True,
+                                 "wire_schema": WIRE_SCHEMA_VERSION})
             elif self.path == "/lease":
                 lease = lease_backend.get()
                 self._send(200, {"lease": lease.__dict__ if lease else None})
@@ -262,6 +298,15 @@ def make_server(cloud, host: str = "127.0.0.1", port: int = 0,
                                            "msg": self.path}})
                 return
             method = self.path[len("/rpc/"):]
+            # schema check BEFORE touching the body: a drifted client is
+            # told explicitly instead of tripping a decode() error that
+            # masquerades as data corruption. Header-less clients (old or
+            # third-party) pass — the check only fires on a declared skew.
+            declared = self.headers.get("X-Wire-Schema")
+            if declared is not None and declared != str(WIRE_SCHEMA_VERSION):
+                err = WireVersionError(WIRE_SCHEMA_VERSION, declared)
+                self._send(_http_status(err), {"error": encode_error(err)})
+                return
             if method.startswith("_") or not hasattr(cloud, method):
                 self._send(404, {"error": {"type": "NotFoundError",
                                            "msg": f"no method {method}"}})
@@ -343,7 +388,9 @@ class RemoteCloud:
                                               timeout=self.timeout)
             try:
                 conn.request("POST", f"/rpc/{method}", body=body,
-                             headers={"Content-Type": "application/json"})
+                             headers={"Content-Type": "application/json",
+                                      "X-Wire-Schema":
+                                      str(WIRE_SCHEMA_VERSION)})
                 resp = conn.getresponse()
                 payload = resp.read()
                 status = resp.status
@@ -393,6 +440,37 @@ class RemoteCloud:
                 conn.close()
         except OSError:
             return False
+
+    def handshake(self) -> int:
+        """Negotiate the wire schema on connect: reads the server's
+        version from /healthz and raises WireVersionError on skew —
+        an explicit refusal instead of a mid-payload decode failure.
+        Returns the negotiated version. Transport failures map to
+        retryable ServerError like any other call."""
+        import http.client
+        try:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                payload = resp.read()
+            finally:
+                conn.close()
+        except socket.timeout as e:
+            raise ServerError(f"handshake timed out: {e}")
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            raise ServerError(f"handshake transport failure: {e}")
+        try:
+            obj = json.loads(payload) if payload else {}
+        except json.JSONDecodeError:
+            obj = {}
+        # a server predating the handshake ships no version field; treat
+        # it as v0 — explicitly skewed, not silently compatible
+        theirs = obj.get("wire_schema", 0)
+        if theirs != WIRE_SCHEMA_VERSION:
+            raise WireVersionError(WIRE_SCHEMA_VERSION, theirs)
+        return theirs
 
     # --- CloudProvider surface ---
     def create_fleet(self, requests: List[LaunchRequest]):

@@ -177,3 +177,33 @@ def fleet_device_fault_hook(plans: dict):
         yield
     finally:
         solver_mod.set_dispatch_fault_hook(None)
+
+
+@contextlib.contextmanager
+def wire_fault_hook(fail_methods=("solve_bucket",), after: int = 0,
+                    error: Optional[type] = None):
+    """Arm the federation transport's wire-fault seam: RPCs whose method
+    is in `fail_methods` raise after `after` successful probes of those
+    methods — `after=0` kills the first matching call (the mid-solve
+    server-crash drill: the client's degrade ladder must host-solve the
+    bucket, arm its cooldown, and trip the watchdog's
+    federation_degraded invariant). Raises ConnectionError by default —
+    exactly what a dead server produces at the socket. Always disarms
+    on exit, same leak-proofing contract as the other seams."""
+    from ..federation import transport as transport_mod
+    state = {"seen": 0}
+    err = error if error is not None else ConnectionError
+
+    def probe(method: str) -> None:
+        if method not in fail_methods:
+            return
+        state["seen"] += 1
+        if state["seen"] > after:
+            raise err(f"injected wire fault on {method} "
+                      f"(call {state['seen']})")
+
+    prev = transport_mod.set_wire_fault_hook(probe)
+    try:
+        yield state
+    finally:
+        transport_mod.set_wire_fault_hook(prev)

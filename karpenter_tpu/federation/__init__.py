@@ -1,0 +1,54 @@
+"""Federation plane: the fleet across a real process boundary.
+
+One process on one device is not "millions of users" (ROADMAP item 1).
+This package promotes the fleet's shared SolverService to a NETWORK
+service: a `SolverServer` (server.py) hosts the one real solver stack —
+device-resident catalogs, mesh-sharded batched dispatch — and N fleet
+processes, each a full TenantShard stack on its own store/journal/warm
+path, reach it through a `FederatedSolverClient` (client.py) over the
+`cloud/remote.py` wire layer (the same codec, error taxonomy, and
+schema-version handshake the remote CloudProvider rides).
+
+The split line is deliberate: clients keep the ENTIRE host-side solve
+path — catalog views, encode, spread, integrity oracle, warm path,
+decode — and ship only the packed device-dispatch payload (the [B, Gp,
+W] request stack the batched dispatcher would have uploaded anyway).
+The server runs exactly `ops/solver.dispatch_packed` and returns the
+raw packed rows; the client decodes them with its own catalogs. A
+federated solve and an in-process solve therefore share every byte of
+the encode/decode path, which is how the three-digest determinism
+contract (state hash, fault fingerprint, load fingerprint) crosses the
+process boundary unchanged — tests/test_federation.py asserts it.
+
+Catalog tensors cross the wire ONCE PER CLUSTER: content-keyed
+`SharedCatalogCache` tokens become the cross-process protocol — a
+client announces its token first and ships tensor bytes only on server
+miss; ICE/price divergence re-fingerprints into a new token and re-keys
+automatically, exactly like the in-process view split (docs/
+federation.md has the full ladder).
+
+Failure ladder: a wire error degrades exactly the affected bucket to
+the local host-solve path (the same containment as a device fault),
+arms a count-based cooldown so the next buckets don't spin on a dead
+server, and surfaces on the watchdog's `federation_degraded` invariant
+before any SLO burns.
+"""
+
+from .client import (FederatedSolverClient, FederatedSolverService,
+                     build_federated_service)
+from .envelopes import (AdmissionVerdictEnvelope, CatalogUploadEnvelope,
+                        HandshakeEnvelope, IntegrityVerdictEnvelope,
+                        ReportAck, SolveBucketRequest, SolveBucketResult,
+                        WatchdogFindingEnvelope, decode_envelope,
+                        encode_envelope)
+from .server import SolverServer, make_fed_server
+from .transport import HTTPTransport, InMemoryTransport
+
+__all__ = [
+    "AdmissionVerdictEnvelope", "CatalogUploadEnvelope",
+    "FederatedSolverClient", "FederatedSolverService", "HTTPTransport",
+    "HandshakeEnvelope", "InMemoryTransport", "IntegrityVerdictEnvelope",
+    "ReportAck", "SolveBucketRequest", "SolveBucketResult", "SolverServer",
+    "WatchdogFindingEnvelope", "build_federated_service",
+    "decode_envelope", "encode_envelope", "make_fed_server",
+]

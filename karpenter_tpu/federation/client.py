@@ -1,0 +1,338 @@
+"""Federation client: a full fleet process minus the device.
+
+`FederatedSolverClient` speaks the wire protocol (handshake, catalog
+token announce/upload, bucket solves, verdict mirroring);
+`FederatedSolverService` plugs it under the fleet's batched pump by
+subclassing `fleet/service.SolverService` and overriding exactly ONE
+seam — `_dispatch_bucket` — so every other behavior (DRR order, arena
+leasing, staging, draining, ticket completion, SLO samples) is the
+in-process code, not a copy of it.
+
+The client packs each bucket's [B, Gp, W] request stack with the SAME
+`_pack_groups`/`_group_inputs` calls `ops/solver.dispatch_batch` uses,
+ships the bytes, and rehydrates the reply rows into an
+`InFlightBatch.from_rows` — decode then runs locally against the
+client's own catalogs. In-process and federated runs therefore share
+every byte of the encode and decode paths; only the device hop moves.
+
+Degrade ladder (ordered, each observable):
+
+1. wire failure mid-bucket → exactly that bucket's tickets host-solve
+   through their own facades (`_run_serial(fault_fallback=True)`, the
+   SAME containment as a device fault), `federation_fallbacks_total
+   {reason="error"}` increments, and a count-based cooldown arms
+2. during cooldown the wire is not attempted at all — buckets dispatch
+   on the LOCAL device path (reason="cooldown"), so a dead server
+   costs one timeout, not one per bucket
+3. a catalog view without a content token cannot federate (tokens are
+   the cross-process identity) — local dispatch, reason="no_token"
+4. an unknown-token rejection (server restarted / FIFO-evicted) is NOT
+   a failure: the client re-announces the catalog and retries once
+
+`federation_state()` feeds the watchdog's `federation_degraded`
+invariant, so the ladder's first rung pages before any tenant SLO
+burns.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..cloud.remote import (WIRE_SCHEMA_VERSION, NotFoundError,
+                            WireVersionError)
+from ..metrics import FEDERATION_CATALOG, FEDERATION_FALLBACKS
+from ..fleet.service import SolverService
+from .envelopes import (AdmissionVerdictEnvelope, CatalogUploadEnvelope,
+                        IntegrityVerdictEnvelope, SolveBucketRequest,
+                        SolveBucketResult, WatchdogFindingEnvelope,
+                        decode_envelope, encode_envelope, pack_array,
+                        tensor_bytes, unpack_array)
+
+# wire failures back off for this many buckets before re-probing the
+# server — the same count-based (virtual-clock-safe) shape as the
+# facade's device FALLBACK_COOLDOWN
+FED_COOLDOWN = 8
+
+
+class FederatedSolverClient:
+    """The wire-side half: protocol state for ONE fleet process.
+
+    Tracks which catalog tokens this process has already announced (and
+    at what resource width), so steady state is zero catalog RPCs per
+    bucket; the server's content-keyed store makes the aggregate
+    cluster cost one tensor upload per distinct catalog view.
+    """
+
+    def __init__(self, transport, run_id: str = "", process: str = ""):
+        self.transport = transport
+        self.run_id = run_id
+        self.process = process
+        self._announced: dict = {}   # token -> max resource width announced
+        self.stats = {"solve_rpcs": 0, "catalog_rpcs": 0,
+                      "announce_hits": 0, "announce_misses": 0,
+                      "uploads": 0, "retried_unknown_token": 0,
+                      "reports": 0,
+                      # raw (pre-base64, pre-JSON) tensor payload bytes
+                      # this client shipped + received — the denominator
+                      # of the wire-overhead ratio (wire bytes carry
+                      # ~4/3 base64 inflation plus envelope framing)
+                      "tensor_bytes_sent": 0, "tensor_bytes_received": 0}
+
+    def handshake(self) -> dict:
+        """Negotiate schema + learn the server's shape. The reply's
+        wire_schema is checked even on transports whose HTTP layer
+        already enforced the header (in-memory has no header)."""
+        out = self.transport.call("handshake", {
+            "schema": WIRE_SCHEMA_VERSION, "run_id": self.run_id,
+            "process": self.process})
+        theirs = out.get("wire_schema", 0)
+        if theirs != WIRE_SCHEMA_VERSION:
+            raise WireVersionError(WIRE_SCHEMA_VERSION, theirs)
+        return out
+
+    # --- catalog token protocol -------------------------------------------
+
+    def ensure_catalog(self, cat, R: int) -> Optional[tuple]:
+        """Make the server hold a DeviceCatalog for `cat`'s content
+        token at resource width >= R; returns the token (None when the
+        catalog has no content token and cannot federate). Announce
+        first, ship tensors only on miss — the once-per-cluster
+        contract."""
+        tok = getattr(cat, "cache_token", None)
+        if tok is None:
+            return None
+        token = tuple(tok)
+        if self._announced.get(token, -1) >= R:
+            return token
+        self.stats["catalog_rpcs"] += 1
+        out = self.transport.call("has_catalog", {
+            "schema": WIRE_SCHEMA_VERSION, "token": list(token),
+            "R": int(R)})
+        if out.get("present"):
+            self.stats["announce_hits"] += 1
+            FEDERATION_CATALOG.inc(event="announce_hit")
+        else:
+            self.stats["announce_misses"] += 1
+            FEDERATION_CATALOG.inc(event="announce_miss")
+            self._upload_catalog(cat, R, token)
+        self._announced[token] = R
+        return token
+
+    def _upload_catalog(self, cat, R: int, token: tuple) -> None:
+        from ..ops.encode import align_resources, align_zone_overhead
+        zovh = align_zone_overhead(cat, R)
+        env = CatalogUploadEnvelope(
+            schema=WIRE_SCHEMA_VERSION, run_id=self.run_id,
+            process=self.process, token=token,
+            alloc=pack_array(align_resources(cat.allocatable, R)),
+            price=pack_array(np.asarray(cat.price)),
+            avail=pack_array(np.asarray(cat.available)),
+            ovh_z=pack_array(zovh) if zovh is not None else None,
+            R=int(R))
+        self.transport.call("put_catalog", encode_envelope(env))
+        self.stats["uploads"] += 1
+        self.stats["tensor_bytes_sent"] += (
+            tensor_bytes(env.alloc) + tensor_bytes(env.price)
+            + tensor_bytes(env.avail) + tensor_bytes(env.ovh_z))
+
+    def forget(self, token: tuple) -> None:
+        """Drop local announce state (server said unknown-token)."""
+        self._announced.pop(tuple(token), None)
+
+    # --- bucket solves -----------------------------------------------------
+
+    def solve_bucket(self, reqs: List) -> Tuple[np.ndarray, float]:
+        """Ship one same-signature bucket; returns (packed int32 rows
+        [Bp, L], server device span seconds). Packs the stack with the
+        exact calls dispatch_batch uses, so the bytes on the wire are
+        the bytes an in-process dispatch would have uploaded. Retries
+        ONCE through a catalog re-announce on unknown-token."""
+        from ..ops.solver import _group_inputs, _pack_groups
+        first = reqs[0]
+        st = first.statics
+        Gp, cols = first.Gp, list(st["cols"])
+        R = int(first.enc.requests.shape[1])
+        token = self.ensure_catalog(first.cat, R)
+        if token is None:
+            raise NotFoundError("catalog has no content token")
+        gbufs = [_pack_groups(*_group_inputs(r.enc, Gp), cols)
+                 for r in reqs]
+        conf_np = None
+        if st["track_conflicts"]:
+            from ..ops.solver import _pad_to
+            conf_np = np.stack(
+                [_pad_to(_pad_to(r.enc.conflict, Gp, 0), Gp, 1)
+                 if r.enc.conflict is not None
+                 else np.zeros((Gp, Gp), bool) for r in reqs])
+        env = SolveBucketRequest(
+            schema=WIRE_SCHEMA_VERSION, run_id=self.run_id,
+            process=self.process, token=token,
+            shape_class=first.shape_class, Gp=int(Gp), B=len(reqs),
+            statics=dict(st), gbuf=pack_array(np.stack(gbufs)),
+            conf=pack_array(conf_np) if conf_np is not None else None,
+            tenants=tuple(getattr(r, "tenant", "") for r in reqs))
+        payload = encode_envelope(env)
+        self.stats["solve_rpcs"] += 1
+        self.stats["tensor_bytes_sent"] += (tensor_bytes(env.gbuf)
+                                            + tensor_bytes(env.conf))
+        try:
+            out = self.transport.call("solve_bucket", payload)
+        except NotFoundError:
+            # server lost the token (restart / LRU): re-announce + one
+            # retry — a protocol event, not a degrade
+            self.forget(token)
+            self.stats["retried_unknown_token"] += 1
+            self.ensure_catalog(first.cat, R)
+            out = self.transport.call("solve_bucket", payload)
+        res = decode_envelope(out)
+        assert isinstance(res, SolveBucketResult)
+        self.stats["tensor_bytes_received"] += tensor_bytes(res.rows)
+        return unpack_array(res.rows), float(res.span_s)
+
+    # --- verdict mirroring -------------------------------------------------
+
+    def report(self, items: List) -> int:
+        """Mirror admission/integrity/watchdog envelopes to the server
+        ledger; returns the accepted count (0 if nothing to send)."""
+        if not items:
+            return 0
+        for it in items:
+            assert isinstance(it, (AdmissionVerdictEnvelope,
+                                   IntegrityVerdictEnvelope,
+                                   WatchdogFindingEnvelope))
+        out = self.transport.call("report", {
+            "schema": WIRE_SCHEMA_VERSION, "run_id": self.run_id,
+            "items": [encode_envelope(it) for it in items]})
+        ack = decode_envelope(out)
+        self.stats["reports"] += ack.accepted
+        return ack.accepted
+
+
+class FederatedSolverService(SolverService):
+    """The fleet's SolverService with the device hop moved server-side.
+
+    Only `_dispatch_bucket` changes: batchable buckets cross the wire
+    and rehydrate as `InFlightBatch.from_rows`; everything upstream
+    (staging, bucketing, DRR) and downstream (drain, decode, finish)
+    is the parent's code, which is what makes the federated and the
+    in-process digests byte-identical.
+    """
+
+    def __init__(self, clock, fed: FederatedSolverClient, **kwargs):
+        super().__init__(clock, **kwargs)
+        self.fed = fed
+        self._fed_cooldown = 0
+        self._fed_failures = 0
+        self._fed_last_error = ""
+        self.fed_stats = {"wire_buckets": 0, "wire_tickets": 0,
+                          "local_buckets": 0, "cooldown_skips": 0,
+                          "no_token": 0}
+
+    def _dispatch_bucket(self, entries: List[dict]):
+        from ..metrics.tenant import tenant_scope
+        from ..ops import solver as ops_solver
+        # the per-tenant device-fault probe KEEPS its in-process
+        # semantics: a tenant-targeted fault plan aborts the bucket
+        # before any dispatch, wire or local — the containment tests
+        # rely on the probe order being identical on both paths
+        try:
+            for tenant in dict.fromkeys(e["ticket"].tenant
+                                        for e in entries):
+                with tenant_scope(tenant):
+                    ops_solver.probe_dispatch_fault("device")
+        except BaseException:  # noqa: BLE001 — degrade only this batch
+            for e in entries:
+                self._run_serial(e, fault_fallback=True)
+            return None
+        reqs = [e["batchable"] for e in entries]
+        if self._fed_cooldown > 0:
+            self._fed_cooldown -= 1
+            self.fed_stats["cooldown_skips"] += 1
+            FEDERATION_FALLBACKS.inc(reason="cooldown")
+            return self._local_bucket(entries, reqs)
+        if getattr(reqs[0].cat, "cache_token", None) is None:
+            # no content token = no cross-process catalog identity; the
+            # local device path still serves the bucket
+            self.fed_stats["no_token"] += 1
+            FEDERATION_FALLBACKS.inc(reason="no_token")
+            return self._local_bucket(entries, reqs)
+        try:
+            rows, span_s = self.fed.solve_bucket(reqs)
+        except WireVersionError:
+            # schema skew never heals by waiting or retrying — surface
+            # it instead of degrading into a silent local-only fleet
+            raise
+        except BaseException as e:  # noqa: BLE001 — wire is a boundary
+            self._fed_failures += 1
+            self._fed_cooldown = FED_COOLDOWN
+            self._fed_last_error = f"{type(e).__name__}: {e}"
+            FEDERATION_FALLBACKS.inc(reason="error")
+            # the failed bucket's tickets host-solve NOW through their
+            # own facades — the device-fault containment contract
+            for e2 in entries:
+                self._run_serial(e2, fault_fallback=True)
+            return None
+        ifb = ops_solver.InFlightBatch.from_rows(reqs, rows, span_s=span_s)
+        cs = self.class_stats.setdefault(
+            reqs[0].shape_class,
+            {"tickets": 0, "batches": 0, "copending_pumps": 0,
+             "cobatched_pumps": 0})
+        cs["batches"] += 1
+        self.fed_stats["wire_buckets"] += 1
+        self.fed_stats["wire_tickets"] += len(entries)
+        return ifb
+
+    def _local_bucket(self, entries: List[dict], reqs: List):
+        """Cooldown/no-token path: the parent's local device dispatch
+        with the parent's containment (probe already ran above)."""
+        from ..ops import solver as ops_solver
+        try:
+            ifb = ops_solver.dispatch_batch(reqs)
+        except BaseException:  # noqa: BLE001 — degrade only this batch
+            for e in entries:
+                self._run_serial(e, fault_fallback=True)
+            return None
+        cs = self.class_stats.setdefault(
+            reqs[0].shape_class,
+            {"tickets": 0, "batches": 0, "copending_pumps": 0,
+             "cobatched_pumps": 0})
+        cs["batches"] += 1
+        self.fed_stats["local_buckets"] += 1
+        return ifb
+
+    def federation_state(self) -> dict:
+        """The watchdog's federation_degraded observables."""
+        return {"federated": True,
+                "degraded": self._fed_cooldown > 0,
+                "cooldown": self._fed_cooldown,
+                "failures": self._fed_failures,
+                "last_error": self._fed_last_error,
+                **self.fed_stats}
+
+
+def build_federated_service(clock, server_addr: str = "", run_id: str = "",
+                            process: str = "p000", shared_server=None,
+                            mesh=None, **service_kwargs):
+    """Assemble the client stack: transport → handshake → service.
+
+    server_addr "host:port" dials a `make_fed_server` process over HTTP;
+    empty embeds a SolverServer behind an InMemoryTransport (the tier-1
+    shape — full wire fidelity, no socket). shared_server lets several
+    services in one process model several fleet processes against ONE
+    server (pass each a distinct `process` name). The handshake runs
+    here, so schema skew fails assembly, not the first bucket."""
+    from .server import SolverServer
+    from .transport import HTTPTransport, InMemoryTransport
+    if server_addr:
+        host, _, port = server_addr.rpartition(":")
+        transport = HTTPTransport(host or "127.0.0.1", int(port))
+        transport.handshake()
+    else:
+        server = shared_server if shared_server is not None else \
+            SolverServer(run_id=run_id, mesh=mesh)
+        transport = InMemoryTransport(server)
+    fed = FederatedSolverClient(transport, run_id=run_id, process=process)
+    fed.handshake()
+    return FederatedSolverService(clock, fed, **service_kwargs)

@@ -78,6 +78,19 @@ def main(argv=None) -> int:
     ap.add_argument("--journal-dir", default="",
                     help="directory for per-tenant intent-journal WAL "
                          "files (empty: in-memory journals)")
+    ap.add_argument("--federate", action="store_true",
+                    help="route batched buckets through the federation "
+                         "plane (karpenter_tpu/federation): an embedded "
+                         "SolverServer behind an in-memory wire unless "
+                         "--server-addr dials a real one. Implies "
+                         "--batch and a device backend — per-tenant "
+                         "hashes and fingerprints must match the "
+                         "in-process run (the cross-process determinism "
+                         "contract)")
+    ap.add_argument("--server-addr", default="",
+                    help="host:port of a running federation solver "
+                         "server (python -m karpenter_tpu.federation."
+                         "server); empty with --federate embeds one")
     args = ap.parse_args(argv)
 
     if not args.scenario:
@@ -86,12 +99,29 @@ def main(argv=None) -> int:
         return 0
 
     seeds = (list(range(args.seeds)) if args.seeds > 0 else [args.seed])
+    runner_kwargs = dict(tenants=args.tenants or None,
+                         backend=args.backend,
+                         batch=args.batch or None,
+                         inflight_cap=args.inflight_cap or None,
+                         journal_dir=args.journal_dir or None)
+    if args.federate:
+        from ..federation import build_federated_service
+        # federation only engages for device-batchable buckets: a host
+        # backend would stage nothing for the wire and silently test the
+        # local path, so --federate picks device unless overridden
+        if args.backend == "host":
+            runner_kwargs["backend"] = "device"
+        runner_kwargs["batch"] = True
+
+        def service_factory(clock, kw,
+                            _addr=args.server_addr, _sc=args.scenario):
+            # run_id from scenario name, never wall clock: envelopes
+            # must be byte-identical across seeded repeats
+            return build_federated_service(clock, server_addr=_addr,
+                                           run_id=f"fed-{_sc}", **kw)
+        runner_kwargs["service_factory"] = service_factory
     failed = run_matrix(args.scenario, seeds, repeat=args.repeat,
-                        tenants=args.tenants or None,
-                        backend=args.backend,
-                        batch=args.batch or None,
-                        inflight_cap=args.inflight_cap or None,
-                        journal_dir=args.journal_dir or None)
+                        **runner_kwargs)
     return 1 if failed else 0
 
 

@@ -91,7 +91,8 @@ class FleetRunner:
                  inflight_cap: Optional[int] = None,
                  journal_dir: Optional[str] = None,
                  warmpath: Optional[bool] = None,
-                 batch: Optional[bool] = None):
+                 batch: Optional[bool] = None,
+                 service_factory=None):
         self.scenario: FleetScenario = (
             scenario if isinstance(scenario, FleetScenario)
             else get_fleet_scenario(scenario))
@@ -108,6 +109,13 @@ class FleetRunner:
         # identical armed or not (the chaos parity contract —
         # tests/test_fleet.py compares a run each way)
         self.batch = self.scenario.batch if batch is None else bool(batch)
+        # federation seam: a callable (clock, service_kwargs) -> service
+        # replaces the in-process SolverService with e.g. a
+        # FederatedSolverService whose buckets cross the wire. The judge
+        # (hashes, fingerprints, invariants) is untouched — the
+        # cross-process determinism contract is asserted BY running the
+        # same scenario through both factories.
+        self.service_factory = service_factory
         self.clock: Optional[FakeClock] = None
         self.service: Optional[SolverService] = None
         self.shards: List[TenantShard] = []
@@ -122,10 +130,14 @@ class FleetRunner:
         sc = self.scenario
         self.clock = FakeClock()
         self.origin = self.clock.now()
-        self.service = SolverService(self.clock, backend=self.backend,
-                                     inflight_cap=self.inflight_cap,
-                                     quantum=sc.quantum, window=sc.window,
-                                     batch=self.batch)
+        service_kwargs = dict(backend=self.backend,
+                              inflight_cap=self.inflight_cap,
+                              quantum=sc.quantum, window=sc.window,
+                              batch=self.batch)
+        if self.service_factory is not None:
+            self.service = self.service_factory(self.clock, service_kwargs)
+        else:
+            self.service = SolverService(self.clock, **service_kwargs)
         self.shards = []
         for i in range(self.tenants):
             name = f"t{i:03d}"
@@ -233,6 +245,17 @@ class FleetRunner:
                 svc.pipeline_overlap_ratio(), 4)
         if warm_div:
             stats["warm_divergences"] = warm_div
+        fed_state = getattr(svc, "federation_state", None)
+        if fed_state is not None:
+            fs = fed_state()
+            stats["federated_wire_buckets"] = float(fs["wire_buckets"])
+            stats["federated_wire_tickets"] = float(fs["wire_tickets"])
+            stats["federated_local_buckets"] = float(fs["local_buckets"])
+            stats["federated_wire_failures"] = float(fs["failures"])
+            cstats = svc.fed.stats
+            stats["federation_catalog_uploads"] = float(cstats["uploads"])
+            stats["federation_announce_hits"] = float(
+                cstats["announce_hits"])
         stats["slo_alerts"] = float(len(self.slo.alerts))
         stats["watchdog_findings"] = fleet_findings
         report = FleetReport(

@@ -265,6 +265,69 @@ _register(FleetScenario(
     tenants=8,
     timeout=240.0))
 
+# --- federation_smoke -------------------------------------------------------
+# The federation plane's tier-1 member: uniform first waves so every
+# tenant's fresh solve lands in the SAME shape class (maximum
+# co-batching → maximum wire traffic when run --federate), plus seeded
+# trickles for per-tenant variety. Runs identically in-process — the
+# cross-process determinism test executes this scenario through BOTH
+# service factories and requires byte-identical digests. The analyze
+# hook only judges federated runs: at least one bucket must actually
+# cross the wire, the degrade ladder must not have been armed, and
+# catalog tensors must have crossed at most once per distinct view
+# (the once-per-cluster contract).
+
+
+def _fedsmoke_workload(i: int, name: str):
+    def workload(sim, rng):
+        second = 2 + rng.randrange(4)         # 2..5 pods
+        at = 10.0 + rng.randrange(8)          # 10..17s
+        _waved([(0.0, 6, "w0", "500m", "1Gi"),
+                (at, second, "w1", "250m", "512Mi")])(sim, rng)
+    return workload
+
+
+def _federation_analyze(runner, report) -> None:
+    svc = runner.service
+    fed_state = getattr(svc, "federation_state", None)
+    if fed_state is None:
+        return  # in-process run of the same scenario: digests only
+    fs = fed_state()
+    report.stats["federation_degraded"] = float(fs["degraded"])
+    if fs["wire_buckets"] == 0:
+        report.violations.append(
+            "federated run but no bucket ever crossed the wire — the "
+            "whole fleet silently ran the local path")
+    if fs["failures"]:
+        report.violations.append(
+            f"{fs['failures']} wire failure(s) degraded buckets in a "
+            f"scenario with no injected wire faults")
+    uploads = svc.fed.stats["uploads"]
+    views = max(1, svc.shared_catalog.stats["misses"])
+    report.stats["catalog_uploads"] = float(uploads)
+    report.stats["catalog_views_minted"] = float(views)
+    if uploads > views:
+        report.violations.append(
+            f"catalog tensors crossed the wire {uploads} times for "
+            f"{views} distinct view(s) — the token-announce protocol "
+            f"is re-shipping content")
+
+
+_register(FleetScenario(
+    name="federation_smoke",
+    description="Uniform first waves (one co-batched shape class) plus "
+                "seeded trickles across 8 shards; batch armed. Run with "
+                "--federate to push every bucket through the wire: the "
+                "verdict requires wire traffic, zero degrades, and at "
+                "most one catalog upload per distinct view. Digests "
+                "must match the in-process run of the same seed.",
+    tenant_workload=_fedsmoke_workload,
+    tenant_rules=lambda i, n: [],
+    tenants=8,
+    timeout=240.0,
+    batch=True,
+    analyze=_federation_analyze))
+
 _register(FleetScenario(
     name="fleet_noisy_neighbor",
     description="Tenant t000 storms a spot-only pool through a 140s ICE "

@@ -185,11 +185,27 @@ def run_fleet(opts: Options) -> int:
                    if opts.intent_journal_file else None)
     if journal_dir:
         os.makedirs(journal_dir, exist_ok=True)
+    backend = opts.solver_backend
+    batch = opts.fleet_batch or None
+    service_factory = None
+    if opts.federate:
+        # federation only engages for device-batchable buckets: --federate
+        # implies the batched engine and a device backend unless the user
+        # picked a non-default backend explicitly
+        from .federation import build_federated_service
+        if backend == "host":
+            backend = "device"
+        batch = True
+
+        def service_factory(clock, kw, _addr=opts.server_addr):
+            return build_federated_service(clock, server_addr=_addr,
+                                           run_id="fed-fleet_smoke", **kw)
     runner = FleetRunner("fleet_smoke", tenants=opts.fleet_tenants,
-                         backend=opts.solver_backend,
+                         backend=backend,
                          inflight_cap=opts.fleet_inflight_cap,
                          journal_dir=journal_dir,
-                         batch=opts.fleet_batch or None)
+                         batch=batch,
+                         service_factory=service_factory)
     report = runner.run()
     print(report.summary())
     return 0 if report.ok else 1

@@ -304,6 +304,41 @@ FLEET_CATALOG_SHARED = REGISTRY.counter(
     "device-resident tensors and compiled executables), a 'miss' paid "
     "the full encode_catalog",
     ("event",))
+FEDERATION_RPCS = REGISTRY.counter(
+    "karpenter_tpu_federation_rpcs_total",
+    "Federation-plane RPCs issued by this process (federation/"
+    "transport.py), by method (handshake, has_catalog, put_catalog, "
+    "solve_bucket, report) and outcome ('ok' = the server answered, "
+    "'error' = a transport failure or server-side refusal — each error "
+    "feeds the client's local-fallback cooldown)",
+    ("method", "outcome"))
+FEDERATION_WIRE_BYTES = REGISTRY.counter(
+    "karpenter_tpu_federation_wire_bytes_total",
+    "Serialized federation payload bytes by direction ('sent' / "
+    "'received'), measured at the transport after JSON encoding — the "
+    "numerator of the bench's c17_wire_overhead_frac: wire bytes per "
+    "solve vs the tensor bytes the catalog-token protocol avoided "
+    "re-shipping",
+    ("direction",))
+FEDERATION_CATALOG = REGISTRY.counter(
+    "karpenter_tpu_federation_catalog_total",
+    "Cross-process catalog-token protocol events: 'announce_hit' = the "
+    "server already held the content-keyed view (zero tensor bytes "
+    "crossed), 'announce_miss' = the token was unknown, 'upload' = the "
+    "client shipped the catalog tensors. Steady state is one 'upload' "
+    "per catalog view per CLUSTER — every further process announces "
+    "into a hit (bench key c17_catalog_uploads_per_cluster)",
+    ("event",))
+FEDERATION_FALLBACKS = REGISTRY.counter(
+    "karpenter_tpu_federation_fallbacks_total",
+    "Buckets a federated client ran LOCALLY instead of over the wire, "
+    "by reason: 'error' = the solve RPC failed mid-flight (server "
+    "crash, transport drop — the bucket's tickets degrade through the "
+    "host-solve path exactly like a device fault), 'cooldown' = a "
+    "recent failure armed the count-based suppression window and the "
+    "wire wasn't retried, 'no_token' = the bucket's catalog view "
+    "carries no content token so it cannot cross processes",
+    ("reason",))
 PROFILE_PHASE_MS = REGISTRY.counter(
     "karpenter_tpu_profile_phase_ms_total",
     "Milliseconds of wall time the phase-attribution ledger "

@@ -71,6 +71,10 @@ PHASES: Tuple[str, ...] = (
     #                     verification of ranked subsets
     "integrity",        # solution-integrity plane: feasibility oracle,
     #                     canary dual-path re-solves, resident audits
+    "wire",             # federation plane: serialized RPC latency between
+    #                     a fleet process and the solver server (encode +
+    #                     transport + server turnaround; the bench's
+    #                     c17_wire_overhead_frac numerator)
     "reconcile_other",  # controller pass glue outside the seams above
 )
 
@@ -117,6 +121,7 @@ _SPAN_PHASE: Dict[str, str] = {
     "optimizer.search": "optimizer_search",
     "optimizer.verify": "optimizer_verify",
     "integrity.verify": "integrity",
+    "federation.wire": "wire",
 }
 
 COVERAGE_TARGET = 0.99

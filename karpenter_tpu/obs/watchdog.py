@@ -114,6 +114,7 @@ INVARIANTS: Tuple[str, ...] = (
     "optimizer_divergence",
     "integrity_breach",
     "recompute_runaway",
+    "federation_degraded",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -133,6 +134,7 @@ _VIOLATION_MAP: Tuple[Tuple[str, str], ...] = (
     ("auditor diverged", "warm_divergence"),
     ("unbounded backlog", "overload_unbounded"),
     ("integrity violation", "integrity_breach"),
+    ("wire failure", "federation_degraded"),
 )
 
 
@@ -594,6 +596,7 @@ class Watchdog:
             else:
                 self._clear("fleet_starvation", tenant)
         self._check_pipeline(now, fired)
+        self._check_federation(now, fired)
 
     def _check_pipeline(self, now: float, fired: List[Finding]) -> None:
         """The batched dispatcher's pipeline invariants (no-op on a
@@ -623,6 +626,28 @@ class Watchdog:
                            copending=cs["copending_pumps"])
             else:
                 self._clear("pipeline_stall", key)
+
+    def _check_federation(self, now: float,
+                          fired: List[Finding]) -> None:
+        """The federation plane's degrade ladder, surfaced ONLINE: a
+        wire failure arms the client's cooldown, and this fires while
+        any cooldown is armed — so the first degraded bucket pages
+        before a tenant SLO burns, and the finding clears itself once
+        buckets cross the wire again (no-op on in-process services)."""
+        svc = self.service
+        state_fn = getattr(svc, "federation_state", None)
+        if state_fn is None:
+            return
+        fs = state_fn()
+        if fs.get("degraded"):
+            self._fire(fired, "federation_degraded", "warning", "wire",
+                       f"federated dispatch degraded to the local path: "
+                       f"{fs['failures']} wire failure(s), cooldown "
+                       f"{fs['cooldown']} bucket(s) remaining "
+                       f"(last: {fs['last_error']})", now,
+                       failures=fs["failures"], cooldown=fs["cooldown"])
+        else:
+            self._clear("federation_degraded", "wire")
 
     def _check_meters(self, now: float, fired: List[Finding]) -> None:
         from .profile import LEDGER

@@ -155,6 +155,11 @@ class ResidentEntry:
     buf: object                    # jax.Array
     group: int                     # residency-ledger group id
     shape_class: Optional[str] = None
+    # explicit device layout (jax.sharding.NamedSharding) the buffer was
+    # committed with, None = default single-device placement. A layout
+    # change re-seeds like a shape change: patching a replicated buffer
+    # with sharded row blocks would silently commit to the wrong devices
+    sharding: object = None
     stats: Dict[str, int] = field(default_factory=lambda: {
         "patches": 0, "full": 0, "clean": 0,
         "rows_patched": 0, "rows_total": 0})
@@ -193,7 +198,8 @@ class ResidentStateManager:
                token: Optional[tuple] = None,
                shape_class: Optional[str] = None,
                donate: bool = True,
-               patch_across_tokens: bool = False):
+               patch_across_tokens: bool = False,
+               sharding=None):
         """Return a device array holding `matrix`'s bytes: the patched
         resident buffer when the view matches, a full (re-)upload on any
         fallback trigger. `matrix` is digested on axis 0 (rows = pod
@@ -207,7 +213,14 @@ class ResidentStateManager:
         Correctness never rides the token either way: the digest diff
         compares the new host bytes against the resident copy's, so a
         patch always lands the new content exactly. Request matrices
-        keep the conservative default (epoch bump => full re-upload)."""
+        keep the conservative default (epoch bump => full re-upload).
+
+        sharding: commit (and patch) the buffer under an explicit
+        jax.sharding layout — the mesh-path residency seam (PR 11
+        follow-up): the federation server's batched request stacks live
+        sharded over the batch mesh, and their patches ship per-shard
+        row blocks through _put_sharded instead of re-uploading full.
+        A layout change re-seeds under the shape_change reason."""
         token = tuple(token) if token is not None else None
         mat = np.ascontiguousarray(matrix)
         with self._lock:
@@ -216,6 +229,11 @@ class ResidentStateManager:
             if ent is None:
                 reason = "first_sight"
             elif ent.shape != mat.shape:
+                reason = "shape_change"
+            elif ent.sharding != sharding:
+                # device layout changed (mesh grew, replicated -> sharded):
+                # the resident bytes live on the wrong devices — same
+                # re-seed class as the shape growing
                 reason = "shape_change"
             elif ent.dtype != mat.dtype:
                 reason = "dtype_change"
@@ -228,7 +246,7 @@ class ResidentStateManager:
         if reason is not None:
             return self._corruption_seam(
                 key, self._full_upload(key, mat, token, shape_class,
-                                       reason))
+                                       reason, sharding=sharding))
         digests = dm.UploadMeter._row_digests(mat.reshape(mat.shape[0], -1))
         changed = np.nonzero(digests != ent.digests)[0]
         rows = int(mat.shape[0])
@@ -236,7 +254,8 @@ class ResidentStateManager:
         if changed.size > rows * PATCH_MAX_FRAC:
             return self._corruption_seam(
                 key, self._full_upload(key, mat, token, shape_class,
-                                       "dense", digests=digests))
+                                       "dense", digests=digests,
+                                       sharding=sharding))
         try:
             return self._corruption_seam(
                 key, self._patch(ent, mat, digests, changed, row_bytes,
@@ -257,7 +276,8 @@ class ResidentStateManager:
 
     def _full_upload(self, key: tuple, mat: np.ndarray,
                      token: Optional[tuple], shape_class: Optional[str],
-                     reason: str, digests: Optional[np.ndarray] = None):
+                     reason: str, digests: Optional[np.ndarray] = None,
+                     sharding=None):
         from ..metrics import DEVICEMEM_PATCH, RESIDENT_FALLBACKS
         from . import solver as _ops
         RESIDENT_FALLBACKS.inc(reason=reason)
@@ -266,7 +286,8 @@ class ResidentStateManager:
                 mat.reshape(mat.shape[0], -1))
         with dm.attributed(kind="resident_state",
                            shape_class=shape_class) as grp:
-            buf = _ops._put(mat)
+            buf = (_ops._put_sharded(mat, sharding) if sharding is not None
+                   else _ops._put(mat))
         # shipped-bytes redundancy metering: with residency armed the
         # meter sees what actually crosses the tunnel, so a steady warm
         # path collapses upload_redundant_frac toward zero changed bytes.
@@ -281,7 +302,8 @@ class ResidentStateManager:
         if ent is None:
             ent = ResidentEntry(key=key, token=token, shape=mat.shape,
                                 dtype=mat.dtype, digests=digests, buf=buf,
-                                group=grp, shape_class=shape_class)
+                                group=grp, shape_class=shape_class,
+                                sharding=sharding)
         else:
             # refresh IN PLACE: the entry object stays the ledger owner
             # of its previous groups, so a predecessor buffer another
@@ -290,6 +312,7 @@ class ResidentStateManager:
             ent.token, ent.shape, ent.dtype = token, mat.shape, mat.dtype
             ent.digests, ent.buf, ent.group = digests, buf, grp
             ent.shape_class = shape_class
+            ent.sharding = sharding
         dm.DEVICEMEM.adopt(grp, ent)
         ent.stats["full"] += 1
         ent.stats["rows_total"] += int(mat.shape[0])
@@ -304,6 +327,24 @@ class ResidentStateManager:
             self.stats["rows_total"] += int(mat.shape[0])
         DEVICEMEM_PATCH.inc(float(mat.nbytes), outcome="full")
         return buf
+
+    @staticmethod
+    def _axis0_shards(sharding) -> int:
+        """Shard count along axis 0 of a NamedSharding (1 = replicated /
+        unsharded axis). Defensive: any layout this can't read patches
+        through the flat (replicated-index) path, which is correct under
+        every layout — GSPMD just ships the index vector everywhere."""
+        try:
+            spec = sharding.spec
+            if not spec or spec[0] is None:
+                return 1
+            names = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+            n = 1
+            for nm in names:
+                n *= int(sharding.mesh.shape[nm])
+            return n
+        except Exception:  # noqa: BLE001 — layout introspection best-effort
+            return 1
 
     def _patch(self, ent: ResidentEntry, mat: np.ndarray,
                digests: np.ndarray, changed: np.ndarray, row_bytes: int,
@@ -327,19 +368,49 @@ class ResidentStateManager:
             if avoided:
                 DEVICEMEM_PATCH.inc(float(avoided), outcome="avoided")
             return ent.buf
-        changed_rows = np.ascontiguousarray(mat[changed])
         from . import solver as _ops
+        n_sh = (self._axis0_shards(ent.sharding)
+                if ent.sharding is not None else 1)
+        grouped = n_sh > 1 and rows % n_sh == 0
+        if grouped:
+            # per-shard row blocks: shard s owns rows [s*q, (s+1)*q) of
+            # the axis-0-sharded buffer, so its changed indices group
+            # into ITS slot of a [n_sh, k] index matrix — each device
+            # then receives only the rows it will write (h2d per chip
+            # shrinks with the mesh). Groups pad to the widest with
+            # IDEMPOTENT duplicates: a repeated index rewrites the same
+            # new row, an empty group rewrites one of its own UNCHANGED
+            # rows with its current bytes — byte-identical no-ops either
+            # way, so the scatter's duplicate-write order can't matter.
+            q = rows // n_sh
+            groups = [changed[(changed >= s * q) & (changed < (s + 1) * q)]
+                      for s in range(n_sh)]
+            k = max(int(g.size) for g in groups)
+            idx_np = np.empty((n_sh, k), np.int32)
+            for s, g in enumerate(groups):
+                fill = int(g[0]) if g.size else s * q
+                idx_np[s, :g.size] = g
+                idx_np[s, g.size:] = fill
+            rows_np = np.ascontiguousarray(mat[idx_np])  # [n_sh, k, ...]
+            changed_rows = np.ascontiguousarray(mat[changed])
+        else:
+            idx_np = changed.astype(np.int32)
+            rows_np = changed_rows = np.ascontiguousarray(mat[changed])
         sp = (TRACER.span("solve.resident_patch", rows=int(changed.size),
                           total_rows=rows,
-                          donate=bool(donate))
+                          donate=bool(donate), shards=n_sh)
               if TRACER.enabled else NOOP_SPAN)
         with sp:
             b0 = dm.TRANSFERS.totals()[0]
             with dm.attributed(reason="resident_patch",
                                kind="resident_state",
                                shape_class=shape_class):
-                idx_dev = _ops._put(changed.astype(np.int32))
-                rows_dev = _ops._put(changed_rows)
+                if grouped:
+                    idx_dev = _ops._put_sharded(idx_np, ent.sharding)
+                    rows_dev = _ops._put_sharded(rows_np, ent.sharding)
+                else:
+                    idx_dev = _ops._put(idx_np)
+                    rows_dev = _ops._put(rows_np)
             new_buf = _scatter_fn(donate)(ent.buf, idx_dev, rows_dev)
             # the dispatch CONSUMED ent.buf when donating — rebind the
             # entry to the scatter output IMMEDIATELY so no later

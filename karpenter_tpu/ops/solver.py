@@ -701,6 +701,40 @@ def _batched_fn():  # graftlint: donates=3
     return _solve_batched if cpu else _solve_batched_donate
 
 
+# mesh-jitted BATCHED kernels, keyed on the (hashable) Mesh — the same
+# bound-cache discipline as _mesh_fn_cache below. One executable per
+# mesh serves every shape class (shapes are jit cache keys underneath).
+_batched_mesh_cache: dict = {}
+_BATCHED_MESH_CACHE_MAX = 16
+
+
+def _batched_mesh_fn(mesh):
+    """jit the batched kernel with the REQUEST axis laid across `mesh`
+    (parallel/mesh.make_batch_mesh): input shardings ride in on the
+    device_put stack (P(axis) over batch rows), the catalog replicates,
+    and out_shardings pins the packed [Bp, L] result to the same layout.
+    vmap lanes are independent solves, so GSPMD partitions this with no
+    collectives at all — batch capacity scales linearly with mesh.size.
+    NEVER donates: the sharded stack may be a resident buffer the server
+    patches next round (ops/resident.py sharded puts)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    fn = _batched_mesh_cache.get(mesh)
+    if fn is None:
+        if len(_batched_mesh_cache) >= _BATCHED_MESH_CACHE_MAX:
+            _batched_mesh_cache.clear()
+            # dead jit wrappers ⇒ honest recompiles next dispatch
+            _compile_seen.difference_update(
+                {k for k in _compile_seen if k[0] == "batch_mesh"})
+        axis = mesh.axis_names[0]
+        fn = partial(
+            jax.jit, static_argnames=("n_max", "k_max", "cols",
+                                      "track_conflicts", "zone_ovh"),
+            out_shardings=NamedSharding(mesh, P(axis)),
+        )(_solve_batched_impl)
+        _batched_mesh_cache[mesh] = fn
+    return fn
+
+
 @dataclass
 class BatchableSolve:
     """One solve request staged for batched dispatch: the encoded
@@ -838,6 +872,21 @@ class InFlightBatch:
     def results(self) -> List[SolveResult]:
         return [self.decode(i) for i in range(self.size)]
 
+    @classmethod
+    def from_rows(cls, reqs: List[BatchableSolve], rows: np.ndarray,
+                  span_s: float = 0.0) -> "InFlightBatch":
+        """Rehydrate a drained batch from already-read packed rows —
+        the federation client's path: the device half ran in the server
+        process and the [Bp, L] int32 rows arrived as wire bytes.
+        decode() then runs HERE against the client's own cat/enc, so a
+        federated solve and an in-process solve share one decode path
+        (byte-identical results by construction). block() is a no-op
+        (_buf already set); the wire latency is the caller's to meter."""
+        ifb = cls(reqs, None, 0.0)
+        ifb._buf = np.ascontiguousarray(rows, dtype=np.int32)
+        ifb.span_s = float(span_s)
+        return ifb
+
 
 # batch-axis padding buckets: {1, 2, 3, 4, 6, 8, 12, 16, ...} so
 # executables converge per shape class instead of recompiling per fleet
@@ -846,11 +895,119 @@ def _batch_bucket(b: int) -> int:
     return _bucket(b, 1)
 
 
-def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
+def _stage_batch_stack(gstack_np: np.ndarray, conf_np, track: bool,
+                       mesh=None, resident_key: Optional[tuple] = None,
+                       token=None, shape_class: str = ""):
+    """Upload one packed request stack ([Bp, Gp, W] f32, plus the
+    optional [Bp, Gp, Gp] conflict stack). Three routes, composable:
+    plain _put (classic), _put_sharded over a batch mesh (each device
+    receives only ITS batch rows — h2d volume per chip shrinks with
+    mesh.size), or the resident manager (resident_key set: an unchanged
+    tenant's rows patch instead of re-uploading, sharded when a mesh is
+    given — the PR 11 follow-up). Returns (gstack, conf, ledger group,
+    donate_ok): resident and mesh stacks must NOT be donated — resident
+    buffers serve the next pump, and the mesh jit never donates."""
+    sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    with dm.attributed(reason="batch_upload", kind="batch_gbuf",
+                       shape_class=shape_class) as grp:
+        donate_ok = False
+        if resident_key is not None:
+            from .resident import RESIDENT
+            if RESIDENT.armed:
+                gstack = RESIDENT.upload(
+                    resident_key + ("batch_gbuf",) + tuple(gstack_np.shape),
+                    gstack_np, token=token, shape_class=shape_class,
+                    donate=False, sharding=sharding)
+            elif sharding is not None:
+                gstack = _maybe_corrupt(
+                    "gbuf", _put_sharded(gstack_np, sharding))
+            else:
+                gstack = _maybe_corrupt("gbuf", _put(gstack_np))
+        elif sharding is not None:
+            gstack = _maybe_corrupt("gbuf", _put_sharded(gstack_np, sharding))
+        else:
+            gstack = _maybe_corrupt("gbuf", _put(gstack_np))
+            donate_ok = True
+        conf = None
+        if track and conf_np is not None:
+            conf = (_put_sharded(conf_np, sharding) if sharding is not None
+                    else _put(conf_np))
+    return gstack, conf, grp, donate_ok
+
+
+def _dispatch_stack(gstack, conf, dcat, st: dict, donate_ok: bool,
+                    mesh=None):
+    """Classify the dispatch hit/miss and run the batched kernel —
+    the device half shared by dispatch_batch (in-process buckets) and
+    dispatch_packed (federation server: the stack arrived as wire
+    bytes). Consumes `gstack` (possibly donated) — callers must not
+    touch their handle afterwards."""
+    track, zone_ovh = st["track_conflicts"], st["zone_ovh"]
+    Bp = int(gstack.shape[0])
+    head = ("batch_mesh", mesh) if mesh is not None else ("batch",)
+    event = _dispatch_cache_event(
+        head + (Bp, tuple(dcat.alloc.shape), tuple(dcat.price.shape),
+                tuple(gstack.shape), track, zone_ovh, st["n_max"],
+                st["k_max"], tuple(st["cols"])))
+    sp = (TRACER.span("solve.compile" if event == "miss"
+                      else "solve.dispatch", cache=event, backend="device",
+                      batch=Bp, n_max=st["n_max"], mesh=mesh is not None)
+          if TRACER.enabled else NOOP_SPAN)
+    # NO fault-hook probe here: the fleet's injector routes faults by
+    # current_tenant(), and this call serves MANY tenants — the caller
+    # probes via probe_dispatch_fault() under each tenant's scope BEFORE
+    # dispatching (fleet/service._dispatch_bucket), so a tenant-targeted
+    # fault aborts the batch while an unscoped probe can neither miss
+    # the target nor fire for a tenant that isn't even in the batch
+    # the donating call keeps the factory at the call site (not bound to
+    # a local first): the use-after-donate lint resolves donate positions
+    # from `_batched_fn()(...)` shapes, and this is the site it guards
+    with sp:
+        if mesh is not None:
+            packed = _batched_mesh_fn(mesh)(
+                dcat.alloc, dcat.price, dcat.avail, gstack, conf,
+                dcat.ovh_z if zone_ovh else None,
+                n_max=st["n_max"], k_max=st["k_max"], cols=st["cols"],
+                track_conflicts=track, zone_ovh=zone_ovh)
+        elif not donate_ok:
+            packed = _solve_batched(
+                dcat.alloc, dcat.price, dcat.avail, gstack, conf,
+                dcat.ovh_z if zone_ovh else None,
+                n_max=st["n_max"], k_max=st["k_max"], cols=st["cols"],
+                track_conflicts=track, zone_ovh=zone_ovh)
+        else:  # donating branch LAST: no gstack read may follow it
+            packed = _batched_fn()(
+                dcat.alloc, dcat.price, dcat.avail, gstack, conf,
+                dcat.ovh_z if zone_ovh else None,
+                n_max=st["n_max"], k_max=st["k_max"], cols=st["cols"],
+                track_conflicts=track, zone_ovh=zone_ovh)
+    # dispatch donated gstack (off-CPU): XLA may already have reused its
+    # bytes for `packed` — drop the host handle so no later edit can
+    # read the dead buffer (the use-after-donate lint rule enforces it)
+    del gstack
+    return packed
+
+
+def dispatch_batch(reqs: List[BatchableSolve], mesh=None,
+                   resident_key: Optional[tuple] = None) -> InFlightBatch:
     """Pack one bucket of same-signature requests into a single device
     call and return without blocking (the device executes while the
     caller stages the next bucket). Padded batch rows replicate request
-    0 with every group count zeroed — pure no-ops in the scan."""
+    0 with every group count zeroed — pure no-ops in the scan.
+
+    mesh: lay the REQUEST axis across a batch mesh
+    (parallel/mesh.make_batch_mesh) — Bp rounds up to a mesh.size
+    multiple so every chip owns whole rows, the bucket's device catalog
+    replicates over the mesh, and batch capacity scales with slice size
+    instead of the padding ladder. Results are decoded row-by-row
+    exactly like the single-device path (lanes never interact), so
+    hashes are identical either way.
+    resident_key: route the stacked request matrix through the
+    device-resident manager (federation server steady state: tenant
+    rows that didn't change between pumps patch instead of re-ship)."""
     import time as _time
     assert reqs, "empty batch"
     first = reqs[0]
@@ -858,9 +1015,16 @@ def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
         "batched requests must share one shape-class signature"
     st = first.statics
     Gp, cols = first.Gp, list(st["cols"])
-    track, zone_ovh = st["track_conflicts"], st["zone_ovh"]
+    track = st["track_conflicts"]
     dcat = first.dcat
     B, Bp = len(reqs), _batch_bucket(len(reqs))
+    if mesh is not None:
+        ms = int(mesh.size)
+        Bp = -(-Bp // ms) * ms  # whole rows per chip: Bp % mesh.size == 0
+        # the bucket must read a catalog resident on the SAME mesh —
+        # _auto_dcat keys on (token|id, mesh), so this is one replicated
+        # upload per (view, mesh), shared by every later bucket
+        dcat = _auto_dcat(first.cat, first.enc.requests.shape[1], mesh=mesh)
     sp = (TRACER.span("solve.batch_pack", requests=B, padded=Bp,
                       shape_class=first.shape_class)
           if TRACER.enabled else NOOP_SPAN)
@@ -878,41 +1042,20 @@ def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
             pad = gbufs[0].copy()
             pad[:, len(cols)] = 0.0  # zero the counts column: a no-op row
             gbufs.extend([pad] * (Bp - B))
-        with dm.attributed(reason="batch_upload", kind="batch_gbuf",
-                           shape_class=first.shape_class) as grp:
-            gstack = _maybe_corrupt("gbuf", _put(np.stack(gbufs)))
-            conf = None
-            if track:
-                confs = [_pad_to(_pad_to(r.enc.conflict, Gp, 0), Gp, 1)
-                         if r.enc.conflict is not None
-                         else np.zeros((Gp, Gp), bool) for r in reqs]
-                confs.extend([np.zeros((Gp, Gp), bool)] * (Bp - B))
-                conf = _put(np.stack(confs))
+        conf_np = None
+        if track:
+            confs = [_pad_to(_pad_to(r.enc.conflict, Gp, 0), Gp, 1)
+                     if r.enc.conflict is not None
+                     else np.zeros((Gp, Gp), bool) for r in reqs]
+            confs.extend([np.zeros((Gp, Gp), bool)] * (Bp - B))
+            conf_np = np.stack(confs)
+        gstack, conf, grp, donate_ok = _stage_batch_stack(
+            np.stack(gbufs), conf_np, track, mesh=mesh,
+            resident_key=resident_key, token=first.cat.cache_token,
+            shape_class=first.shape_class)
         sp.set(h2d_bytes=transfer_bytes()[0] - b0)
-    event = _dispatch_cache_event(
-        ("batch", Bp, tuple(dcat.alloc.shape), tuple(dcat.price.shape),
-         tuple(gstack.shape), track, zone_ovh, st["n_max"], st["k_max"],
-         tuple(st["cols"])))
-    sp = (TRACER.span("solve.compile" if event == "miss"
-                      else "solve.dispatch", cache=event, backend="device",
-                      batch=Bp, n_max=st["n_max"])
-          if TRACER.enabled else NOOP_SPAN)
-    # NO fault-hook probe here: the fleet's injector routes faults by
-    # current_tenant(), and this call serves MANY tenants — the caller
-    # probes via probe_dispatch_fault() under each tenant's scope BEFORE
-    # dispatching (fleet/service._dispatch_bucket), so a tenant-targeted
-    # fault aborts the batch while an unscoped probe can neither miss
-    # the target nor fire for a tenant that isn't even in the batch
-    with sp:
-        packed = _batched_fn()(
-            dcat.alloc, dcat.price, dcat.avail, gstack, conf,
-            dcat.ovh_z if zone_ovh else None,
-            n_max=st["n_max"], k_max=st["k_max"], cols=st["cols"],
-            track_conflicts=track, zone_ovh=zone_ovh)
-    # dispatch donated gstack (off-CPU): XLA may already have reused its
-    # bytes for `packed` — drop the host handle so no later edit can
-    # read the dead buffer (the use-after-donate lint rule enforces it)
-    del gstack
+    packed = _dispatch_stack(gstack, conf, dcat, st, donate_ok, mesh=mesh)
+    del gstack  # consumed by _dispatch_stack (donated off-CPU)
     ifb = InFlightBatch(reqs, packed, _time.perf_counter())
     # the in-flight batch OWNS the staged uploads and the pending packed
     # output: residency drops when it drains (block() frees _packed) or
@@ -921,6 +1064,47 @@ def dispatch_batch(reqs: List[BatchableSolve]) -> InFlightBatch:
     dm.DEVICEMEM.track("packed_result", [packed], owner=ifb,
                        shape_class=first.shape_class)
     return ifb
+
+
+def dispatch_packed(gstack_np: np.ndarray, conf_np, dcat: "DeviceCatalog",
+                    statics: dict, shape_class: str = "", mesh=None,
+                    resident_key: Optional[tuple] = None, token=None):
+    """Dispatch an ALREADY-PACKED request stack — the federation
+    server's entry point: its clients packed the gbufs on their own
+    hosts and shipped the bytes, so there are no BatchableSolve objects
+    (no cat/enc) on this side. Pads the batch axis to the bucket (and
+    mesh multiple), uploads, dispatches, and returns (device packed
+    [Bp, L] int32, residency-ledger group) without blocking; the caller
+    reads the rows back and ships them to the owning clients, which
+    decode with their own catalogs."""
+    B = int(gstack_np.shape[0])
+    Bp = _batch_bucket(B)
+    if mesh is not None:
+        ms = int(mesh.size)
+        Bp = -(-Bp // ms) * ms
+    track = statics["track_conflicts"]
+    if Bp > B:
+        pad = np.repeat(gstack_np[:1], Bp - B, axis=0).copy()
+        pad[:, :, len(statics["cols"])] = 0.0  # zero counts: no-op rows
+        gstack_np = np.concatenate([gstack_np, pad], axis=0)
+        if track and conf_np is not None:
+            conf_np = np.concatenate(
+                [conf_np, np.zeros((Bp - B,) + conf_np.shape[1:], bool)],
+                axis=0)
+    sp = (TRACER.span("solve.batch_pack", requests=B, padded=Bp,
+                      shape_class=shape_class)
+          if TRACER.enabled else NOOP_SPAN)
+    with sp:
+        b0 = transfer_bytes()[0]
+        gstack, conf, grp, donate_ok = _stage_batch_stack(
+            gstack_np, conf_np, track, mesh=mesh,
+            resident_key=resident_key, token=token,
+            shape_class=shape_class)
+        sp.set(h2d_bytes=transfer_bytes()[0] - b0)
+    packed = _dispatch_stack(gstack, conf, dcat, statics, donate_ok,
+                             mesh=mesh)
+    del gstack  # consumed by _dispatch_stack (donated off-CPU)
+    return packed, grp
 
 
 def probe_dispatch_fault(backend: str) -> None:

@@ -38,6 +38,22 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(mesh_devices, ("nodes",))
 
 
+def make_batch_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the batched dispatcher's REQUEST axis (axis name
+    "batch") — the other way to spend a slice. make_mesh shards one big
+    solve's node axis; this shards a bucket of independent solves, one
+    whole request per chip (vmap lanes never interact, so GSPMD inserts
+    ZERO collectives — embarrassingly parallel). Batch capacity then
+    scales with slice size instead of the padding ladder: a bucket of
+    B requests costs ceil(B / n_devices) sequential kernel latencies.
+    On a 1-device host (tier-1 CPU runs) this degenerates to the plain
+    batched path byte-for-byte."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    mesh_devices = mesh_utils.create_device_mesh((n,), devices=devices[:n])
+    return Mesh(mesh_devices, ("batch",))
+
+
 # jitted sharded-solve wrappers, keyed on the (hashable) Mesh + n_max —
 # the bound-cache discipline every other mesh-jit factory in the tree
 # follows (consolidate._mesh_screen_fn, solver._mesh_fn_cache): without

@@ -122,6 +122,21 @@ class Options:
     # work for batch k. Results, hashes, and fault fingerprints are
     # identical either way; only read in fleet mode
     fleet_batch: bool = False
+    # federation mode (docs/federation.md): route the fleet's batched
+    # buckets through the federation plane (karpenter_tpu/federation) —
+    # the device half of every solve runs in a SolverServer process
+    # reached over the cloud/remote.py wire, catalogs cross once per
+    # cluster via content tokens, and wire failures degrade buckets to
+    # the local host-solve path under the watchdog's
+    # federation_degraded invariant. Implies --fleet-batch and a device
+    # backend; only read in fleet mode
+    federate: bool = False
+    # host:port of a running federation solver server (python -m
+    # karpenter_tpu.federation.server); empty with --federate embeds a
+    # SolverServer behind an in-memory transport (full wire fidelity —
+    # every payload round-trips the codec — without a socket); only
+    # read with --federate
+    server_addr: str = ""
     # long-soak serving mode (loadgen/, docs/loadgen.md): --soak drives
     # a tenant fleet OPEN-LOOP — seeded arrival processes fire on the
     # sim clock without waiting for drain, admission control sheds or

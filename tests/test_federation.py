@@ -1,0 +1,614 @@
+"""Federation plane: the fleet over the wire (ISSUE 18).
+
+Covers the wire envelopes (codec round-trip for every class), the
+catalog token protocol (announce/upload once per cluster, width rule,
+LRU eviction, unknown-token retry), the cross-process determinism
+contract (federated digests byte-identical to in-process, with and
+without a batch mesh on the server), the degrade ladder (mid-solve
+server crash host-solves the bucket, arms the cooldown, and trips the
+watchdog's federation_degraded invariant FIRST), corruption detection
+across the process boundary, schema-skew rejection at every layer, and
+the real HTTP transport (in-thread server; the subprocess READY
+protocol is slow-marked).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.catalog import CatalogProvider
+from karpenter_tpu.catalog.generator import small_catalog
+from karpenter_tpu.cloud.remote import (WIRE_SCHEMA_VERSION, NotFoundError,
+                                        WireVersionError)
+from karpenter_tpu.federation import (FederatedSolverClient,
+                                      build_federated_service)
+from karpenter_tpu.federation.envelopes import (
+    AdmissionVerdictEnvelope, CatalogUploadEnvelope, HandshakeEnvelope,
+    IntegrityVerdictEnvelope, ReportAck, SolveBucketRequest,
+    SolveBucketResult, WatchdogFindingEnvelope, decode_envelope,
+    encode_envelope, pack_array, tensor_bytes, unpack_array)
+from karpenter_tpu.federation.server import SolverServer, serve_in_thread
+from karpenter_tpu.federation.transport import HTTPTransport, InMemoryTransport
+from karpenter_tpu.fleet import FleetRunner
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import Pod
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.utils.clock import FakeClock
+
+V = WIRE_SCHEMA_VERSION
+
+
+def mk_pods(n, prefix="p", cpu="500m", mem="1Gi"):
+    return [Pod(name=f"{prefix}-{i}",
+                requests=Resources.parse({"cpu": cpu, "memory": mem}))
+            for i in range(n)]
+
+
+def mk_fed_service(process="p000", shared_server=None, run_id="fed-test",
+                   mesh=None, **kw):
+    kw.setdefault("backend", "device")
+    kw.setdefault("batch", True)
+    return build_federated_service(FakeClock(), run_id=run_id,
+                                   process=process,
+                                   shared_server=shared_server,
+                                   mesh=mesh, **kw)
+
+
+# ---------------------------------------------------------------------------
+# wire envelopes
+# ---------------------------------------------------------------------------
+
+
+class TestEnvelopeCodec:
+    """Every envelope class must survive encode -> JSON -> decode with
+    full equality — tuples stay tuples (tokens are dict keys on the
+    server) and tensors come back bit-identical."""
+
+    def _roundtrip(self, env):
+        wire = json.loads(json.dumps(encode_envelope(env), sort_keys=True))
+        out = decode_envelope(wire)
+        assert type(out) is type(env)
+        return out
+
+    def test_handshake(self):
+        env = HandshakeEnvelope(schema=V, run_id="r", process="p000")
+        assert self._roundtrip(env) == env
+
+    def test_catalog_upload(self):
+        rng = np.random.default_rng(0)
+        env = CatalogUploadEnvelope(
+            schema=V, run_id="r", process="p001",
+            token=("shared", "abcd", "efgh"),
+            alloc=pack_array(rng.random((3, 5)).astype(np.float32)),
+            price=pack_array(rng.random((3, 4)).astype(np.float32)),
+            avail=pack_array(np.ones((3, 4), np.bool_)),
+            ovh_z=None, R=5)
+        out = self._roundtrip(env)
+        assert out == env
+        assert isinstance(out.token, tuple)
+        np.testing.assert_array_equal(unpack_array(out.alloc),
+                                      unpack_array(env.alloc))
+
+    def test_solve_bucket_request(self):
+        g = np.arange(2 * 4 * 6, dtype=np.float32).reshape(2, 4, 6)
+        env = SolveBucketRequest(
+            schema=V, run_id="r", process="p000",
+            token=("shared", "h", "f"), shape_class="g4n8",
+            Gp=4, B=2,
+            statics={"n_max": 8, "k_max": 4, "cols": (0, 1, 2),
+                     "track_conflicts": True, "zone_ovh": False},
+            gbuf=pack_array(g),
+            conf=pack_array(np.zeros((2, 4, 4), np.bool_)),
+            tenants=("t000", "t001"))
+        out = self._roundtrip(env)
+        assert out == env
+        assert out.statics["cols"] == (0, 1, 2)  # tuple, not list
+        np.testing.assert_array_equal(unpack_array(out.gbuf), g)
+
+    def test_solve_bucket_result(self):
+        rows = np.arange(12, dtype=np.int32).reshape(3, 4)
+        env = SolveBucketResult(schema=V, run_id="r",
+                                rows=pack_array(rows), span_s=0.25,
+                                padded=3)
+        out = self._roundtrip(env)
+        assert out == env
+        got = unpack_array(out.rows)
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(got, rows)
+
+    def test_verdict_and_finding_envelopes(self):
+        for env in (
+            AdmissionVerdictEnvelope(schema=V, run_id="r", process="p0",
+                                     tenant="t0", action="admit",
+                                     reason="under quota"),
+            IntegrityVerdictEnvelope(schema=V, run_id="r", process="p0",
+                                     tenant="t0", check="capacity",
+                                     ok=False, detail="node n3 over"),
+            WatchdogFindingEnvelope(schema=V, run_id="r", process="p0",
+                                    invariant="federation_degraded",
+                                    severity="warning", key="wire",
+                                    message="cooldown armed"),
+            ReportAck(schema=V, run_id="r", accepted=3),
+        ):
+            assert self._roundtrip(env) == env
+
+    def test_unknown_envelope_rejected(self):
+        with pytest.raises(ValueError):
+            decode_envelope({"__fed__": "NopeEnvelope", "f": {}})
+        with pytest.raises(TypeError):
+            encode_envelope(object())
+
+    def test_tensor_bytes(self):
+        p = pack_array(np.zeros((3, 5), np.float32))
+        assert tensor_bytes(p) == 3 * 5 * 4
+        assert tensor_bytes(None) == 0
+
+
+# ---------------------------------------------------------------------------
+# catalog token protocol (server-side, synthetic tensors)
+# ---------------------------------------------------------------------------
+
+
+class TestCatalogProtocol:
+    def _upload(self, server, token, R, run_id="x"):
+        env = CatalogUploadEnvelope(
+            schema=V, run_id=run_id, process="p0", token=token,
+            alloc=pack_array(np.ones((3, R), np.float32)),
+            price=pack_array(np.ones((3, 2), np.float32)),
+            avail=pack_array(np.ones((3, 2), np.bool_)),
+            ovh_z=None, R=R)
+        return server.handle("put_catalog", encode_envelope(env))
+
+    def test_upload_once_then_announce_hits(self):
+        server = SolverServer(run_id="x")
+        tok = ("shared", "h", "f")
+        out = self._upload(server, tok, 5)
+        assert out["result"] == {"stored": True, "duplicate": False}
+        # duplicate upload at the same width carries no new information
+        out = self._upload(server, tok, 5)
+        assert out["result"]["duplicate"] is True
+        assert server.stats["catalog_uploads"] == 1
+        out = server.handle("has_catalog", {"schema": V,
+                                            "token": list(tok), "R": 5})
+        assert out["result"]["present"] is True
+
+    def test_width_rule_narrow_store_misses_wider_ask(self):
+        """A stored catalog narrower than the asker's R cannot serve it:
+        announce misses and a wider re-upload replaces the entry."""
+        server = SolverServer(run_id="x")
+        tok = ("shared", "h", "f")
+        self._upload(server, tok, 4)
+        out = server.handle("has_catalog", {"schema": V,
+                                            "token": list(tok), "R": 6})
+        assert out["result"]["present"] is False
+        out = self._upload(server, tok, 6)
+        assert out["result"]["duplicate"] is False  # replaced, not kept
+        out = server.handle("has_catalog", {"schema": V,
+                                            "token": list(tok), "R": 6})
+        assert out["result"]["present"] is True
+
+    def test_lru_bound_evicts_oldest(self):
+        server = SolverServer(run_id="x", max_catalogs=2)
+        for i in range(3):
+            self._upload(server, ("shared", f"h{i}", "f"), 4)
+        assert len(server._catalogs) == 2
+        out = server.handle("has_catalog", {
+            "schema": V, "token": ["shared", "h0", "f"], "R": 4})
+        assert out["result"]["present"] is False  # oldest evicted
+
+    def test_report_mirrors_to_server_ledger(self):
+        server = SolverServer(run_id="x")
+        client = FederatedSolverClient(InMemoryTransport(server),
+                                       run_id="x", process="p0")
+        items = [
+            AdmissionVerdictEnvelope(schema=V, run_id="x", process="p0",
+                                     tenant="t0", action="admit",
+                                     reason=""),
+            IntegrityVerdictEnvelope(schema=V, run_id="x", process="p0",
+                                     tenant="t0", check="canary", ok=True,
+                                     detail=""),
+            WatchdogFindingEnvelope(schema=V, run_id="x", process="p0",
+                                    invariant="claim_leak",
+                                    severity="info", key="c1",
+                                    message="m"),
+        ]
+        assert client.report(items) == 3
+        assert client.report([]) == 0
+        assert len(server.reports) == 3
+        assert server.stats["reports"] == 3
+        assert isinstance(server.reports[0], AdmissionVerdictEnvelope)
+
+
+# ---------------------------------------------------------------------------
+# service-level federation (in-memory transport, full wire fidelity)
+# ---------------------------------------------------------------------------
+
+
+class TestFederatedService:
+    def test_bucket_crosses_wire_and_solves(self):
+        svc = mk_fed_service()
+        types = small_catalog()
+        pool = NodePool(name="default")
+        clients = [svc.register(f"t{i}", CatalogProvider(lambda: types))
+                   for i in range(3)]
+        tickets = [c.solve_async(mk_pods(4, f"p{i}"), pool)
+                   for i, c in enumerate(clients)]
+        svc.pump()
+        for t in tickets:
+            assert t.result().launches
+        assert svc.fed_stats["wire_buckets"] >= 1
+        assert svc.fed_stats["wire_tickets"] == 3
+        assert svc.fed.stats["uploads"] == 1
+        server = svc.fed.transport.server
+        assert server.stats["buckets"] >= 1
+        assert server.stats["catalog_uploads"] == 1
+
+    def test_catalog_uploads_once_per_cluster_not_per_process(self):
+        """Two services model two fleet processes against ONE server:
+        the second announces into a hit — tensors cross the wire once."""
+        server = SolverServer(run_id="fed-share")
+        s1 = mk_fed_service("p000", shared_server=server,
+                            run_id="fed-share")
+        s2 = mk_fed_service("p001", shared_server=server,
+                            run_id="fed-share")
+        types = small_catalog()
+        pool = NodePool(name="default")
+        for svc, name in ((s1, "a"), (s2, "b")):
+            c = svc.register(name, CatalogProvider(lambda: types))
+            t = c.solve_async(mk_pods(4, name), pool)
+            svc.pump()
+            assert t.result().launches
+        assert server.stats["catalog_uploads"] == 1
+        assert s1.fed.stats["uploads"] == 1
+        assert s2.fed.stats["uploads"] == 0
+        assert s2.fed.stats["announce_hits"] >= 1
+        assert s2.fed_stats["wire_buckets"] >= 1
+
+    def test_unknown_token_reannounces_and_retries_once(self):
+        """Server restart / LRU eviction is a protocol event, not a
+        degrade: the client forgets, re-announces, retries — and the
+        cooldown never arms."""
+        svc = mk_fed_service()
+        server = svc.fed.transport.server
+        types = small_catalog()
+        pool = NodePool(name="default")
+        c = svc.register("a", CatalogProvider(lambda: types))
+        t = c.solve_async(mk_pods(4, "w0"), pool)
+        svc.pump()
+        assert t.result().launches
+        server._catalogs.clear()  # simulate server restart
+        t2 = c.solve_async(mk_pods(4, "w1"), pool)
+        svc.pump()
+        assert t2.result().launches
+        assert svc.fed.stats["retried_unknown_token"] == 1
+        assert server.stats["unknown_token"] == 1
+        assert svc.fed.stats["uploads"] == 2  # re-shipped after restart
+        assert svc._fed_failures == 0 and svc._fed_cooldown == 0
+
+    def test_wire_failure_hostsolves_bucket_and_arms_cooldown(self):
+        """The degrade ladder rung 1+2: a dead wire mid-bucket
+        host-solves exactly that bucket's tickets and later buckets ride
+        the LOCAL device path while the cooldown drains."""
+        from karpenter_tpu.faults.injector import wire_fault_hook
+        from karpenter_tpu.metrics import FEDERATION_FALLBACKS
+        svc = mk_fed_service()
+        types = small_catalog()
+        pool = NodePool(name="default")
+        c = svc.register("a", CatalogProvider(lambda: types))
+        err0 = FEDERATION_FALLBACKS.value(reason="error")
+        cd0 = FEDERATION_FALLBACKS.value(reason="cooldown")
+        with wire_fault_hook(fail_methods=("solve_bucket",), after=0):
+            t = c.solve_async(mk_pods(4, "w0"), pool)
+            svc.pump()
+            assert t.result().launches  # host-solved through its facade
+        assert svc._fed_failures == 1
+        assert svc._fed_cooldown > 0
+        assert FEDERATION_FALLBACKS.value(reason="error") == err0 + 1
+        # wire healthy again, but the cooldown gates: local device path
+        t2 = c.solve_async(mk_pods(4, "w1"), pool)
+        svc.pump()
+        assert t2.result().launches
+        assert svc.fed_stats["local_buckets"] >= 1
+        assert svc.fed_stats["cooldown_skips"] >= 1
+        assert FEDERATION_FALLBACKS.value(reason="cooldown") == cd0 + 1
+
+    def test_schema_skew_raises_not_degrades(self):
+        """WireVersionError never enters the degrade ladder — a silently
+        local-only fleet is worse than a loud one."""
+        svc = mk_fed_service()
+        types = small_catalog()
+        pool = NodePool(name="default")
+        c = svc.register("a", CatalogProvider(lambda: types))
+
+        orig = svc.fed.transport.call
+
+        def skewed(method, payload):
+            if method == "solve_bucket":
+                raise WireVersionError(V, V + 1)
+            return orig(method, payload)
+
+        svc.fed.transport.call = skewed
+        c.solve_async(mk_pods(4, "w0"), pool)
+        with pytest.raises(WireVersionError):
+            svc.pump()
+        assert svc._fed_cooldown == 0  # ladder never armed
+
+
+# ---------------------------------------------------------------------------
+# cross-process determinism (the contract the judge enforces)
+# ---------------------------------------------------------------------------
+
+
+class TestCrossProcessDeterminism:
+    def _federated(self, seed, mesh=None, tenants=4):
+        def factory(clock, kw):
+            return build_federated_service(clock, run_id=f"fed-{seed}",
+                                           process="p000", mesh=mesh, **kw)
+        return FleetRunner("federation_smoke", tenants=tenants, seed=seed,
+                           backend="device", service_factory=factory).run()
+
+    def test_federated_digests_match_in_process(self):
+        """Same seed, same scenario: per-tenant end-state hashes AND
+        fault/load fingerprints byte-identical whether buckets cross the
+        wire or dispatch in-process."""
+        fed = self._federated(seed=5)
+        local = FleetRunner("federation_smoke", tenants=4, seed=5,
+                            backend="device").run()
+        assert fed.ok, fed.summary()
+        assert local.ok, local.summary()
+        assert fed.tenant_hashes == local.tenant_hashes
+        assert fed.tenant_fingerprints == local.tenant_fingerprints
+        assert fed.fleet_hash == local.fleet_hash
+        assert fed.fleet_fingerprint == local.fleet_fingerprint
+        assert fed.stats["federated_wire_buckets"] > 0
+        assert fed.stats["federated_wire_failures"] == 0
+        # the once-per-cluster contract, scenario-judged
+        assert fed.stats["catalog_uploads"] <= \
+            fed.stats["catalog_views_minted"]
+
+    def test_mesh_sharded_server_keeps_digest_parity(self):
+        """Laying the bucket's request axis across a batch mesh is an
+        EXECUTION detail: digests must match the in-process run even
+        when the server shards over all 8 virtual devices."""
+        from karpenter_tpu.parallel.mesh import make_batch_mesh
+        mesh = make_batch_mesh()
+        fed = self._federated(seed=3, mesh=mesh)
+        local = FleetRunner("federation_smoke", tenants=4, seed=3,
+                            backend="device").run()
+        assert fed.ok, fed.summary()
+        assert local.ok, local.summary()
+        assert fed.fleet_hash == local.fleet_hash
+        assert fed.fleet_fingerprint == local.fleet_fingerprint
+        assert fed.stats["federated_wire_buckets"] > 0
+
+    def test_mid_solve_server_crash_degrades_and_watchdog_pages_first(self):
+        """The mid-solve crash drill: the wire dies after two buckets;
+        every affected bucket host-solves (tenants still converge), the
+        cooldown arms, and the fleet watchdog's federation_degraded
+        invariant fires ONLINE — before the end-of-run verdict."""
+        from karpenter_tpu.faults.injector import wire_fault_hook
+        from karpenter_tpu.metrics import FEDERATION_FALLBACKS
+
+        def factory(clock, kw):
+            return build_federated_service(clock, run_id="fed-crash",
+                                           process="p000", **kw)
+        runner = FleetRunner("fleet_smoke", tenants=6, seed=0,
+                             backend="device", batch=True,
+                             service_factory=factory)
+        err0 = FEDERATION_FALLBACKS.value(reason="error")
+        with wire_fault_hook(fail_methods=("solve_bucket",), after=2):
+            report = runner.run()
+        assert report.converged, report.summary()
+        assert report.ok, report.summary()
+        svc = runner.service
+        assert svc._fed_failures >= 1
+        assert svc.fed_stats["wire_buckets"] == 2  # before the crash
+        # degraded buckets were SERVED: host-solve + local cooldown path
+        assert FEDERATION_FALLBACKS.value(reason="error") > err0
+        assert (svc.fed_stats["local_buckets"]
+                + svc.fed_stats["cooldown_skips"]) >= 1
+        assert report.stats["federated_wire_failures"] >= 1
+        # the watchdog saw it online, not just in the post-mortem
+        found = [f for f in runner.watchdog.findings
+                 if f.invariant == "federation_degraded"]
+        assert found, "federation_degraded never fired"
+        assert found[0].severity == "warning"
+        assert found[0].attrs["failures"] >= 1
+
+    @pytest.mark.slow
+    def test_noisy_neighbor_federated_digests_match_in_process(self):
+        """The acceptance scenario: t000's storm + ICE window + brownout
+        with every bucket crossing the wire — victim SLO verdicts and
+        all three digests identical to the in-process device run."""
+        def factory(clock, kw):
+            return build_federated_service(clock, run_id="fed-noisy",
+                                           process="p000", **kw)
+        fed = FleetRunner("fleet_noisy_neighbor", seed=0,
+                          backend="device", batch=True,
+                          service_factory=factory).run()
+        local = FleetRunner("fleet_noisy_neighbor", seed=0,
+                            backend="device", batch=True).run()
+        assert fed.ok, fed.summary()
+        assert local.ok, local.summary()
+        assert fed.fleet_hash == local.fleet_hash
+        assert fed.fleet_fingerprint == local.fleet_fingerprint
+        assert fed.stats["federated_wire_buckets"] > 0
+        assert fed.stats["federated_wire_failures"] == 0
+
+    def test_corruption_across_the_boundary_detected_before_commit(self):
+        """SDC on the server's staged request stack: the client's
+        integrity oracle (which never crossed the wire) detects the bad
+        rows at finish_solve, recovers through its own fallback solve,
+        and the fleet verdict stays green — 100% detection, zero commits
+        of corrupt placements."""
+        from karpenter_tpu.integrity import INTEGRITY
+        from karpenter_tpu.ops import solver as ops_solver
+
+        fired = {"n": 0}
+
+        def hook(target, buf):
+            # the server's batched stack is the only 3-D gbuf ([B,Gp,W]);
+            # fire exactly once so the blast radius is one bucket
+            if target != "gbuf" or fired["n"] or np.ndim(buf) != 3:
+                return buf
+            fired["n"] += 1
+            import jax.numpy as jnp
+            arr = np.array(buf)
+            rows = arr.reshape(-1, arr.shape[-1])
+            words = rows[0].view(np.uint32)
+            words ^= np.uint32(1 << 30)  # silent f32 bit-rot, row 0
+            return jnp.asarray(arr)
+
+        server = SolverServer(run_id="fed-sdc", use_resident=False)
+
+        def factory(clock, kw):
+            return build_federated_service(clock, run_id="fed-sdc",
+                                           process="p000",
+                                           shared_server=server, **kw)
+        runner = FleetRunner("federation_smoke", tenants=6, seed=1,
+                             backend="device", service_factory=factory)
+        det0 = INTEGRITY.detections()
+        ops_solver.set_corruption_hook(hook)
+        try:
+            report = runner.run()
+        finally:
+            ops_solver.set_corruption_hook(None)
+        assert fired["n"] == 1, "injection never reached the server"
+        assert report.ok, report.summary()
+        assert INTEGRITY.detections() > det0, (
+            "corrupt placements crossed the wire undetected")
+        recoveries = sum(
+            s.sim.solver.facade.stats.get("integrity_recoveries", 0)
+            for s in runner.shards)
+        assert recoveries >= 1
+
+
+# ---------------------------------------------------------------------------
+# schema-version negotiation
+# ---------------------------------------------------------------------------
+
+
+class TestWireVersioning:
+    def test_server_rejects_skew_before_parsing_body(self):
+        server = SolverServer(run_id="x")
+        out = server.handle("handshake", {"schema": V + 1,
+                                          "not_even": "valid"})
+        assert "error" in out
+        from karpenter_tpu.cloud.remote import decode_error
+        err = decode_error(out["error"])
+        assert isinstance(err, WireVersionError)
+
+    def test_client_handshake_checks_reply_schema(self):
+        class SkewedTransport:
+            def call(self, method, payload):
+                return {"wire_schema": V + 1, "run_id": "x"}
+
+        client = FederatedSolverClient(SkewedTransport(), run_id="x")
+        with pytest.raises(WireVersionError):
+            client.handshake()
+
+    def test_unknown_method_is_not_found(self):
+        server = SolverServer(run_id="x")
+        transport = InMemoryTransport(server)
+        with pytest.raises(NotFoundError):
+            transport.call("no_such_method", {"schema": V})
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport (real sockets, in-thread server)
+# ---------------------------------------------------------------------------
+
+
+class TestHTTPTransport:
+    def test_handshake_and_solve_over_http(self):
+        server = SolverServer(run_id="fed-http")
+        srv, port = serve_in_thread(server)
+        try:
+            svc = mk_fed_service(server_addr=f"127.0.0.1:{port}",
+                                 run_id="fed-http")
+            types = small_catalog()
+            pool = NodePool(name="default")
+            c = svc.register("a", CatalogProvider(lambda: types))
+            t = c.solve_async(mk_pods(4, "w0"), pool)
+            svc.pump()
+            assert t.result().launches
+            assert svc.fed_stats["wire_buckets"] == 1
+            assert server.stats["catalog_uploads"] == 1
+            assert server.stats["handshakes"] >= 1
+        finally:
+            srv.shutdown()
+
+    def test_http_rejects_skewed_header_with_426(self):
+        import http.client
+        server = SolverServer(run_id="x")
+        srv, port = serve_in_thread(server)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            try:
+                conn.request("POST", "/fed/handshake", body=b"{}",
+                             headers={"Content-Type": "application/json",
+                                      "X-Wire-Schema": str(V + 1)})
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+                assert resp.status == 426
+                assert body["error"]["type"] == "WireVersionError"
+            finally:
+                conn.close()
+            # the transport surfaces it as the typed exception
+            t = HTTPTransport("127.0.0.1", port)
+            with pytest.raises(WireVersionError):
+                # a healthy header but a skewed BODY also rejects
+                t.call("handshake", {"schema": V + 1})
+        finally:
+            srv.shutdown()
+
+    def test_handshake_refuses_versionless_server(self):
+        """A /healthz with no wire_schema field is a v0 peer: skew."""
+        import http.server
+        import threading
+
+        class Legacy(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = b'{"ok": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Legacy)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            t = HTTPTransport("127.0.0.1", srv.server_address[1])
+            with pytest.raises(WireVersionError):
+                t.handshake()
+        finally:
+            srv.shutdown()
+
+    @pytest.mark.slow
+    def test_subprocess_server_ready_protocol(self):
+        """The standalone entrypoint binds, prints READY <port>, and
+        serves the schema-stamped /healthz."""
+        import os
+        import subprocess
+        import sys
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "karpenter_tpu.federation.server",
+             "--port", "0", "--run-id", "fed-sub"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("READY "), line
+            port = int(line.split()[1])
+            assert HTTPTransport("127.0.0.1", port).handshake() == V
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

@@ -221,8 +221,10 @@ def test_mutant_reread_trips_in_solver(tmp_path):
     position 3 of _batched_fn()'s callable; a read planted after the
     dispatch (before the `del gstack`) must fail lint."""
     real = os.path.join(ROOT, "karpenter_tpu", "ops", "solver.py")
+    # anchor on the bare `del gstack` in _dispatch_onebuf-style code;
+    # dispatch_batch/dispatch_packed carry commented `del gstack` lines
     mutant = _mutate(
-        real, "del gstack",
+        real, "del gstack\n",
         "_stale = gstack", tmp_path, "solver_mutant.py", before=True)
     run = Engine(default_rules(), root=ROOT).lint_paths([mutant])
     hits = [f for f in run.findings if f.rule == "use-after-donate"]

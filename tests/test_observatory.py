@@ -82,6 +82,9 @@ class TestPhaseLedgerMapping:
         # feasibility oracle + canary + resident audit on every solve
         ("integrity.verify", {"backend": "device", "outcome": "ok"},
          "integrity"),
+        # federation plane (karpenter_tpu/federation/): serialized RPC
+        # latency between a fleet client process and the solver server
+        ("federation.wire", {"method": "solve_bucket"}, "wire"),
         ("reconcile:provisioner", {}, "reconcile_other"),
     ]
 

@@ -64,7 +64,7 @@ class TestArming:
             "trace_ring_overflow", "devicemem_leak",
             "resident_staleness", "overload_unbounded",
             "optimizer_divergence", "integrity_breach",
-            "recompute_runaway")
+            "recompute_runaway", "federation_degraded")
 
 
 class TestTrips:
@@ -258,6 +258,40 @@ class TestTrips:
         wd2 = Watchdog(svc2.clock, service=svc2).arm()
         wd2.tick(force=True)
         assert not _findings(wd2, "pipeline_stall")
+
+    def test_trip_federation_degraded(self):
+        """A wire failure arms the federated client's cooldown — the
+        watchdog pages while the fleet is silently running buckets on
+        the local path instead of over the wire."""
+        from karpenter_tpu.federation import build_federated_service
+        from karpenter_tpu.fleet.service import SolverService
+        clock = FakeClock()
+        svc = build_federated_service(clock, run_id="wd-test",
+                                      backend="host")
+        wd = Watchdog(clock, service=svc).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "federation_degraded")
+        # seed the exact state _dispatch_bucket leaves after a wire
+        # failure: failure count, cooldown window, last error
+        svc._fed_failures = 1
+        svc._fed_cooldown = 3
+        svc._fed_last_error = "ConnectionError: connection refused"
+        wd.tick(force=True)
+        found = _findings(wd, "federation_degraded")
+        assert found and found[0].severity == "warning"
+        assert found[0].key == "wire"
+        assert found[0].attrs["cooldown"] == 3
+        # recovery: the cooldown is spent and the wire probes clean —
+        # the edge clears so a later failure can page again
+        svc._fed_cooldown = 0
+        wd.tick(force=True)
+        assert ("federation_degraded", "wire") not in wd._active
+        # an in-process service exposes no federation_state and never
+        # evaluates the monitor
+        svc2 = SolverService(FakeClock(), backend="host")
+        wd2 = Watchdog(svc2.clock, service=svc2).arm()
+        wd2.tick(force=True)
+        assert not _findings(wd2, "federation_degraded")
 
     def test_trip_overload_unbounded(self):
         """Seeded overload with shedding DISABLED: the open-loop backlog
