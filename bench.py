@@ -1041,8 +1041,14 @@ def main() -> None:
     # and c16_recompute_coverage is the ≥99% attribution invariant over
     # the traced reconcile wall. c16_full_reconcile_p50_ms (forced-cold,
     # the recompute-everything ceiling) vs c16_warm_admit_floor_ms (the
-    # delta-served floor) brackets what zero-recompute is worth.
-    # *_redundant_frac keys are perf-gate-informational by name;
+    # delta-served floor) brackets what zero-recompute is worth. Since
+    # PR 19 the delta plane SPENDS the headroom this regime measured:
+    # warm reps run with the memos armed (c16_{stage}_served_frac is the
+    # serve rate, c16_{stage}_redundant_frac should collapse toward the
+    # audit cadence), cold reps force-cold the warm path AND invalidate
+    # the delta plane (reason="disarm") so the ceiling stays a true
+    # recompute-everything measurement. *_redundant_frac /
+    # *_served_frac keys are perf-gate-informational by name;
     # coverage gates higher-better.
     from karpenter_tpu.obs.recompute import COVERAGE_TARGET as _COV16
     from karpenter_tpu.obs.recompute import RECOMPUTE as _RC16
@@ -1099,6 +1105,11 @@ def main() -> None:
             live16 = live16[_churn16:] + fresh16
             if phase16 == "cold":
                 sim16.warmpath.force_cold("bench-c16")
+                # the cold ceiling must recompute EVERYTHING: drop every
+                # delta memo too, or a served solve would ride into the
+                # recompute-everything measurement
+                from karpenter_tpu.ops.delta import DELTA as _DELTA16
+                _DELTA16.invalidate((), reason="disarm")
             t0 = time.perf_counter()
             with TRACER.trace("reconcile.profile", config="c16_steady",
                               phase=phase16):
@@ -1120,7 +1131,12 @@ def main() -> None:
                      "a call site lost its RECOMPUTE.classify()")
         detail[f"c16_{st}_redundant_frac"] = round(
             row16["redundant_frac"], 4) if row16 else 0.0
+        detail[f"c16_{st}_served_frac"] = round(
+            row16.get("served_frac", 0.0), 4) if row16 else 0.0
     detail["c16_recompute_coverage"] = snap16["coverage"]
+    detail["c16_delta_saved_ms_est"] = round(
+        sum(r.get("saved_ms_est", 0.0)
+            for r in snap16["stages"].values()), 3)
     detail["c16_redundant_wall_ms"] = round(
         sum(r["ms"].get("redundant", 0.0)
             for r in snap16["stages"].values()), 3)

@@ -624,8 +624,25 @@ class DisruptionController:
                     frozenset(v.name for v, x in zip(views, exclude)
                               if x),
                     min(budget, 64))
-        if self._optimizer_noop.get(pool.name) == noop_key:
-            from ..obs.recompute import RECOMPUTE
+        from ..obs.recompute import RECOMPUTE, fingerprint
+        from ..ops.delta import DELTA
+        # armed, the verdict lives in the delta plane: same serve as the
+        # legacy dict, but policed — every audit_every-th serve is
+        # refused and the search runs fresh for a confirm/diverge
+        # verdict, and a diverged key (stored "fruitless", fresh pass
+        # consolidated) opens the never-wrong-twice cooldown
+        nfp = fingerprint(noop_key[0], tuple(sorted(noop_key[1])),
+                          noop_key[2])
+        dkey = ("disrupt", id(self), pool.name)
+        opt_audit = False
+        if DELTA.armed:
+            hit = DELTA.serve("optimizer", dkey, nfp)
+            if hit is not None:
+                if not hit[1]:
+                    RECOMPUTE.classify("optimizer", served=True)
+                    return False
+                opt_audit = True
+        elif self._optimizer_noop.get(pool.name) == noop_key:
             RECOMPUTE.classify("optimizer", served=True)
             return False
         use_device = self.solver.backend in ("device", "mesh")
@@ -658,6 +675,7 @@ class DisruptionController:
             return False
         if not plan.subsets:
             self._optimizer_noop[pool.name] = noop_key
+            self._delta_note_fruitless(dkey, nfp, opt_audit)
             return False
         vsp = (TRACER.span("optimizer.verify",
                            ranked=len(plan.subsets))
@@ -702,6 +720,15 @@ class DisruptionController:
                     self.stats["optimizer_consolidated"] = (
                         self.stats.get("optimizer_consolidated", 0) + 1)
                     self._optimizer_noop.pop(pool.name, None)
+                    if opt_audit:
+                        # the stored "fruitless" verdict was WRONG — the
+                        # audit pass consolidated. Never-wrong-twice.
+                        DELTA.diverge("optimizer", dkey)
+                    else:
+                        # executing moves the views: the memoized verdict
+                        # (keyed on the pre-execute occupancy) is moot
+                        DELTA.invalidate(("optimizer",) + dkey,
+                                         reason="epoch")
                     vsp.set(verified=verified, accepted=len(subset))
                     return True
                 vsp.set(verified=verified, accepted=0)
@@ -727,7 +754,23 @@ class DisruptionController:
                 self.stats.get("optimizer_errors", 0) + 1)
             return False
         self._optimizer_noop[pool.name] = noop_key
+        self._delta_note_fruitless(dkey, nfp, opt_audit)
         return False
+
+    def _delta_note_fruitless(self, dkey: tuple, nfp: int,
+                              audit: bool) -> None:
+        """Record a completed-but-fruitless optimizer pass in the delta
+        plane: a fresh audit pass that STILL found nothing confirms the
+        stored verdict (serve counter resets); a first-time verdict
+        stores it. Fault-aborted passes never reach here — nothing
+        proved the search fruitless, so nothing is memoized."""
+        from ..ops.delta import DELTA
+        if not DELTA.armed:
+            return
+        if audit:
+            DELTA.confirm("optimizer", dkey, nfp, check_fp=nfp)
+        else:
+            DELTA.store("optimizer", dkey, nfp, True, check_fp=nfp)
 
     def _multi_node_greedy(self, pool: NodePool,
                            candidates: List[NodeView], now: float,

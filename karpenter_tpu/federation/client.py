@@ -69,6 +69,10 @@ class FederatedSolverClient:
         self.transport = transport
         self.run_id = run_id
         self.process = process
+        # handshake-negotiated: True once the server advertised it
+        # decodes zlib'd pack_array payloads; stays False against old
+        # servers, and every send then rides uncompressed
+        self.compress = False
         self._announced: dict = {}   # token -> max resource width announced
         self.stats = {"solve_rpcs": 0, "catalog_rpcs": 0,
                       "announce_hits": 0, "announce_misses": 0,
@@ -90,6 +94,7 @@ class FederatedSolverClient:
         theirs = out.get("wire_schema", 0)
         if theirs != WIRE_SCHEMA_VERSION:
             raise WireVersionError(WIRE_SCHEMA_VERSION, theirs)
+        self.compress = bool(out.get("compress", False))
         return out
 
     # --- catalog token protocol -------------------------------------------
@@ -123,13 +128,14 @@ class FederatedSolverClient:
     def _upload_catalog(self, cat, R: int, token: tuple) -> None:
         from ..ops.encode import align_resources, align_zone_overhead
         zovh = align_zone_overhead(cat, R)
+        z = self.compress
         env = CatalogUploadEnvelope(
             schema=WIRE_SCHEMA_VERSION, run_id=self.run_id,
             process=self.process, token=token,
-            alloc=pack_array(align_resources(cat.allocatable, R)),
-            price=pack_array(np.asarray(cat.price)),
-            avail=pack_array(np.asarray(cat.available)),
-            ovh_z=pack_array(zovh) if zovh is not None else None,
+            alloc=pack_array(align_resources(cat.allocatable, R), compress=z),
+            price=pack_array(np.asarray(cat.price), compress=z),
+            avail=pack_array(np.asarray(cat.available), compress=z),
+            ovh_z=pack_array(zovh, compress=z) if zovh is not None else None,
             R=int(R))
         self.transport.call("put_catalog", encode_envelope(env))
         self.stats["uploads"] += 1
@@ -170,8 +176,10 @@ class FederatedSolverClient:
             schema=WIRE_SCHEMA_VERSION, run_id=self.run_id,
             process=self.process, token=token,
             shape_class=first.shape_class, Gp=int(Gp), B=len(reqs),
-            statics=dict(st), gbuf=pack_array(np.stack(gbufs)),
-            conf=pack_array(conf_np) if conf_np is not None else None,
+            statics=dict(st),
+            gbuf=pack_array(np.stack(gbufs), compress=self.compress),
+            conf=pack_array(conf_np, compress=self.compress)
+            if conf_np is not None else None,
             tenants=tuple(getattr(r, "tenant", "") for r in reqs))
         payload = encode_envelope(env)
         self.stats["solve_rpcs"] += 1
@@ -289,7 +297,8 @@ class FederatedSolverService(SolverService):
         with the parent's containment (probe already ran above)."""
         from ..ops import solver as ops_solver
         try:
-            ifb = ops_solver.dispatch_batch(reqs)
+            ifb = ops_solver.dispatch_batch(
+                reqs, resident_key=self._bucket_resident_key(entries))
         except BaseException:  # noqa: BLE001 — degrade only this batch
             for e in entries:
                 self._run_serial(e, fault_fallback=True)

@@ -25,11 +25,23 @@ and base64 of the C-contiguous bytes. Base64 over JSON is ~4/3 the
 tensor size; tools/federation_report.py reports the measured
 wire-bytes-to-tensor-bytes ratio so the overhead stays visible rather
 than folklore.
+
+Compression is a pack_array-internal affair, not a schema change: a
+sender that learned (via the handshake's ``compress`` capability) that
+its peer decodes zlib may pass ``compress=True``, which adds ``"z": 1``
+to the dict and base64s the DEFLATE stream instead of the raw bytes.
+`unpack_array` handles both forms unconditionally, so capability skew
+is one-directional and safe: an old server simply never advertises,
+an old client simply never sets the flag, and either way the bytes
+decode. Solver gbufs are mostly padding zeros, so the win is large;
+payloads the codec cannot shrink (or under the 512-byte floor) stay
+uncompressed even when asked.
 """
 
 from __future__ import annotations
 
 import base64
+import zlib
 from dataclasses import dataclass, fields, is_dataclass
 from typing import Any, Optional, Tuple
 
@@ -37,24 +49,52 @@ import numpy as np
 
 from ..cloud import remote as wire
 
+# tensors smaller than this never compress: the DEFLATE header + the
+# CPU spent are not worth shaving a few wire bytes off a row vector
+COMPRESS_MIN_BYTES = 512
+
 # ---------------------------------------------------------------------------
 # numpy <-> base64
 
 
-def pack_array(arr) -> dict:
-    """Encode an ndarray as a JSON-safe dict (dtype, shape, base64 bytes)."""
+def pack_array(arr, compress: bool = False) -> dict:
+    """Encode an ndarray as a JSON-safe dict (dtype, shape, base64 bytes).
+
+    ``compress=True`` (only pass it when the peer's handshake advertised
+    the ``compress`` capability) zlib-deflates the raw bytes first and
+    marks the dict with ``"z": 1`` — skipped when the tensor is tiny or
+    the stream would not actually shrink."""
     a = np.ascontiguousarray(arr)
-    return {
+    out = {
         "dtype": str(a.dtype),
         "shape": tuple(int(d) for d in a.shape),
-        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
     }
+    raw = a.tobytes()
+    if compress and len(raw) >= COMPRESS_MIN_BYTES:
+        z = zlib.compress(raw, 1)
+        if len(z) < len(raw):
+            out["z"] = 1
+            out["b64"] = base64.b64encode(z).decode("ascii")
+            return out
+    out["b64"] = base64.b64encode(raw).decode("ascii")
+    return out
 
 
 def unpack_array(obj: dict) -> np.ndarray:
     raw = base64.b64decode(obj["b64"])
+    if obj.get("z"):
+        raw = zlib.decompress(raw)
     a = np.frombuffer(raw, dtype=np.dtype(obj["dtype"]))
     return a.reshape(tuple(obj["shape"])).copy()
+
+
+def packed_wire_bytes(obj: Optional[dict]) -> int:
+    """Actual base64 payload size of a pack_array dict as it rides the
+    wire — compression-aware, unlike `tensor_bytes` (the logical
+    numerator vs denominator of the compression ratio)."""
+    if not obj:
+        return 0
+    return len(obj.get("b64", ""))
 
 
 def tensor_bytes(obj: Optional[dict]) -> int:

@@ -114,7 +114,11 @@ class SolverServer:
         self.stats["handshakes"] += 1
         return {"wire_schema": WIRE_SCHEMA_VERSION, "run_id": self.run_id,
                 "mesh_devices": int(self.mesh.size) if self.mesh else 1,
-                "resident": bool(self.use_resident)}
+                "resident": bool(self.use_resident),
+                # capability, not schema: this server decodes zlib'd
+                # pack_array payloads ("z": 1). Old clients ignore the
+                # key and keep sending uncompressed — which still decodes
+                "compress": True}
 
     def _rpc_has_catalog(self, payload: dict) -> dict:
         """Token announce. `R` is the client's resource width: the same
@@ -199,9 +203,14 @@ class SolverServer:
         self.stats["padded_rows"] += int(rows.shape[0])
         self.stats["max_bucket_rows"] = max(self.stats["max_bucket_rows"],
                                             int(rows.shape[0]))
+        # echo the client's compression choice: a request whose gbuf
+        # arrived zlib'd proves the peer decodes it, so the reply rows
+        # may compress too; an uncompressed request gets uncompressed
+        # rows (old clients never see a "z" payload)
+        zcap = bool(isinstance(env.gbuf, dict) and env.gbuf.get("z"))
         return encode_envelope(SolveBucketResult(
             schema=WIRE_SCHEMA_VERSION, run_id=env.run_id,
-            rows=pack_array(rows), span_s=span_s,
+            rows=pack_array(rows, compress=zcap), span_s=span_s,
             padded=int(rows.shape[0])))
 
     def _rpc_report(self, payload: dict) -> dict:

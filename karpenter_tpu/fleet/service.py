@@ -445,6 +445,13 @@ class SolverService:
         # class co-batching effectiveness counters
         self._inflight_since: Optional[float] = None
         self.class_stats: Dict[str, Dict[str, int]] = {}
+        # stable batch-composition contract (ops/delta.py era, the open
+        # PR 11 follow-up): last pump's bucket membership per batch
+        # signature. A bucket whose membership repeats keys a RESIDENT
+        # stacked gbuf (digest-diffed per-row scatter — only changed
+        # rows cross the tunnel); first-seen/changed memberships keep
+        # the donated full-stack upload path
+        self._bucket_members: Dict[tuple, tuple] = {}
         # /debug/fleet on both exposition servers: the live per-tenant
         # queue/throttle/starvation view (last-built service wins). The
         # route table holds the service by WEAKREF — the uniform debug-
@@ -773,6 +780,26 @@ class SolverService:
                 if sig in cob:
                     cs["cobatched_pumps"] += 1
 
+    def _bucket_resident_key(self, entries: List[dict]) -> Optional[tuple]:
+        """Stable batch-composition contract: a bucket whose (tenant,
+        facade-view) membership is IDENTICAL to the previous pump's
+        bucket for the same batch signature gets a device-resident
+        stacked gbuf — the solver's digest-diffed scatter then ships
+        only the rows that changed, instead of donating a full [B,Gp,W]
+        upload per pump. First-seen and changed memberships return None
+        (the donated full-stack path, which stays the graftlint donate
+        rule's anchor)."""
+        from ..obs.recompute import fingerprint
+        sig = entries[0]["batchable"].signature
+        members = tuple((e["ticket"].tenant, e["batchable"].meter_key)
+                        for e in entries)
+        stable = self._bucket_members.get(sig) == members
+        self._bucket_members[sig] = members
+        if not stable:
+            return None
+        return ("fleet", id(self), entries[0]["batchable"].shape_class,
+                fingerprint(members))
+
     def _dispatch_bucket(self, entries: List[dict]):
         """One bucket -> one async device call. A device fault here
         aborts the WHOLE call, so exactly the tickets in this batch
@@ -793,7 +820,8 @@ class SolverService:
                 with tenant_scope(tenant):
                     ops_solver.probe_dispatch_fault("device")
             ifb = ops_solver.dispatch_batch(
-                [e["batchable"] for e in entries])
+                [e["batchable"] for e in entries],
+                resident_key=self._bucket_resident_key(entries))
         except BaseException:  # noqa: BLE001 — degrade only this batch
             for e in entries:
                 self._run_serial(e, fault_fallback=True)

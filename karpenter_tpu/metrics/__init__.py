@@ -611,5 +611,29 @@ RECOMPUTE_UNATTRIBUTED_MS = REGISTRY.counter(
     "invariant's gap meter: growth means a code path does stage work "
     "without registering its input fingerprint — each gap also lands a "
     "recompute.unattributed marker in the flight recorder", ("stage",))
+DELTA_MEMO = REGISTRY.counter(
+    "karpenter_tpu_delta_memo_total",
+    "Delta-plane memo protocol events (ops/delta.py), by memo stage "
+    "(solve, affinity, spread, optimizer) and event: 'served' = an "
+    "unchanged-input pass answered from the memo (the matching work "
+    "unit meters recompute_work_total{outcome='delta_served'}), "
+    "'stored' = a freshly computed output memoized, 'audit' = a serve "
+    "refused because the audit cadence expired (the caller recomputes "
+    "fresh), 'confirmed' = that fresh recompute matched the stored "
+    "output, byte-for-byte by content fingerprint. A confirmed/audit "
+    "ratio below 1.0 means divergences — see "
+    "delta_invalidations_total", ("stage", "event"))
+DELTA_INVALIDATIONS = REGISTRY.counter(
+    "karpenter_tpu_delta_invalidations_total",
+    "Delta-memo entries dropped, by stage and ladder reason: "
+    "'divergence' = an audit recompute disagreed with the stored "
+    "output (opens the never-wrong-twice cooldown for that key), "
+    "'epoch' = the key re-stored under a new input fingerprint (the "
+    "world moved), 'quarantine' = an integrity violation quarantined "
+    "the owning facade's device path and its memos with it, "
+    "'capacity' = LRU bound, 'disarm' = explicit force-cold. "
+    "Divergences on a healthy run mean a memo key is too weak — the "
+    "audit cadence caught it, which is the design, but the rate "
+    "should be zero", ("stage", "reason"))
 
 __all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram"]

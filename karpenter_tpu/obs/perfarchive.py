@@ -84,9 +84,14 @@ _HIGHER_BETTER = re.compile(
 # informational; the gated integrity quantities are
 # `c3_integrity_overhead_frac` (lower-better: the oracle's share of
 # solve wall) and `c15_sdc_detection_rate` (higher-better: injected
-# corruptions caught).
+# corruptions caught). `*_served_frac` (the c16 delta-plane serve rate)
+# is informational for the same reason as the redundancy fractions:
+# how much of a regime's work is servable is a workload-mix property —
+# the gated delta quantity is the reconcile latency the serving buys
+# (`c16_full_reconcile_p50_ms`, lower-better via the `_ms` rule).
 _NEVER_GATES = re.compile(
-    r"(_redundant_frac|_rows_frac|_shed_frac|integrity_\w*_total)$")
+    r"(_redundant_frac|_rows_frac|_shed_frac|_served_frac|"
+    r"integrity_\w*_total)$")
 
 
 def metric_direction(key: str) -> Optional[str]:

@@ -472,11 +472,23 @@ class RecomputeLedger:
                 row = row or {o: 0 for o in OUTCOMES}
                 total = sum(row.values())
                 wall, unattr = self._stage_wall.get(st, (0.0, 0.0))
+                # delta-serving savings estimate: each served unit is
+                # priced at the stage's mean PAID (fresh + redundant)
+                # per-unit wall — the work the delta plane did not redo
+                served = row.get("delta_served", 0)
+                paid_units = row.get("fresh", 0) + row.get("redundant", 0)
+                paid_ms = (self._ms.get((st, "fresh"), 0.0)
+                           + self._ms.get((st, "redundant"), 0.0))
+                saved_ms = (served * paid_ms / paid_units
+                            if paid_units else 0.0)
                 stages[st] = {
                     "units": dict(row),
                     "redundant_frac": round(
                         row.get("redundant", 0) / total, 4) if total
                     else 0.0,
+                    "served_frac": round(served / total, 4) if total
+                    else 0.0,
+                    "saved_ms_est": round(saved_ms, 3),
                     "ms": {o: round(self._ms.get((st, o), 0.0), 3)
                            for o in OUTCOMES},
                     "bytes": {o: int(self._bytes.get((st, o), 0))
@@ -519,8 +531,10 @@ class RecomputeLedger:
 
 def format_report(snapshot: dict) -> str:
     """The `make recompute-report` table: per stage, the outcome unit
-    split, the redundant fraction, and the redundant wall — the
-    headroom table the zero-recompute builder spends."""
+    split, the redundant fraction, the redundant wall (the headroom the
+    delta plane spends), and the estimated wall the delta-served units
+    did NOT pay (served units priced at the stage's mean paid
+    per-unit cost)."""
     out: List[str] = []
     stages = snapshot.get("stages", {})
     if not stages:
@@ -529,9 +543,9 @@ def format_report(snapshot: dict) -> str:
     out.append("recompute observatory — who redoes identical work")
     out.append(f"  {'stage':<10} {'units':>9} {'fresh':>9} "
                f"{'redundant':>9} {'served':>9} {'red%':>7} "
-               f"{'red ms':>10} {'gap ms':>9}")
-    out.append("  " + "-" * 78)
-    tot_red_ms = tot_gap = 0.0
+               f"{'red ms':>10} {'saved ms':>10} {'gap ms':>9}")
+    out.append("  " + "-" * 88)
+    tot_red_ms = tot_gap = tot_saved = 0.0
     for st in snapshot.get("taxonomy", sorted(stages)):
         row = stages.get(st)
         if row is None:
@@ -540,19 +554,22 @@ def format_report(snapshot: dict) -> str:
         u = row["units"]
         total = sum(u.values())
         red_ms = row["ms"].get("redundant", 0.0)
+        saved_ms = row.get("saved_ms_est", 0.0)
         tot_red_ms += red_ms
+        tot_saved += saved_ms
         tot_gap += row["unattributed_ms"]
         out.append(
             f"  {st:<10} {total:>9,} {u.get('fresh', 0):>9,} "
             f"{u.get('redundant', 0):>9,} "
             f"{u.get('delta_served', 0):>9,} "
             f"{100.0 * row['redundant_frac']:>6.1f}% "
-            f"{red_ms:>10.3f} {row['unattributed_ms']:>9.3f}")
-    out.append("  " + "-" * 78)
+            f"{red_ms:>10.3f} {saved_ms:>10.3f} "
+            f"{row['unattributed_ms']:>9.3f}")
+    out.append("  " + "-" * 88)
     out.append(f"  coverage {snapshot.get('coverage', 1.0):.4f} "
                f"(target {COVERAGE_TARGET:g}) | redundant wall "
-               f"{tot_red_ms:.3f}ms — the measured headroom | "
-               f"unattributed {tot_gap:.3f}ms")
+               f"{tot_red_ms:.3f}ms — the measured headroom | served "
+               f"saved ~{tot_saved:.3f}ms | unattributed {tot_gap:.3f}ms")
     if snapshot.get("errors"):
         out.append(f"  WARNING: {snapshot['errors']} trace(s) failed to "
                    "ingest")

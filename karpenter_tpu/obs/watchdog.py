@@ -51,6 +51,13 @@ INCREMENTAL monitors evaluated on a sim-clock cadence:
   cannot hand them out (upload() re-keys on token mismatch), so a
   persistent stale entry is held HBM plus a latent-bug signal — the
   refresh that should have re-seeded it never ran.
+- **delta_staleness** — a delta-plane memo entry (ops/delta.py) that
+  reached its audit cadence and never received a fresh confirm,
+  lingering past a sim grace: `serve()` already refuses it, so a
+  persistent stale entry means the owning loop stopped closing its
+  serve-and-verify audit contract — the recompute that should have
+  confirmed (or diverged) the shortcut never ran. Pre-arm residue is
+  excluded and the window is ClockJump-absorbed like every other stamp.
 - **optimizer_divergence** — the global disruption optimizer's exact
   verification keeps REJECTING the relaxation ranking's picks: a
   tenant's consecutive-reject streak (optimizer/stats.py, reset by any
@@ -110,6 +117,7 @@ INVARIANTS: Tuple[str, ...] = (
     "trace_ring_overflow",
     "devicemem_leak",
     "resident_staleness",
+    "delta_staleness",
     "overload_unbounded",
     "optimizer_divergence",
     "integrity_breach",
@@ -181,6 +189,11 @@ class Watchdog:
     #                           (generous: a healthy view refreshes at its
     #                           next solve — only a view that NEVER
     #                           refreshes after an epoch bump should fire)
+    DELTA_GRACE = 900.0       # audit-due delta-memo age before a finding
+    #                           (generous for the same reason: a healthy
+    #                           loop closes the audit at its very next
+    #                           pass — only a key whose owner stopped
+    #                           confirming should fire)
     OVERLOAD_GRACE = 45.0     # sim seconds a tenant's waiting depth may
     #                           sit above the admission budget before a
     #                           still-growing backlog counts as unbounded
@@ -277,6 +290,10 @@ class Watchdog:
         # clock); stale at arm = another run's residue, excluded
         self._resident: Dict[tuple, float] = {}
         self._resident_base: frozenset = frozenset()
+        # delta-memo staleness: internal memo key -> first-seen
+        # (watchdog clock); audit-due at arm = another run's residue
+        self._delta_stale: Dict[tuple, float] = {}
+        self._delta_base: frozenset = frozenset()
         # overload excursions: tenant -> (first-seen-over-budget stamp on
         # the watchdog clock, depth at first sight) — jump-absorbed like
         # every other window
@@ -321,6 +338,9 @@ class Watchdog:
                                       for o in DEVICEMEM.orphans())
         from ..ops.resident import RESIDENT
         self._resident_base = frozenset(s["key"] for s in RESIDENT.stale())
+        from ..ops.delta import DELTA
+        self._delta_base = frozenset((st,) + tuple(k)
+                                     for st, k, _ in DELTA.stale())
         from ..optimizer.stats import OPTIMIZER
         self._optimizer_base = dict(OPTIMIZER.reject_streaks())
         from ..integrity import INTEGRITY
@@ -377,6 +397,7 @@ class Watchdog:
         self._check_meters(now, fired)
         self._check_devicemem(now, fired)
         self._check_resident(now, fired)
+        self._check_delta(now, fired)
         self._check_overload(now, fired)
         self._check_optimizer(now, fired)
         self._check_integrity(now, fired)
@@ -398,6 +419,8 @@ class Watchdog:
         self._drift = {k: v + shift for k, v in self._drift.items()}
         self._devmem = {k: v + shift for k, v in self._devmem.items()}
         self._resident = {k: v + shift for k, v in self._resident.items()}
+        self._delta_stale = {k: v + shift
+                             for k, v in self._delta_stale.items()}
         self._overload = {k: (t + shift, d)
                           for k, (t, d) in self._overload.items()}
         self._recompute = {k: (t + shift, f)
@@ -760,6 +783,40 @@ class Watchdog:
                 kstr = "/".join(str(t) for t in key)
                 self._clear("resident_staleness", f"view/{kstr}")
 
+    def _check_delta(self, now: float, fired: List[Finding]) -> None:
+        """Delta-plane memo entries stuck at audit-due
+        (ops/delta.DELTA.stale()) — serve() refuses them, so the entry
+        costs nothing to correctness, but a lingering one means its
+        owning loop stopped running the fresh confirm/diverge pass the
+        serve-and-verify contract promises. Aged on the watchdog's
+        observation clock, jump-absorbed, pre-arm residue excluded. A
+        healthy key clears itself: the owner's next pass confirms (the
+        counter resets) or diverges (the entry drops)."""
+        from ..ops.delta import DELTA
+        seen: set = set()
+        for stage, key, since in DELTA.stale():
+            ik = (stage,) + tuple(key)
+            if ik in self._delta_base:
+                continue
+            seen.add(ik)
+            first = self._delta_stale.setdefault(ik, now)
+            age = now - first
+            if age < self.DELTA_GRACE:
+                continue
+            kstr = "/".join(str(t) for t in ik)
+            self._fire(fired, "delta_staleness", "warning",
+                       f"memo/{kstr}",
+                       f"delta memo {kstr} audit-due for {since} serves "
+                       f"and unconfirmed for {age:.0f}s "
+                       f"(grace {self.DELTA_GRACE:g}s)", now,
+                       stage=stage, since_confirm=int(since),
+                       age_s=round(age, 1))
+        for ik in list(self._delta_stale):
+            if ik not in seen:   # confirmed, diverged, or evicted
+                self._delta_stale.pop(ik, None)
+                kstr = "/".join(str(t) for t in ik)
+                self._clear("delta_staleness", f"memo/{kstr}")
+
     def _check_overload(self, now: float, fired: List[Finding]) -> None:
         """An open-loop tenant's waiting-pod depth above the admission
         budget and still not shrinking (or its oldest parked arrival
@@ -1011,6 +1068,7 @@ class Watchdog:
                            "pipeline_s": self.pipeline_grace,
                            "devicemem_s": self.DEVICEMEM_GRACE,
                            "resident_s": self.RESIDENT_GRACE,
+                           "delta_s": self.DELTA_GRACE,
                            "overload_s": self.overload_grace,
                            "optimizer_streak": self.OPTIMIZER_STREAK,
                            "recompute_s": self.RECOMPUTE_GRACE,

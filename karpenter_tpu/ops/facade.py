@@ -307,6 +307,15 @@ class PreparedSolve:
     daemonsets: Optional[list] = None
     backend: str = ""
     t0: float = 0.0
+    # delta-plane bookkeeping (ops/delta.py): the solve-memo key/fp
+    # this prepared solve settles in finish_solve — store on a miss,
+    # confirm/diverge on an audit-due recompute. delta_served marks a
+    # result already answered FROM the memo (finish must not re-store).
+    delta_key: Optional[tuple] = None
+    delta_fp: int = 0
+    delta_audit: bool = False
+    delta_check: int = 0
+    delta_served: bool = False
 
 
 def _pod_key(p: Pod) -> str:
@@ -728,19 +737,17 @@ class Solver:
         from ..obs.recompute import RECOMPUTE, encoded_fingerprint, fingerprint
         occ_sig = tuple(sorted((zone, len(placed))
                                for zone, placed in occupancy))
-        RECOMPUTE.classify("affinity",
-                           fingerprint(encoded_fingerprint(enc), occ_sig))
         sp = (TRACER.span("solve.spread") if TRACER.enabled else NOOP_SPAN)
         with sp:
             asp = (TRACER.span("encode.affinity") if TRACER.enabled
                    else NOOP_SPAN)
             with asp:
-                enc = apply_zone_affinity(enc, cat, occupancy)
-            enc = split_spread_groups(
-                enc, cat, self._spread_constraints(enc, cat, occupancy))
+                enc = self._delta_affinity(enc, cat, occupancy, occ_sig,
+                                           nodepool.name)
+            enc = self._delta_spread(enc, cat, occupancy, occ_sig,
+                                     nodepool.name)
             sp.set(groups=int(enc.G))
         post_fp = encoded_fingerprint(enc)
-        RECOMPUTE.classify("spread", fingerprint(post_fp, occ_sig, "spread"))
         if enc.G == 0:
             out = self._merge_plan(SolveOutput([], {}, dropped), plan,
                                    cat, nodepool)
@@ -758,18 +765,32 @@ class Solver:
             # the C++ FFD takes a flat [T, R] allocatable; zone-varying
             # reservations need the masked-max path — host oracle instead
             backend = "host"
-        # the gbuf identity a solve dispatch is about to grind: an
-        # unchanged fingerprint re-solved from scratch is the redundant
-        # solve work a warm admission / residency layer should serve
-        RECOMPUTE.classify("solve", fingerprint(
-            post_fp, self._last_cat_key, backend, int(enc.counts.sum())))
-        return PreparedSolve(
+        prep = PreparedSolve(
             cat=cat, cat_key=self._last_cat_key, enc=enc,
             existing=existing, plan=plan, dropped=dropped,
             blocks_gated=blocks_gated, ds_fp=ds_fp, all_pods=all_pods,
             nodepool=nodepool, node_class=node_class,
             spread_occupancy=spread_occupancy, daemonsets=daemonsets,
             backend=backend, t0=t0)
+        # delta plane: an unchanged-input solve serves the memoized
+        # result (still oracle-verified in finish_solve) instead of
+        # dispatching; miss/audit marks the prep so finish_solve
+        # settles the memo protocol
+        from .delta import existing_context_fingerprint
+        ex_fp = existing_context_fingerprint(existing)
+        served = self._delta_serve_solve(prep, post_fp, ex_fp)
+        if served is not None:
+            return served
+        # the FULL solve input identity a dispatch is about to grind —
+        # encoded content AND the standing-fleet context (two what-ifs
+        # over the same pods against different hypothetical clusters
+        # are different solves, not redundancy): an unchanged
+        # fingerprint re-solved from scratch is the redundant work the
+        # delta memo should have served
+        RECOMPUTE.classify("solve", fingerprint(
+            post_fp, self._last_cat_key, backend, int(enc.counts.sum()),
+            ex_fp))
+        return prep
 
     def _device_dcat(self, prep: PreparedSolve, mesh):
         """Device-resident catalog tensors for a prepared solve — the ONE
@@ -832,6 +853,185 @@ class Solver:
         chaos/restart machinery. Returns the entries dropped."""
         from .resident import RESIDENT
         return RESIDENT.invalidate(("facade", id(self)), reason=reason)
+
+    # --- delta plane (ops/delta.py): serve-and-verify memos ----------------
+    # The four prepare-time stages the c16 regime measured as >84%
+    # redundant serve their prior outputs when the input fingerprints
+    # are unchanged. Every shortcut is policed: served solves still run
+    # the integrity oracle (finish_solve), and the plane's audit
+    # cadence forces a fresh recompute with a confirm/diverge verdict
+    # (divergence invalidates + opens the never-wrong-twice cooldown).
+
+    def _delta_solve_key(self, prep: PreparedSolve, ex_fp: int) -> tuple:
+        # the existing-context fingerprint is part of the KEY, not the
+        # validation fingerprint: one reconcile runs many concurrent
+        # solves against DIFFERENT hypothetical cluster contexts (the
+        # disruption controller's what-ifs), and a single key would make
+        # them evict each other every pass. Distinct contexts memoize
+        # side by side; pod-content drift within one context re-stores
+        # under a new fp (the metered epoch)
+        return ("facade", id(self),
+                prep.cat_key[0] if prep.cat_key else None,
+                prep.nodepool.name if prep.nodepool is not None else None,
+                prep.blocks_gated, prep.ds_fp, ex_fp)
+
+    def _delta_serve_solve(self, prep: PreparedSolve, post_fp: int,
+                           ex_fp: int) -> Optional[PreparedSolve]:
+        """Solve-memo serve half. None = not served (miss, audit due,
+        ineligible) — the caller dispatches normally and finish_solve
+        settles the memo via the prep's delta_* fields. A clean hit
+        decodes the memoized SolveResult against the CURRENT prep
+        (fresh pod identities) through the full finish_solve pipeline —
+        the integrity oracle validates every served result."""
+        from .delta import (DELTA, copy_solve_result,
+                            solve_memo_fingerprint,
+                            solve_result_fingerprint)
+        # colocation plans thread planner state through finish_solve
+        # the memo cannot key — they always recompute. Existing-node
+        # solves (full reconciles, disruption what-ifs — the bulk of
+        # the c16 headroom) ARE served: attach_existing_context ran
+        # before this point, so the prepared VirtualNodes carry the
+        # full solver-visible standing-fleet state and the context
+        # fingerprint below folds it into the memo key
+        if (not DELTA.armed or prep.plan is not None
+                or self.profile_dir):
+            return None
+        key = self._delta_solve_key(prep, ex_fp)
+        fp = solve_memo_fingerprint(prep.enc, prep.cat_key, prep.backend,
+                                    prep.blocks_gated, prep.ds_fp)
+        hit = DELTA.serve("solve", key, fp)
+        if hit is None:
+            prep.delta_key, prep.delta_fp = key, fp
+            return None
+        (result, backend), audit_due = hit
+        if audit_due:
+            prep.delta_key, prep.delta_fp = key, fp
+            prep.delta_audit = True
+            prep.delta_check = solve_result_fingerprint(result)
+            return None
+        from ..obs.recompute import RECOMPUTE
+        RECOMPUTE.classify("solve", served=True)
+        prep.delta_served = True
+        out = self.finish_solve(prep, copy_solve_result(result), backend)
+        return PreparedSolve(output=out)
+
+    def _delta_record_solve(self, prep: PreparedSolve,
+                            result: SolveResult, backend: str) -> None:
+        """Settle the solve memo for a freshly computed (and already
+        integrity-verified) result: store on a miss, confirm/diverge on
+        an audit-due recompute."""
+        if prep.delta_key is None or prep.delta_served:
+            return
+        from .delta import (DELTA, copy_solve_result,
+                            solve_result_fingerprint)
+        check = solve_result_fingerprint(result)
+        if prep.delta_audit:
+            if check == prep.delta_check:
+                DELTA.confirm("solve", prep.delta_key, prep.delta_fp,
+                              value=(copy_solve_result(result), backend),
+                              check_fp=check)
+            else:
+                DELTA.diverge("solve", prep.delta_key)
+            return
+        DELTA.store("solve", prep.delta_key, prep.delta_fp,
+                    (copy_solve_result(result), backend), check_fp=check)
+
+    def _delta_affinity(self, enc: EncodedPods, cat: CatalogTensors,
+                        occupancy, occ_sig: tuple,
+                        pool: str) -> EncodedPods:
+        """Zone-affinity pre-pass through the delta memo: an unchanged
+        (enc content, occupancy signature, zones) pass REPLAYS the
+        memoized transformation descriptor against the CURRENT enc —
+        pod identities stay fresh while the O(occupancy) selector
+        matching and cluster/union-find work is served."""
+        from ..obs.recompute import (RECOMPUTE, encoded_fingerprint,
+                                     fingerprint)
+        from .affinity import descriptor_fingerprint, replay_zone_affinity
+        from .delta import DELTA, group_terms_fingerprint
+        led_fp = fingerprint(encoded_fingerprint(enc), occ_sig)
+        if not DELTA.armed:
+            RECOMPUTE.classify("affinity", led_fp)
+            return apply_zone_affinity(enc, cat, occupancy)
+        # the occupancy signature is zone+count only — the group-terms
+        # digest carries the selector semantics, and the audit cadence
+        # polices what neither catches. The content fingerprint is part
+        # of the KEY: one reconcile's what-if solves run this pass over
+        # many (enc, occupancy) variants, and a per-(facade, pool)
+        # entry would thrash instead of serving the repeats
+        mfp = fingerprint(led_fp, tuple(cat.zones))
+        key = ("facade", id(self), pool, group_terms_fingerprint(enc),
+               mfp)
+        hit = DELTA.serve("affinity", key, mfp)
+        if hit is not None and not hit[1]:
+            out = replay_zone_affinity(enc, cat, hit[0])
+            if out is not None:
+                RECOMPUTE.classify("affinity", served=True)
+                return out
+            # the descriptor no longer fits the enc it was keyed to —
+            # a memo-key defect: treat exactly like an audit divergence
+            DELTA.diverge("affinity", key)
+            hit = None
+        capture: dict = {}
+        out = apply_zone_affinity(enc, cat, occupancy, capture=capture)
+        RECOMPUTE.classify("affinity", led_fp)
+        cfp = descriptor_fingerprint(capture)
+        if hit is not None:  # audit-due: judge the stored descriptor
+            if cfp == descriptor_fingerprint(hit[0]):
+                DELTA.confirm("affinity", key, mfp, value=capture,
+                              check_fp=cfp)
+            else:
+                DELTA.diverge("affinity", key)
+        else:
+            DELTA.store("affinity", key, mfp, capture, check_fp=cfp)
+        return out
+
+    def _delta_spread(self, enc: EncodedPods, cat: CatalogTensors,
+                      occupancy, occ_sig: tuple, pool: str) -> EncodedPods:
+        """Topology-spread pass through the delta memo: the memo serves
+        the O(cluster pods) selector-counting half (_spread_constraints);
+        the cheap structural split always runs against the current enc."""
+        from ..obs.recompute import (RECOMPUTE, encoded_fingerprint,
+                                     fingerprint)
+        from .delta import (DELTA, copy_spread_constraints,
+                            group_terms_fingerprint,
+                            spread_constraints_fingerprint)
+
+        def _classify_fresh(out_enc):
+            RECOMPUTE.classify("spread", fingerprint(
+                encoded_fingerprint(out_enc), occ_sig, "spread"))
+
+        if not (DELTA.armed and enc.G and bool(enc.spread_zone.any())):
+            out = split_spread_groups(
+                enc, cat, self._spread_constraints(enc, cat, occupancy))
+            _classify_fresh(out)
+            return out
+        mfp = fingerprint(encoded_fingerprint(enc), occ_sig,
+                          tuple(cat.zones), "spread")
+        # content fp in the KEY, same rationale as _delta_affinity:
+        # concurrent what-if variants must memoize side by side
+        key = ("facade", id(self), pool, group_terms_fingerprint(enc),
+               mfp)
+        hit = DELTA.serve("spread", key, mfp)
+        if hit is not None and not hit[1]:
+            out = split_spread_groups(
+                enc, cat, copy_spread_constraints(hit[0]))
+            RECOMPUTE.classify("spread", served=True)
+            return out
+        cons = self._spread_constraints(enc, cat, occupancy)
+        cfp = spread_constraints_fingerprint(cons)
+        if hit is not None:  # audit-due
+            if cfp == spread_constraints_fingerprint(hit[0]):
+                DELTA.confirm("spread", key, mfp,
+                              value=copy_spread_constraints(cons),
+                              check_fp=cfp)
+            else:
+                DELTA.diverge("spread", key)
+        else:
+            DELTA.store("spread", key, mfp,
+                        copy_spread_constraints(cons), check_fp=cfp)
+        out = split_spread_groups(enc, cat, cons)
+        _classify_fresh(out)
+        return out
 
     def stage_batchable(self, prep: PreparedSolve):
         """ops.solver.BatchableSolve for a prepared solve, or None when
@@ -921,6 +1121,9 @@ class Solver:
         # through the fallback backend; KARPENTER_TPU_INTEGRITY=0 makes
         # this a single env check (today's path byte-for-byte)
         result, backend = self._verify_integrity(prep, result, backend)
+        # delta plane: memoize (or audit-settle) the verified result —
+        # an unchanged-input reconcile serves it without a dispatch
+        self._delta_record_solve(prep, result, backend)
 
         out = self._decode(cat, enc, result, prep.nodepool, prep.dropped)
         out = self._merge_plan(out, prep.plan, cat, prep.nodepool)
@@ -1111,8 +1314,15 @@ class Solver:
         variants of the offending view, and suspend the device path for
         the standard never-wrong-twice cooldown."""
         from ..metrics import DEGRADED_MODE
+        from .delta import DELTA
         from .resident import RESIDENT
         RESIDENT.invalidate(("facade", id(self)), reason="corruption")
+        # memoized solve results may have been decoded from the same
+        # corrupted device state — they die with it (host-side
+        # affinity/spread memos are untouched: nothing device-backed
+        # feeds them)
+        DELTA.invalidate(("solve", "facade", id(self)),
+                         reason="quarantine")
         # cached DeviceCatalogs may still reference a corrupted resident
         # buffer — the cache entries must die with the entries
         if self._dcat_cache:
@@ -1233,19 +1443,15 @@ class Solver:
         self._meter_encode_rows(enc_ctx)
         self._apply_min_values_caps(enc, cat, nodepool.requirements)
         dropped = enc.dropped_keys  # split_spread_groups rebuilds the enc
-        from ..obs.recompute import RECOMPUTE, encoded_fingerprint, fingerprint
         occ_sig = tuple(sorted((zone, len(placed))
                                for zone, placed in occupancy))
-        RECOMPUTE.classify("affinity",
-                           fingerprint(encoded_fingerprint(enc), occ_sig))
         asp = (TRACER.span("encode.affinity", warm=True) if TRACER.enabled
                else NOOP_SPAN)
         with asp:
-            enc = apply_zone_affinity(enc, cat, occupancy)
-        enc = split_spread_groups(
-            enc, cat, self._spread_constraints(enc, cat, occupancy))
-        RECOMPUTE.classify("spread", fingerprint(
-            encoded_fingerprint(enc), occ_sig, "spread"))
+            enc = self._delta_affinity(enc, cat, occupancy, occ_sig,
+                                       nodepool.name)
+        enc = self._delta_spread(enc, cat, occupancy, occ_sig,
+                                 nodepool.name)
         enc.dropped_keys = dropped
         if enc.G:
             self._relax_infeasible_preferences(enc, cat)

@@ -28,6 +28,14 @@ def _env_name(flag: str) -> str:
 # without a row here (docs/static-analysis.md).
 # Rows: (name, default, description).
 ENV_KNOBS: tuple = (
+    ("KARPENTER_TPU_DELTA", "1",
+     "delta-plane master gate (ops/delta.py) — 0 disarms every "
+     "serve-and-verify memo (solve/affinity/spread/optimizer) and the "
+     "steady state recomputes from scratch, byte-for-byte identical"),
+    ("KARPENTER_TPU_DELTA_AUDIT", "16",
+     "delta-memo audit cadence: every this-many serves of a key is "
+     "refused and recomputed fresh for a confirm/diverge verdict "
+     "(0 audits every pass, i.e. the memo never serves)"),
     ("KARPENTER_TPU_DURATIONS", "<repo>/scale_durations.jsonl",
      "duration-event JSONL sink for the scale suite "
      "(metrics/durations.py, the Timestream analog)"),

@@ -295,12 +295,20 @@ def test_catalog_divergence_never_trips_pipeline_stall():
     assert wd.fired("pipeline_stall") == 0
 
 
-def test_ledger_attributes_batching_overhead_with_full_coverage():
+def test_ledger_attributes_batching_overhead_with_full_coverage(
+        monkeypatch):
     """ISSUE 9 profile satellite: a traced batched pump lands
     `batch_pack` and `pipeline_wait` in the phase ledger, and the >=99%
     coverage invariant stays green — `fleet.pump` roots the trace and is
-    itself mapped, so the pump's own glue attributes to queue_wait."""
+    itself mapped, so the pump's own glue attributes to queue_wait.
+
+    Delta memos disarmed: the traced second round repeats the warm
+    round's content, and a facade-level serve would skip the pump whose
+    phases this test asserts."""
     from karpenter_tpu.obs import TRACER
+    from karpenter_tpu.ops.delta import DELTA
+    monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+    DELTA.reset()
     from karpenter_tpu.obs.profile import LEDGER
     types = small_catalog()
     svc = SolverService(FakeClock(), backend="device", batch=True)

@@ -1,0 +1,422 @@
+"""Delta plane (ops/delta.py): serve-and-verify memos for the
+steady-state reconcile — the protocol (serve/store/confirm/diverge),
+the audit cadence, the never-wrong-twice cooldown, the invalidation
+ladder, and the byte-parity contract: a delta-served pipeline must
+produce EXACTLY the output a forced-cold recompute produces, across
+seeds, churn, and audit cadences.
+
+The INVALIDATION_CASES table is the canonical test coverage of the
+invalidation-reason ladder — `make obs-audit` requires every
+ops/delta.INVALIDATION_REASONS name to appear in this file as a string
+constant constructed by a test, so a new rung without a test here
+fails the audit (the same contract as the recompute taxonomy)."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.catalog import CatalogProvider
+from karpenter_tpu.catalog.generator import small_catalog
+from karpenter_tpu.models import labels as L
+from karpenter_tpu.models.nodepool import NodePool
+from karpenter_tpu.models.pod import (Pod, PodAffinityTerm,
+                                      TopologySpreadConstraint)
+from karpenter_tpu.models.resources import Resources
+from karpenter_tpu.ops.delta import (DELTA, DOMAINS, INVALIDATION_REASONS,
+                                     DeltaPlane)
+from karpenter_tpu.ops.facade import Solver
+
+POOL = NodePool(name="default")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """The plane is process-global: isolate every test's memo set."""
+    DELTA.reset()
+    yield
+    DELTA.reset()
+
+
+# --- the serve/verify protocol ---------------------------------------------
+
+
+class TestProtocol:
+    def test_miss_store_serve_roundtrip(self):
+        p = DeltaPlane()
+        assert p.serve("solve", ("k",), 1) is None          # cold miss
+        assert p.store("solve", ("k",), 1, "payload", check_fp=9)
+        val, audit = p.serve("solve", ("k",), 1)
+        assert val == "payload" and audit is False
+        assert p.stats["serves"] == 1 and p.stats["misses"] == 1
+
+    def test_changed_fingerprint_is_a_miss(self):
+        p = DeltaPlane()
+        p.store("spread", ("k",), 1, "old")
+        assert p.serve("spread", ("k",), 2) is None
+        # re-store under the new fingerprint: the world moved on
+        p.store("spread", ("k",), 2, "new")
+        assert p.serve("spread", ("k",), 2)[0] == "new"
+
+    def test_audit_cadence_refuses_the_nth_serve(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DELTA_AUDIT", "3")
+        p = DeltaPlane()
+        p.store("affinity", ("k",), 5, "desc", check_fp=7)
+        for _ in range(3):
+            val, audit = p.serve("affinity", ("k",), 5)
+            assert audit is False
+        val, audit = p.serve("affinity", ("k",), 5)
+        assert audit is True and val == "desc"   # recompute, don't use
+        # confirm resets the counter: serving resumes
+        p.confirm("affinity", ("k",), 5, value="desc2", check_fp=7)
+        val, audit = p.serve("affinity", ("k",), 5)
+        assert audit is False and val == "desc2"
+        assert p.stats["audits_due"] == 1 and p.stats["confirms"] == 1
+
+    def test_audit_zero_never_serves(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DELTA_AUDIT", "0")
+        p = DeltaPlane()
+        p.store("solve", ("k",), 1, "v")
+        val, audit = p.serve("solve", ("k",), 1)
+        assert audit is True                      # every pass recomputes
+        assert p.stats["serves"] == 0
+
+    def test_disarmed_plane_neither_serves_nor_stores(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        p = DeltaPlane()
+        assert not p.store("solve", ("k",), 1, "v")
+        assert p.serve("solve", ("k",), 1) is None
+        assert p.entries() == 0
+
+    def test_stale_reports_audit_due_entries(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_DELTA_AUDIT", "2")
+        p = DeltaPlane()
+        p.store("optimizer", ("pool-a",), 1, True)
+        p.serve("optimizer", ("pool-a",), 1)
+        assert p.stale() == []
+        p.serve("optimizer", ("pool-a",), 1)
+        assert p.stale() == [("optimizer", ("pool-a",), 2)]
+        p.confirm("optimizer", ("pool-a",), 1)
+        assert p.stale() == []
+
+    def test_snapshot_and_debug_route(self):
+        import json
+
+        from karpenter_tpu.obs.exposition import render
+        p = DeltaPlane()
+        p.store("solve", ("k",), 1, "v")
+        snap = p.snapshot()
+        assert snap["entries"] == 1 and snap["per_stage"] == {"solve": 1}
+        assert snap["domains"] == list(DOMAINS)
+        assert snap["reasons"] == list(INVALIDATION_REASONS)
+        status, ctype, body = render("/debug/delta")
+        assert status == 200 and "json" in ctype
+        doc = json.loads(body)
+        assert doc["armed"] is True
+        assert doc["domains"] == list(DOMAINS)
+
+
+# --- the invalidation ladder ------------------------------------------------
+# canonical coverage table: obs-audit asserts this file constructs every
+# INVALIDATION_REASONS rung
+INVALIDATION_CASES = [
+    "divergence", "epoch", "quarantine", "capacity", "disarm",
+]
+
+
+class TestInvalidationLadder:
+    def test_table_covers_ladder_exactly(self):
+        assert INVALIDATION_CASES == list(INVALIDATION_REASONS)
+
+    def test_divergence_drops_and_arms_never_wrong_twice(self):
+        from karpenter_tpu.ops.delta import COOLDOWN
+        p = DeltaPlane()
+        p.store("solve", ("k",), 1, "wrong")
+        p.diverge("solve", ("k",))
+        assert p.serve("solve", ("k",), 1) is None
+        assert p.snapshot()["invalidations"]["solve"]["divergence"] == 1
+        # the cooldown declines the next COOLDOWN stores for this key
+        for i in range(COOLDOWN):
+            assert not p.store("solve", ("k",), 1, f"retry-{i}")
+        assert p.stats["declined"] == COOLDOWN
+        assert p.store("solve", ("k",), 1, "after-cooldown")
+        assert p.serve("solve", ("k",), 1)[0] == "after-cooldown"
+
+    def test_epoch_metered_on_restore_under_new_fingerprint(self):
+        p = DeltaPlane()
+        p.store("affinity", ("k",), 1, "old")
+        p.store("affinity", ("k",), 2, "new")   # world moved: epoch
+        assert p.snapshot()["invalidations"]["affinity"]["epoch"] == 1
+
+    def test_quarantine_prefix_invalidation_is_scoped(self):
+        p = DeltaPlane()
+        p.store("solve", ("facade", 1, "np-a"), 1, "a")
+        p.store("solve", ("facade", 2, "np-b"), 1, "b")
+        n = p.invalidate(("solve", "facade", 1), reason="quarantine")
+        assert n == 1
+        assert p.serve("solve", ("facade", 1, "np-a"), 1) is None
+        assert p.serve("solve", ("facade", 2, "np-b"), 1)[0] == "b"
+        assert p.snapshot()["invalidations"]["solve"]["quarantine"] == 1
+
+    def test_capacity_lru_eviction(self):
+        p = DeltaPlane(max_entries=2)
+        p.store("solve", ("a",), 1, "a")
+        p.store("spread", ("b",), 1, "b")
+        p.serve("solve", ("a",), 1)             # touch: a is now MRU
+        p.store("optimizer", ("c",), 1, "c")    # evicts b (LRU)
+        assert p.serve("spread", ("b",), 1) is None
+        assert p.serve("solve", ("a",), 1)[0] == "a"
+        assert p.snapshot()["invalidations"]["spread"]["capacity"] == 1
+
+    def test_disarm_invalidates_the_whole_plane(self):
+        from karpenter_tpu.metrics import DELTA_INVALIDATIONS
+        p = DeltaPlane()
+        for st in DOMAINS:
+            p.store(st, ("k",), 1, st)
+        v0 = DELTA_INVALIDATIONS.value(stage="solve", reason="disarm")
+        assert p.invalidate((), reason="disarm") == len(DOMAINS)
+        assert p.entries() == 0
+        assert DELTA_INVALIDATIONS.value(stage="solve",
+                                         reason="disarm") == v0 + 1
+
+    def test_unknown_reason_is_rejected(self):
+        p = DeltaPlane()
+        with pytest.raises(AssertionError):
+            p.invalidate((), reason="because")
+
+
+# --- facade byte-parity fuzz ------------------------------------------------
+
+
+_CPUS = ["100m", "250m", "500m", "1"]
+_MEMS = ["128Mi", "512Mi", "1Gi"]
+
+
+def _mk_pods(n, manifests, gen, spread, anti):
+    """Content is a function of (n, manifests, spread, anti) only —
+    `gen` moves pod NAMES, modeling same-shape churn."""
+    pods = []
+    for i in range(n):
+        s = i % manifests
+        kw = dict(requests=Resources.parse(
+            {"cpu": _CPUS[s % len(_CPUS)], "memory": _MEMS[s % len(_MEMS)]}),
+            labels={"app": f"m{s}"})
+        if spread and s % 3 == 0:
+            kw["topology_spread"] = [TopologySpreadConstraint(
+                topology_key=L.ZONE, max_skew=1)]
+        if anti and s % 4 == 1:
+            kw["affinity_terms"] = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": f"m{s}"}, anti=True)]
+        pods.append(Pod(name=f"dp-{gen}-{i}", **kw))
+    return pods
+
+
+def _digest(out):
+    """Canonical, order-free content digest of a SolveOutput."""
+    return (
+        tuple(sorted(
+            (l.instance_type, l.zone, l.capacity_type, round(l.price, 6),
+             tuple(sorted(l.pod_keys)),
+             tuple((o[0], o[1], o[2], round(o[3], 6))
+                   for o in l.overrides))
+            for l in out.launches)),
+        tuple(sorted((k, tuple(sorted(v)))
+                     for k, v in out.existing_placements.items())),
+        tuple(sorted(out.unschedulable)),
+    )
+
+
+def _drive_rounds(seed):
+    """One seeded mutation schedule: blocks of same-content rounds
+    (churned names — the delta-served steady state) separated by
+    content changes (the epoch boundaries). Returns the digest list."""
+    rng = random.Random(seed)
+    types = small_catalog()
+    f = Solver(CatalogProvider(lambda: types), backend="auto")
+    digests = []
+    gen = 0
+    for _block in range(3):
+        n = rng.randint(6, 14)
+        manifests = rng.randint(2, 4)
+        spread = rng.random() < 0.7
+        anti = rng.random() < 0.7
+        for _rep in range(3):
+            gen += 1
+            out = f.solve(_mk_pods(n, manifests, gen, spread, anti), POOL)
+            digests.append(_digest(out))
+    return digests
+
+
+class TestFacadeByteParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_served_equals_forced_cold(self, seed, monkeypatch):
+        """The acceptance contract: with the memos armed, every solve's
+        output is byte-identical to the forced-cold (disarmed) run of
+        the SAME seeded schedule — and the armed run actually served
+        (this test fails loudly if the serve path stops engaging)."""
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        DELTA.reset()
+        cold = _drive_rounds(seed)
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "1")
+        DELTA.reset()
+        warm = _drive_rounds(seed)
+        assert warm == cold
+        assert DELTA.stats["serves"] >= 2, DELTA.snapshot()
+        assert DELTA.stats["divergences"] == 0, DELTA.snapshot()
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_audit_every_pass_still_byte_identical(self, seed,
+                                                   monkeypatch):
+        """KARPENTER_TPU_DELTA_AUDIT=1 audits every other serve: the
+        fresh recompute must CONFIRM the stored output every time (a
+        divergence here means the memo replayed the world wrong)."""
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        DELTA.reset()
+        cold = _drive_rounds(seed)
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "1")
+        monkeypatch.setenv("KARPENTER_TPU_DELTA_AUDIT", "1")
+        DELTA.reset()
+        audited = _drive_rounds(seed)
+        assert audited == cold
+        assert DELTA.stats["confirms"] >= 1, DELTA.snapshot()
+        assert DELTA.stats["divergences"] == 0, DELTA.snapshot()
+
+
+# --- controller parity: optimizer + the full reconcile ----------------------
+
+
+def _drive_sim(seed, rounds=3, quiet=3):
+    """A miniature c16 regime: standing anti-affinity fleet + churnable
+    residents, settled, then churned reconciles and quiet disruption
+    passes. Returns the end-of-run cluster-state hash."""
+    from karpenter_tpu.cloud.fake import FakeCloudConfig
+    from karpenter_tpu.faults.runner import state_hash
+    from karpenter_tpu.sim import make_sim
+    rng = random.Random(seed)
+    sim = make_sim(cloud_config=FakeCloudConfig(
+        node_ready_delay=1.0, register_delay=0.5,
+        create_fleet_rate=1e6, create_fleet_burst=10**6))
+    for i in range(8):
+        sim.store.add_pod(Pod(
+            name=f"standing-{i}", labels={"app": "standing"},
+            requests=Resources.parse({"cpu": "500m", "memory": "512Mi"}),
+            affinity_terms=[PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                label_selector={"app": "standing"}, anti=True)]))
+    n = 40
+    live = _mk_pods(n, 4, 0, True, False)
+    for p in live:
+        sim.store.add_pod(p)
+    assert sim.engine.run_until(
+        lambda: all(p.node_name for p in sim.store.pods.values()),
+        timeout=600.0, step=1.0)
+    churn = max(2, n // 10)
+    for rnd in range(1, rounds + 1):
+        k = rng.randint(1, churn)
+        for p in live[:k]:
+            sim.store.delete_pod(p.namespace, p.name)
+        fresh = _mk_pods(k, 4, rnd, True, False)
+        for p in fresh:
+            sim.store.add_pod(p)
+        live = live[k:] + fresh
+        sim.provisioner.reconcile(sim.clock.now())
+        sim.disruption.reconcile(sim.clock.now())
+    for _ in range(quiet):
+        sim.disruption.reconcile(sim.clock.now())
+    return state_hash(sim)
+
+
+class TestControllerParity:
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_full_reconcile_state_hash_parity(self, seed, monkeypatch):
+        """Armed vs disarmed through the REAL controllers (provisioner,
+        disruption incl. the optimizer's fruitless-search memo): the
+        end-of-run cluster-state hash must match, and the armed run
+        must have served."""
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        DELTA.reset()
+        cold = _drive_sim(seed)
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "1")
+        DELTA.reset()
+        warm = _drive_sim(seed)
+        assert warm == cold
+        assert DELTA.stats["serves"] >= 1, DELTA.snapshot()
+        assert DELTA.stats["divergences"] == 0, DELTA.snapshot()
+        # the solve memo must engage on existing-node full reconciles —
+        # the bulk of the measured c16 headroom
+        assert DELTA.snapshot()["per_stage"].get("solve", 0) >= 1
+
+
+# --- chaos digest parity (memo armed) ---------------------------------------
+
+
+class TestChaosDigestParity:
+    def test_smoke_repeat_digest_equality_with_memo_armed(self):
+        """The chaos acceptance: `smoke` twice with the memos armed
+        (the second run's plane still holds the first run's entries —
+        facade-id key namespacing must keep them from cross-serving)
+        plus once forced-cold, all three end-state digests identical."""
+        from karpenter_tpu.faults import ScenarioRunner
+        a = ScenarioRunner("smoke", seed=3).run()
+        b = ScenarioRunner("smoke", seed=3).run()
+        assert a.ok and b.ok
+        assert a.end_hash == b.end_hash
+        import os
+        os.environ["KARPENTER_TPU_DELTA"] = "0"
+        try:
+            c = ScenarioRunner("smoke", seed=3).run()
+        finally:
+            os.environ.pop("KARPENTER_TPU_DELTA", None)
+        assert c.ok
+        assert c.end_hash == a.end_hash
+
+    def test_fleet_smoke_repeat_digest_equality_with_memo_armed(self):
+        """Same contract for the fleet pump (bucketed batched dispatch
+        + the stable batch-composition residency): repeat runs and the
+        forced-cold run share one fleet hash."""
+        from karpenter_tpu.fleet import FleetRunner
+        a = FleetRunner("fleet_smoke", tenants=4, seed=0).run()
+        b = FleetRunner("fleet_smoke", tenants=4, seed=0).run()
+        assert a.ok and b.ok
+        assert a.fleet_hash == b.fleet_hash
+        assert a.tenant_hashes == b.tenant_hashes
+        import os
+        os.environ["KARPENTER_TPU_DELTA"] = "0"
+        try:
+            c = FleetRunner("fleet_smoke", tenants=4, seed=0).run()
+        finally:
+            os.environ.pop("KARPENTER_TPU_DELTA", None)
+        assert c.ok
+        assert c.fleet_hash == a.fleet_hash
+
+
+# --- the stable batch-composition contract ----------------------------------
+
+
+class TestBucketResidency:
+    def test_membership_must_repeat_before_residency(self):
+        """fleet/service._bucket_resident_key: first sight of a bucket
+        composition is donated (None), an IDENTICAL next-pump
+        composition gets the resident key, any membership change drops
+        back to donation for one pump."""
+        import types as _t
+
+        from karpenter_tpu.fleet.service import SolverService
+        from karpenter_tpu.utils.clock import FakeClock
+        svc = SolverService(FakeClock())
+
+        def entry(tenant, mk):
+            return {"ticket": _t.SimpleNamespace(tenant=tenant),
+                    "batchable": _t.SimpleNamespace(
+                        signature=("sig", 8), meter_key=mk,
+                        shape_class="small")}
+
+        e1 = [entry("a", 1), entry("b", 2)]
+        assert svc._bucket_resident_key(e1) is None          # first sight
+        key = svc._bucket_resident_key([entry("a", 1), entry("b", 2)])
+        assert key is not None and key[0] == "fleet"
+        again = svc._bucket_resident_key([entry("a", 1), entry("b", 2)])
+        assert again == key                                  # stable
+        # membership changed: donate this pump, resident next pump
+        assert svc._bucket_resident_key([entry("a", 1)]) is None
+        assert svc._bucket_resident_key([entry("a", 1)]) is not None

@@ -284,7 +284,18 @@ class TestDcatEvictions:
 
 
 class TestBatchedPumpTransfers:
-    """ISSUE 10 satellite: transfer accounting under the batched pump."""
+    """ISSUE 10 satellite: transfer accounting under the batched pump.
+
+    The delta plane is disarmed here: a repeated same-content solve
+    would be served at the facade and never reach the pump, hiding the
+    per-bucket transfer accounting these tests assert."""
+
+    @pytest.fixture(autouse=True)
+    def _no_delta(self, monkeypatch):
+        from karpenter_tpu.ops.delta import DELTA
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        DELTA.reset()
+        yield
 
     def _catalog_devices(self):
         from karpenter_tpu.ops import solver as S

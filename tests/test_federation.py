@@ -267,10 +267,13 @@ class TestFederatedService:
         assert s2.fed.stats["announce_hits"] >= 1
         assert s2.fed_stats["wire_buckets"] >= 1
 
-    def test_unknown_token_reannounces_and_retries_once(self):
+    def test_unknown_token_reannounces_and_retries_once(self, monkeypatch):
         """Server restart / LRU eviction is a protocol event, not a
         degrade: the client forgets, re-announces, retries — and the
-        cooldown never arms."""
+        cooldown never arms. (Delta plane disarmed: the second solve's
+        content matches the first, and a facade-level serve would skip
+        the wire path this test exercises.)"""
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
         svc = mk_fed_service()
         server = svc.fed.transport.server
         types = small_catalog()
@@ -288,12 +291,16 @@ class TestFederatedService:
         assert svc.fed.stats["uploads"] == 2  # re-shipped after restart
         assert svc._fed_failures == 0 and svc._fed_cooldown == 0
 
-    def test_wire_failure_hostsolves_bucket_and_arms_cooldown(self):
+    def test_wire_failure_hostsolves_bucket_and_arms_cooldown(
+            self, monkeypatch):
         """The degrade ladder rung 1+2: a dead wire mid-bucket
         host-solves exactly that bucket's tickets and later buckets ride
-        the LOCAL device path while the cooldown drains."""
+        the LOCAL device path while the cooldown drains. (Delta plane
+        disarmed: a facade-level serve of the second same-content solve
+        would skip the local dispatch path this test asserts.)"""
         from karpenter_tpu.faults.injector import wire_fault_hook
         from karpenter_tpu.metrics import FEDERATION_FALLBACKS
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
         svc = mk_fed_service()
         types = small_catalog()
         pool = NodePool(name="default")

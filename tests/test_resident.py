@@ -47,8 +47,16 @@ _MEMS = ["128Mi", "512Mi", "1Gi", "2Gi"]
 
 
 @pytest.fixture(autouse=True)
-def _fresh_resident():
-    """The manager is process-global: isolate every test's view set."""
+def _fresh_resident(monkeypatch):
+    """The manager is process-global: isolate every test's view set.
+    The delta plane (ops/delta.py) sits ABOVE the resident plane and
+    would serve repeat same-content solves without ever dispatching —
+    hiding the upload/patch machinery this module exists to exercise —
+    so it is disarmed here (its own serving is tests/test_delta.py's
+    job)."""
+    from karpenter_tpu.ops.delta import DELTA
+    monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+    DELTA.reset()
     RESIDENT.reset()
     yield
     RESIDENT.reset()

@@ -62,9 +62,10 @@ class TestArming:
             "warm_audit_lag", "warm_divergence", "fleet_starvation",
             "pipeline_stall", "profile_unattributed",
             "trace_ring_overflow", "devicemem_leak",
-            "resident_staleness", "overload_unbounded",
-            "optimizer_divergence", "integrity_breach",
-            "recompute_runaway", "federation_degraded")
+            "resident_staleness", "delta_staleness",
+            "overload_unbounded", "optimizer_divergence",
+            "integrity_breach", "recompute_runaway",
+            "federation_degraded")
 
 
 class TestTrips:
@@ -677,6 +678,53 @@ class TestTrips:
         _age(wd, wd.RESIDENT_GRACE + wd.interval + 1)
         assert not _findings(wd, "resident_staleness")
         RESIDENT.reset()
+
+    def test_trip_delta_staleness(self):
+        """A delta-plane memo entry stuck at audit-due (its owner
+        served up to the cadence, then never ran the fresh
+        confirm/diverge pass) fires after the delta grace; the confirm
+        a healthy loop's next pass performs clears the excursion."""
+        from karpenter_tpu.ops.delta import DELTA
+
+        DELTA.reset()
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()
+        key = ("facade", 4321, "nc-delta")
+        DELTA.store("solve", key, 42, "memoized-result", check_fp=7)
+        for _ in range(DELTA.audit_every):
+            DELTA.serve("solve", key, 42)
+        # audit-due just now: inside the grace, no finding yet
+        wd.tick(force=True)
+        assert not _findings(wd, "delta_staleness")
+        _age(wd, wd.DELTA_GRACE + wd.interval + 1)
+        found = _findings(wd, "delta_staleness")
+        assert found and found[0].severity == "warning"
+        assert "nc-delta" in found[0].message
+        assert found[0].attrs["stage"] == "solve"
+        assert found[0].attrs["since_confirm"] >= DELTA.audit_every
+        # the owner finally closes the audit contract (fresh recompute
+        # matched): the excursion clears (edge re-arms)
+        DELTA.confirm("solve", key, 42)
+        wd.tick(force=True)
+        assert not any(inv == "delta_staleness"
+                       for inv, _k in wd._active)
+        DELTA.reset()
+
+    def test_delta_staleness_predating_arm_never_fires(self):
+        """Audit-due delta-memo residue from a previous run is
+        baselined out at arm() — the zero-false-positive contract."""
+        from karpenter_tpu.ops.delta import DELTA
+
+        DELTA.reset()
+        key = ("facade", 777, "nc-residue")
+        DELTA.store("affinity", key, 9, "memoized-descriptor")
+        for _ in range(DELTA.audit_every):
+            DELTA.serve("affinity", key, 9)
+        clock = FakeClock()
+        wd = Watchdog(clock).arm()  # already audit-due HERE: residue
+        _age(wd, wd.DELTA_GRACE + wd.interval + 1)
+        assert not _findings(wd, "delta_staleness")
+        DELTA.reset()
 
     def test_meter_monitors_attribute_per_tenant(self):
         """The ring/ledger meters are process-global but the monitors

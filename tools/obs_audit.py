@@ -28,7 +28,11 @@ Six invariants that otherwise rot silently:
 6. every graftlint rule (tools/graftlint/rules.RULE_NAMES) has a seeded
    bad-code mutant that TRIPS it in tests/test_graftlint.py
    (`def test_trip_lint_<rule>`) — a lint rule no mutant can trip
-   guards nothing.
+   guards nothing;
+7. every delta-plane invalidation reason (ops/delta.
+   INVALIDATION_REASONS) is constructed by the canonical delta tests
+   (tests/test_delta.py) — an invalidation ladder rung no test climbs
+   is a memo-eviction path nobody has ever watched fire.
 
 Coverage is judged on the AST, not raw text (tools/graftlint/
 discovery.py): a bucket or owner kind counts as exercised only when a
@@ -138,6 +142,18 @@ def audit() -> int:
                 f"`def test_trip_integrity_{check}` (mutation-style "
                 f"negative coverage)")
 
+    from karpenter_tpu.ops.delta import INVALIDATION_REASONS
+    dl_idx = test_index(os.path.join(ROOT, "tests", "test_delta.py"))
+    if not dl_idx.exists:
+        failures.append("tests/test_delta.py (the canonical delta-plane "
+                        "tests) is missing")
+    for reason in INVALIDATION_REASONS:
+        if not dl_idx.exercises(reason):
+            failures.append(
+                f"delta invalidation reason '{reason}' is in the ladder "
+                f"but no test function in tests/test_delta.py constructs "
+                f"it (comments/docstrings don't count)")
+
     gl_idx = test_index(os.path.join(ROOT, "tests", "test_graftlint.py"))
     if not gl_idx.exists:
         failures.append("tests/test_graftlint.py (the canonical lint-rule "
@@ -164,6 +180,8 @@ def audit() -> int:
           f"{len(OWNER_KINDS)} residency owner kinds + "
           f"{len(TRANSFER_REASONS)} transfer reasons test-covered, "
           f"{len(CHECKS)} integrity checks trip-covered, "
+          f"{len(INVALIDATION_REASONS)} delta invalidation reasons "
+          f"test-covered, "
           f"{len(RULE_NAMES)} lint rules trip-covered)")
     return 0
 

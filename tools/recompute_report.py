@@ -10,6 +10,10 @@ spends:
   solve, optimizer, disrupt) split fresh / redundant / delta-served,
 - the redundant fraction and the redundant traced wall per stage (the
   measured win of making that stage delta-aware),
+- the estimated wall the delta plane's served units did NOT pay (the
+  "saved ms" column: served units priced at the stage's mean paid
+  per-unit cost — set KARPENTER_TPU_DELTA=0 to see the same probe
+  recompute everything and the column collapse to zero),
 - the attribution coverage over the traced taxonomy wall (the ≥99%
   invariant; the gap per stage is work no classify() call owned).
 
