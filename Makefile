@@ -78,9 +78,11 @@ federation:  ## federation plane: fleet buckets over the wire (embedded server +
 	$(PY) -m karpenter_tpu.fleet federation_smoke --tenants $(or $(TENANTS),50) --federate
 	$(PY) -m karpenter_tpu.fleet fleet_noisy_neighbor --federate
 
-federation-audit:  ## federation reproducibility: federation_smoke at 2 seeds x --repeat 2 through the wire (identical digests required)
+federation-audit:  ## federation reproducibility: federation_smoke + the wire-weather/restart drills at 2 seeds x --repeat 2 (identical hash+fingerprint digests required)
 	$(PY) -m karpenter_tpu.fleet federation_smoke --seeds 2 --repeat 2 --federate
 	$(PY) -m karpenter_tpu.fleet federation_smoke --seeds 1 --repeat 2 --batch
+	$(PY) -m karpenter_tpu.fleet fed_flap --seeds 2 --repeat 2
+	$(PY) -m karpenter_tpu.fleet fed_server_restart --seeds 2 --repeat 2
 
 federation-report:  ## federation wire economics: per-process throughput, catalog-share hit rate, wire bytes vs tensor bytes (TENANTS=n PROCS=n)
 	$(PY) tools/federation_report.py --tenants $(or $(TENANTS),24) --processes $(or $(PROCS),3)

@@ -1235,6 +1235,53 @@ def main() -> None:
                  f"{_procs17} processes — the token-announce protocol "
                  "is not deduplicating content")
 
+    progress("c18: federation resilience regime (wire weather + "
+             "server crash-restart over the federated fleet)")
+    # --- config 18 (ISSUE 20): the resilience plane. Two seeded drills:
+    # fed_flap (a 15s flapping wire over solve RPCs — the breaker must
+    # open, probe, trial, and rejoin) and fed_server_restart (the
+    # embedded server hard-restarts mid-fleet — clients recover through
+    # the boot-generation protocol, re-announcing every token exactly
+    # once). c18_rejoin_ms is the degraded->rejoined latency of the last
+    # rejoin; c18_retry_frac the fraction of RPC attempts that were
+    # in-place retries; c18_restart_reupload_bytes the tensor bytes the
+    # restart forced back across the wire (bounded: once per view).
+    from karpenter_tpu.fleet.runner import FleetRunner as _FR18
+    from karpenter_tpu.metrics import FEDERATION_RPCS as _FRPC18
+    _rpc0_18 = sum(_FRPC18.sum(outcome=o)
+                   for o in ("ok", "error", "transport", "stale"))
+    t0 = time.perf_counter()
+    _rflap18 = _FR18("fed_flap", seed=0)
+    _repflap18 = _rflap18.run()
+    _fsflap18 = _rflap18.service.federation_state()
+    _rrst18 = _FR18("fed_server_restart", seed=0)
+    _reprst18 = _rrst18.run()
+    _fsrst18 = _rrst18.service.federation_state()
+    _ok18 = _repflap18.ok and _reprst18.ok
+    _attempts18 = (sum(_FRPC18.sum(outcome=o)
+                       for o in ("ok", "error", "transport", "stale"))
+                   - _rpc0_18)
+    _retries18 = _fsflap18["retries"] + _fsrst18["retries"]
+    detail["c18_fleet_settled"] = bool(_ok18)
+    detail["c18_rejoin_ms"] = round(float(_fsflap18["last_rejoin_ms"]), 3)
+    detail["c18_retry_frac"] = round(
+        _retries18 / _attempts18, 4) if _attempts18 else 0.0
+    detail["c18_restart_reupload_bytes"] = int(_fsrst18["reupload_bytes"])
+    detail["c18_generation_changes"] = int(_fsrst18["generation_changes"])
+    detail["c18_rejoins"] = int(_fsflap18["rejoins"]
+                                + _fsrst18["rejoins"])
+    detail["c18_wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    if not _ok18:
+        progress("C18 RESILIENCE DRILL FAILED its verdicts — see the "
+                 "scenario analyze violations")
+    if _fsrst18["failures"]:
+        progress(f"C18 RESTART COST {_fsrst18['failures']:g} wire "
+                 "failure(s) — recovery must ride the generation "
+                 "protocol, not the degrade ladder")
+    if _fsflap18["stale_decoded"] or _fsrst18["stale_decoded"]:
+        progress("C18 SPLIT-BRAIN: a stale-generation frame was DECODED "
+                 "instead of rejected")
+
     progress("profile: writing profile_bench.json (phase attribution)")
     # --- the phase-attribution artifact (obs/profile.py): everything the
     # traced windows above fed the ledger (c7 solve, c8 warm+cold

@@ -11,14 +11,15 @@ end-state hash for identical seeds. See docs/robustness.md.
 """
 
 from .plan import (ApiFault, ClockJump, CrashPoint, DeviceFault, FaultPlan,
-                   IceWindow, InjectedFault, InterruptionBurst)
+                   IceWindow, InjectedFault, InterruptionBurst, WireFault)
 from .runner import (RestartRunner, ScenarioReport, ScenarioRunner,
                      check_invariants, restart_invariants, state_hash)
 from .scenarios import SCENARIOS, Scenario, get_scenario
 
 __all__ = [
     "FaultPlan", "IceWindow", "ApiFault", "ClockJump", "CrashPoint",
-    "DeviceFault", "InterruptionBurst", "InjectedFault", "ScenarioRunner",
+    "DeviceFault", "InterruptionBurst", "InjectedFault", "WireFault",
+    "ScenarioRunner",
     "RestartRunner", "ScenarioReport", "check_invariants",
     "restart_invariants", "state_hash", "SCENARIOS", "Scenario",
     "get_scenario",

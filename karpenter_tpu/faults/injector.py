@@ -207,3 +207,23 @@ def wire_fault_hook(fail_methods=("solve_bucket",), after: int = 0,
         yield state
     finally:
         transport_mod.set_wire_fault_hook(prev)
+
+
+@contextlib.contextmanager
+def wire_fault_plan_hook(plan: Optional[FaultPlan]):
+    """Arm BOTH federation wire seams (pre-RPC request probe and the
+    reply-frame garbler) for the plan's WireFault rules — the seeded
+    counterpart of the count-based `wire_fault_hook` above, with every
+    firing recorded on the plan's canonical timeline so wire weather
+    rides the chaos fingerprints. Always disarms both seams on exit."""
+    from ..federation import transport as transport_mod
+    if plan is None or not plan.has_wire_faults:
+        yield
+        return
+    prev_req = transport_mod.set_wire_fault_hook(plan.on_wire)
+    prev_rep = transport_mod.set_wire_reply_hook(plan.on_wire_reply)
+    try:
+        yield
+    finally:
+        transport_mod.set_wire_fault_hook(prev_req)
+        transport_mod.set_wire_reply_hook(prev_rep)

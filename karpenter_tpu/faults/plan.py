@@ -142,6 +142,54 @@ class CorruptionFault:
 
 
 @dataclass(frozen=True)
+class WireFault:
+    """Federation wire weather: the transport between a fleet process
+    and the solver server misbehaving — the fault family the federation
+    resilience plane (retry ladder, circuit breaker, generation
+    protocol) exists to absorb. Fires through the nil-guarded seams in
+    `federation/transport.py` (`set_wire_fault_hook` before every RPC,
+    `set_wire_reply_hook` on every reply frame), armed by
+    `faults/injector.wire_fault_plan_hook`.
+
+    kind:
+      - "blackhole": EVERY matching RPC during the window fails with a
+        ConnectionError — a network partition; healthz probes fail too
+        unless `methods` excludes them, so the breaker stays open until
+        the window lifts.
+      - "latency": the nth..nth+count-1 eligible probes raise a
+        retryable deadline-exceeded ServerError — a transient stall the
+        idempotent-RPC retry ladder should absorb without a degrade.
+      - "reset": same counting, but a ConnectionResetError — the peer
+        dropping the socket mid-RPC.
+      - "flap": the wire alternates down/up in runs of `nth` eligible
+        probes (probes 1..nth fail, nth+1..2*nth pass, ...) for the
+        whole window — the oscillating-server drill the half-open
+        breaker must rejoin from without a full cooldown per flap.
+      - "slow_handshake": like "latency" but only handshake/healthz
+        RPCs are eligible — connect/probe paths stall while solves
+        (once connected) would be fine.
+      - "corrupt_frame": the nth..nth+count-1 eligible REPLY frames are
+        garbled at the byte level (reply seam) — the transport must
+        reject the frame as a transport failure, never decode it.
+
+    window: the rule is armed during [at, at+window) of run-relative
+    sim time; probes outside do not count (CorruptionFault's `at`
+    discipline, plus an explicit close). nth/count: 1-based counts of
+    ELIGIBLE probes per rule, deterministic like every other family.
+    methods: restrict eligibility to these RPC method names (None
+    matches every method). Every firing lands on the plan's canonical
+    timeline, so wire weather rides the same fingerprint contract as
+    corruption — `--repeat 2` must reproduce it byte-for-byte."""
+
+    kind: str = "reset"   # blackhole | latency | reset | flap | slow_handshake | corrupt_frame
+    at: float = 0.0
+    window: float = math.inf
+    nth: int = 1
+    count: int = 1
+    methods: Optional[Tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
 class CrashPoint:
     """The operator process dies at a named commit-path cut point
     (utils/crashpoints.CUT_POINTS: mid_launch_batch, post_launch,
@@ -198,6 +246,9 @@ class FaultPlan:
         self.corruption_faults = [r for r in self.rules
                                   if isinstance(r, CorruptionFault)]
         self._corruption_counts: dict = {}  # rule idx -> eligible probes
+        self.wire_faults = [r for r in self.rules
+                            if isinstance(r, WireFault)]
+        self._wire_counts: dict = {}  # (seam, rule idx) -> eligible probes
         # per-FIRED-injection snapshot of the integrity plane's
         # detection counter at injection time, in firing order — the
         # runners' judgment matches detections to injections through
@@ -377,6 +428,82 @@ class FaultPlan:
     @property
     def has_corruption_faults(self) -> bool:
         return bool(self.corruption_faults)
+
+    def on_wire(self, method: str) -> None:
+        """The federation transport's request-side seam
+        (`transport.set_wire_fault_hook`): raises the rule taxonomy's
+        exception when a WireFault covers this (per-rule, 1-based)
+        eligible probe inside its armed window. corrupt_frame rules are
+        reply-seam only and never fire here."""
+        if not self.wire_faults:
+            return
+        now = self.clock.now() if self.clock is not None else 0.0
+        rel = now - self.origin
+        for i, r in enumerate(self.wire_faults):
+            if r.kind == "corrupt_frame":
+                continue
+            if r.kind == "slow_handshake":
+                if method not in ("handshake", "healthz"):
+                    continue
+            elif r.methods is not None and method not in r.methods:
+                continue
+            if not (r.at <= rel < r.at + r.window):
+                continue
+            if r.kind == "blackhole":
+                # a partition has no nth: every matching RPC in the
+                # window fails, probes included
+                self.record(now, "wire", f"blackhole:{method}")
+                raise ConnectionError(
+                    f"injected wire blackhole on {method}")
+            n = self._wire_counts.get(("req", i), 0) + 1
+            self._wire_counts[("req", i)] = n
+            if r.kind == "flap":
+                # runs of `nth` eligible probes: down, up, down, ...
+                if ((n - 1) // max(r.nth, 1)) % 2 == 0:
+                    self.record(now, "wire", f"flap:{method}#{n}")
+                    raise ConnectionError(
+                        f"injected wire flap on {method} (probe {n})")
+                continue
+            if not (r.nth <= n < r.nth + r.count):
+                continue
+            self.record(now, "wire", f"{r.kind}:{method}#{n}")
+            if r.kind in ("latency", "slow_handshake"):
+                from ..cloud.provider import ServerError
+                raise ServerError(
+                    f"injected wire {r.kind} on {method} (probe {n}): "
+                    f"deadline exceeded")
+            raise ConnectionResetError(
+                f"injected wire reset on {method} (probe {n})")
+
+    def on_wire_reply(self, method: str, raw: bytes) -> bytes:
+        """The reply-side seam (`transport.set_wire_reply_hook`):
+        returns the reply frame's bytes, garbled when a corrupt_frame
+        WireFault covers this eligible reply — the first byte is XORed
+        so the frame can no longer parse as JSON, forcing the transport
+        to reject it as a transport failure instead of decoding it."""
+        if not self.wire_faults:
+            return raw
+        now = self.clock.now() if self.clock is not None else 0.0
+        rel = now - self.origin
+        out = raw
+        for i, r in enumerate(self.wire_faults):
+            if r.kind != "corrupt_frame":
+                continue
+            if r.methods is not None and method not in r.methods:
+                continue
+            if not (r.at <= rel < r.at + r.window):
+                continue
+            n = self._wire_counts.get(("reply", i), 0) + 1
+            self._wire_counts[("reply", i)] = n
+            if not (r.nth <= n < r.nth + r.count):
+                continue
+            self.record(now, "wire", f"corrupt_frame:{method}#{n}")
+            out = (bytes([out[0] ^ 0xFF]) + out[1:]) if out else b"\xff"
+        return out
+
+    @property
+    def has_wire_faults(self) -> bool:
+        return bool(self.wire_faults)
 
     def on_crash_point(self, point: str) -> None:
         """The utils.crashpoints hook (armed by injector.crash_point_hook):

@@ -64,11 +64,23 @@ class SolverServer:
 
     def __init__(self, mesh=None, run_id: str = "",
                  use_resident: bool = True,
-                 max_catalogs: int = MAX_CATALOGS):
+                 max_catalogs: int = MAX_CATALOGS,
+                 generation: int = 1,
+                 compress_capability: bool = True):
         self.mesh = mesh
         self.run_id = run_id
         self.use_resident = use_resident
         self.max_catalogs = max_catalogs
+        # boot generation: minted at start, stamped into EVERY reply
+        # frame, advanced by restart() — the client's generation guard
+        # rejects frames from an older boot (split-brain) and treats a
+        # newer one as "the server restarted: re-handshake, re-announce"
+        self.generation = int(generation)
+        # capability, not schema: whether this boot decodes zlib'd
+        # pack_array payloads. A version-skew restart can come back
+        # WITHOUT it — the re-handshake is what tells clients to drop
+        # to uncompressed frames
+        self.compress_capability = bool(compress_capability)
         self._catalogs: "OrderedDict[tuple, DeviceCatalog]" = OrderedDict()
         # one dispatch at a time: the solver stack (resident manager,
         # compile-cache bookkeeping) is plain mutable Python — same
@@ -81,15 +93,40 @@ class SolverServer:
             "padded_rows": 0, "reports": 0, "unknown_token": 0,
             # largest padded batch one device call carried — x mesh size
             # this is the bench's c17_mesh_batch_capacity observable
-            "max_bucket_rows": 0,
+            "max_bucket_rows": 0, "healthz": 0, "restarts": 0,
+            "compress_rejected": 0,
         }
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def restart(self, generation: Optional[int] = None,
+                compress_capability: Optional[bool] = None) -> None:
+        """The in-process crash-restart drill: drop everything a process
+        death loses — the catalog store and the mirrored-report ledger —
+        and come back under a NEW boot generation (next integer unless
+        pinned). compress_capability models a version-skew restart: the
+        rebooted binary may no longer speak the compression capability,
+        and only the client's re-handshake can discover that. Cumulative
+        stats survive on purpose (they model the operator's external
+        view, and the reupload accounting reads them across the boot)."""
+        with self._lock:
+            self._catalogs.clear()
+            self.reports.clear()
+            self.generation = (int(generation) if generation is not None
+                               else self.generation + 1)
+            if compress_capability is not None:
+                self.compress_capability = bool(compress_capability)
+            self.stats["restarts"] += 1
 
     # --- dispatch boundary -------------------------------------------------
 
     def handle(self, method: str, payload: dict) -> dict:
-        """One RPC: {"result": ...} or {"error": <taxonomy envelope>}.
-        Schema skew is rejected before the body is interpreted, same
-        contract as the HTTP layer's X-Wire-Schema check."""
+        """One RPC: {"result": ...} or {"error": <taxonomy envelope>},
+        plus the boot generation stamped into EVERY reply frame (errors
+        included — a NotFoundError from a rebooted server is exactly the
+        frame that tells the client to re-announce). Schema skew is
+        rejected before the body is interpreted, same contract as the
+        HTTP layer's X-Wire-Schema check."""
         try:
             fn = getattr(self, f"_rpc_{method}", None)
             if fn is None:
@@ -101,12 +138,13 @@ class SolverServer:
             if declared is not None and declared != WIRE_SCHEMA_VERSION:
                 raise WireVersionError(WIRE_SCHEMA_VERSION, declared)
             with self._lock:
-                return {"result": fn(payload)}
+                return {"result": fn(payload), "gen": self.generation}
         except CloudError as e:
-            return {"error": encode_error(e)}
+            return {"error": encode_error(e), "gen": self.generation}
         except Exception as e:  # noqa: BLE001 — the process boundary
             return {"error": encode_error(
-                ServerError(f"{type(e).__name__}: {e}"))}
+                ServerError(f"{type(e).__name__}: {e}")),
+                "gen": self.generation}
 
     # --- RPCs --------------------------------------------------------------
 
@@ -115,10 +153,34 @@ class SolverServer:
         return {"wire_schema": WIRE_SCHEMA_VERSION, "run_id": self.run_id,
                 "mesh_devices": int(self.mesh.size) if self.mesh else 1,
                 "resident": bool(self.use_resident),
+                "generation": self.generation,
                 # capability, not schema: this server decodes zlib'd
                 # pack_array payloads ("z": 1). Old clients ignore the
                 # key and keep sending uncompressed — which still decodes
-                "compress": True}
+                "compress": self.compress_capability}
+
+    def _rpc_healthz(self, payload: dict) -> dict:
+        """The circuit-breaker's probe target: cheap (no lock contention
+        beyond handle's, no tensors) and generation-stamped like every
+        reply, so a probe against a rebooted server doubles as the
+        restart-discovery RPC."""
+        self.stats["healthz"] += 1
+        return {"ok": True, "wire_schema": WIRE_SCHEMA_VERSION}
+
+    def _reject_compressed(self, *packed) -> None:
+        """A boot without the compress capability cannot decode a "z"
+        payload — fail LOUDLY with a structured error (carrying the new
+        generation in the frame) instead of feeding zlib bytes to the
+        codec; the client answers by re-handshaking and dropping to
+        uncompressed frames."""
+        if self.compress_capability:
+            return
+        for p in packed:
+            if isinstance(p, dict) and p.get("z"):
+                self.stats["compress_rejected"] += 1
+                raise CloudError(
+                    "compressed frame against a server without the "
+                    "compress capability — re-handshake required")
 
     def _rpc_has_catalog(self, payload: dict) -> dict:
         """Token announce. `R` is the client's resource width: the same
@@ -140,6 +202,7 @@ class SolverServer:
     def _rpc_put_catalog(self, payload: dict) -> dict:
         env = decode_envelope(payload)
         assert isinstance(env, CatalogUploadEnvelope)
+        self._reject_compressed(env.alloc, env.price, env.avail, env.ovh_z)
         token = self._token(env.token)
         ent = self._catalogs.get(token)
         if ent is not None and int(ent.alloc.shape[1]) >= int(env.R):
@@ -174,6 +237,7 @@ class SolverServer:
         import time as _time
         env = decode_envelope(payload)
         assert isinstance(env, SolveBucketRequest)
+        self._reject_compressed(env.gbuf, env.conf)
         token = self._token(env.token)
         dcat = self._catalogs.get(token)
         if dcat is None:
@@ -207,7 +271,8 @@ class SolverServer:
         # arrived zlib'd proves the peer decodes it, so the reply rows
         # may compress too; an uncompressed request gets uncompressed
         # rows (old clients never see a "z" payload)
-        zcap = bool(isinstance(env.gbuf, dict) and env.gbuf.get("z"))
+        zcap = (self.compress_capability
+                and bool(isinstance(env.gbuf, dict) and env.gbuf.get("z")))
         return encode_envelope(SolveBucketResult(
             schema=WIRE_SCHEMA_VERSION, run_id=env.run_id,
             rows=pack_array(rows, compress=zcap), span_s=span_s,
@@ -265,7 +330,8 @@ def make_fed_server(server: SolverServer, host: str = "127.0.0.1",
         def do_GET(self):
             if self.path == "/healthz":
                 self._send(200, {"ok": True,
-                                 "wire_schema": WIRE_SCHEMA_VERSION})
+                                 "wire_schema": WIRE_SCHEMA_VERSION,
+                                 "gen": server.generation})
             else:
                 self._send(404, {"error": {"type": "NotFoundError",
                                            "msg": self.path}})
@@ -322,6 +388,14 @@ def main(argv: Optional[list] = None) -> int:
                    help="lay bucket batch axes over all local devices")
     p.add_argument("--no-resident", action="store_true",
                    help="disable the device-resident stack path")
+    p.add_argument("--generation", type=int, default=1,
+                   help="boot generation stamped into every reply frame "
+                        "(a restarted server MUST come back with a "
+                        "higher one — the crash-restart drill passes "
+                        "prior+1)")
+    p.add_argument("--no-compress", action="store_true",
+                   help="model a version-skew restart: this boot does "
+                        "not speak the compression capability")
     p.add_argument("--ready-delay", type=float, default=0.0,
                    help="test hook: sleep before binding")
     args = p.parse_args(argv)
@@ -332,7 +406,9 @@ def main(argv: Optional[list] = None) -> int:
         from ..parallel.mesh import make_batch_mesh
         mesh = make_batch_mesh()
     server = SolverServer(mesh=mesh, run_id=args.run_id,
-                          use_resident=not args.no_resident)
+                          use_resident=not args.no_resident,
+                          generation=args.generation,
+                          compress_capability=not args.no_compress)
     srv = make_fed_server(server, args.host, args.port)
     print(f"READY {srv.server_address[1]}", flush=True)
     try:

@@ -27,7 +27,9 @@ bench's ``c17_wire_overhead_frac``.
 from __future__ import annotations
 
 import json
+import os
 import socket
+import time
 from typing import Callable, Optional
 
 from ..cloud.remote import (WIRE_SCHEMA_VERSION, ServerError,
@@ -35,10 +37,42 @@ from ..cloud.remote import (WIRE_SCHEMA_VERSION, ServerError,
 from ..metrics import FEDERATION_RPCS, FEDERATION_WIRE_BYTES
 from ..obs.tracer import NOOP_SPAN, TRACER
 
+
+def fed_timeout() -> float:
+    """Per-RPC wire deadline in seconds — the KARPENTER_TPU_FED_TIMEOUT
+    env knob (utils/options.ENV_KNOBS). Read per-transport-construction
+    so tests can tighten it without rebuilding module state."""
+    try:
+        return float(os.environ.get("KARPENTER_TPU_FED_TIMEOUT", "") or 10.0)
+    except ValueError:
+        return 10.0
+
+
+class StaleGenerationError(RuntimeError):
+    """A reply frame carried a boot generation OLDER than the one this
+    client has already observed — a split-brain server (or a delayed
+    frame from a dead boot). The frame is rejected by the generation
+    guard BEFORE any envelope decoding; the client never acts on state
+    from a superseded boot. Not retryable: a stale peer does not heal
+    by re-asking it."""
+
+    def __init__(self, known, got, method: str = ""):
+        self.known, self.got, self.method = known, got, method
+        super().__init__(
+            f"stale federation generation on {method or 'rpc'}: reply "
+            f"from boot generation {got}, but generation {known} was "
+            f"already observed — split-brain guard rejected the frame")
+
+
 # Test seam: faults/injector.py arms this to kill the wire mid-run (the
 # "server crash" fault family). Called with the method name before every
 # RPC; raising simulates the transport failing at that point.
 _wire_fault_hook: Optional[Callable[[str], None]] = None
+
+# Reply-side seam: called with (method, raw reply bytes) after the reply
+# is serialized/read and before it is parsed; returns the (possibly
+# garbled) bytes — the corrupt_frame WireFault family fires here.
+_wire_reply_hook: Optional[Callable[[str, bytes], bytes]] = None
 
 
 def set_wire_fault_hook(hook: Optional[Callable[[str], None]]):
@@ -50,9 +84,24 @@ def set_wire_fault_hook(hook: Optional[Callable[[str], None]]):
     return prev
 
 
+def set_wire_reply_hook(hook: Optional[Callable[[str, bytes], bytes]]):
+    """Install (or clear, with None) the reply-frame seam. Returns the
+    previous hook so context managers can restore it."""
+    global _wire_reply_hook
+    prev = _wire_reply_hook
+    _wire_reply_hook = hook
+    return prev
+
+
 def _probe_wire_fault(method: str):
     if _wire_fault_hook is not None:
         _wire_fault_hook(method)
+
+
+def _probe_wire_reply(method: str, raw: bytes) -> bytes:
+    if _wire_reply_hook is not None:
+        return _wire_reply_hook(method, raw)
+    return raw
 
 
 class InMemoryTransport:
@@ -67,6 +116,11 @@ class InMemoryTransport:
 
     def __init__(self, server):
         self.server = server
+        # last boot generation seen on a reply frame, and the client's
+        # split-brain guard (FederatedSolverClient installs it) — called
+        # with (gen, method) BEFORE the frame's result/error is decoded
+        self.last_gen = None
+        self.gen_guard: Optional[Callable] = None
 
     def call(self, method: str, payload: dict) -> dict:
         _probe_wire_fault(method)
@@ -78,12 +132,37 @@ class InMemoryTransport:
             reply = self.server.handle(method, json.loads(body.decode("utf-8")))
             raw = json.dumps(reply, sort_keys=True).encode("utf-8")
             FEDERATION_WIRE_BYTES.inc(len(raw), direction="received")
-            obj = json.loads(raw.decode("utf-8"))
+            raw = _probe_wire_reply(method, raw)
+            try:
+                obj = json.loads(raw.decode("utf-8"))
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                FEDERATION_RPCS.inc(method=method, outcome="transport")
+                raise ServerError(
+                    f"federation RPC {method}: corrupt reply frame ({e})")
+        _check_generation(self, method, obj)
         if "error" in obj:
             FEDERATION_RPCS.inc(method=method, outcome="error")
             raise decode_error(obj["error"])
         FEDERATION_RPCS.inc(method=method, outcome="ok")
         return obj.get("result")
+
+
+def _check_generation(transport, method: str, obj) -> None:
+    """Record the reply frame's boot generation and run the client's
+    split-brain guard (when installed) before the frame is decoded. A
+    StaleGenerationError from the guard is metered as its own RPC
+    outcome and propagates — the frame is never interpreted."""
+    gen = obj.get("gen") if isinstance(obj, dict) else None
+    if gen is None:
+        return
+    transport.last_gen = gen
+    if transport.gen_guard is None:
+        return
+    try:
+        transport.gen_guard(gen, method)
+    except StaleGenerationError:
+        FEDERATION_RPCS.inc(method=method, outcome="stale")
+        raise
 
 
 class HTTPTransport:
@@ -92,11 +171,21 @@ class HTTPTransport:
     Modeled on RemoteCloud._call: the same error taxonomy (timeouts and
     dropped connections → retryable ServerError; structured envelopes
     reconstruct their original class, including the non-retryable
-    WireVersionError) and the same X-Wire-Schema header contract.
+    WireVersionError) and the same X-Wire-Schema header contract. The
+    per-RPC deadline defaults to the KARPENTER_TPU_FED_TIMEOUT knob.
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
-        self.host, self.port, self.timeout = host, port, timeout
+    # real wall waits between retry attempts happen only on this
+    # transport — the in-memory transport has no socket to wait out, so
+    # the client's backoff there is pure bookkeeping
+    retry_sleep = staticmethod(time.sleep)
+
+    def __init__(self, host: str, port: int,
+                 timeout: Optional[float] = None):
+        self.host, self.port = host, port
+        self.timeout = fed_timeout() if timeout is None else timeout
+        self.last_gen = None
+        self.gen_guard: Optional[Callable] = None
 
     def call(self, method: str, payload: dict) -> dict:
         import http.client
@@ -127,10 +216,17 @@ class HTTPTransport:
                 raise ServerError(
                     f"federation RPC {method} transport failure: {e}")
             FEDERATION_WIRE_BYTES.inc(len(raw), direction="received")
+            raw = _probe_wire_reply(method, raw)
             try:
                 obj = json.loads(raw) if raw else {}
-            except json.JSONDecodeError:
-                obj = {}
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                # a frame that does not parse is indistinguishable from
+                # line noise: reject as a retryable transport failure,
+                # never guess at its contents
+                FEDERATION_RPCS.inc(method=method, outcome="transport")
+                raise ServerError(
+                    f"federation RPC {method}: corrupt reply frame ({e})")
+        _check_generation(self, method, obj)
         if "error" in obj:
             FEDERATION_RPCS.inc(method=method, outcome="error")
             raise decode_error(obj["error"])

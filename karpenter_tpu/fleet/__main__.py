@@ -36,7 +36,10 @@ def run_matrix(scenario: str, seeds, repeat: int = 1, **runner_kwargs) -> bool:
             print(rep.summary())
             failed |= not rep.ok
         if repeat > 1:
-            digests = {(rep.fleet_hash, rep.fleet_fingerprint)
+            # three digests per run: end states, per-tenant fault
+            # timelines, and the fleet-level wire-weather timeline
+            digests = {(rep.fleet_hash, rep.fleet_fingerprint,
+                        rep.wire_fingerprint)
                        for rep in reports}
             if len(digests) != 1:
                 print(f"[FAIL] {scenario}: {repeat} runs at seed {seed} "
@@ -104,7 +107,15 @@ def main(argv=None) -> int:
                          batch=args.batch or None,
                          inflight_cap=args.inflight_cap or None,
                          journal_dir=args.journal_dir or None)
-    if args.federate:
+    sc_meta = FLEET_SCENARIOS.get(args.scenario)
+    if args.federate and sc_meta is not None and sc_meta.federate \
+            and not args.server_addr:
+        # federate-by-default scenarios (fed_*) already build their own
+        # embedded server inside FleetRunner — and must, so mid-run
+        # actuators (the fed_server_restart drive hook) can reach it.
+        # --federate is then redundant; a --server-addr still overrides.
+        pass
+    elif args.federate:
         from ..federation import build_federated_service
         # federation only engages for device-batchable buckets: a host
         # backend would stage nothing for the wire and silently test the

@@ -39,6 +39,10 @@ class FleetReport:
     tenant_fingerprints: Dict[str, str]
     sim_seconds: float
     stats: Dict[str, float] = field(default_factory=dict)
+    # canonical timeline digest of the FLEET-level wire plan (WireFault
+    # firings + server restarts) — "" when the scenario has no wire
+    # plan. Part of the repeat contract alongside the two digests below
+    wire_fingerprint: str = ""
     # observatory attachments (never part of the determinism contract —
     # fleet_hash/fleet_fingerprint ignore them):
     slo: Dict[str, object] = field(default_factory=dict)
@@ -92,7 +96,8 @@ class FleetRunner:
                  journal_dir: Optional[str] = None,
                  warmpath: Optional[bool] = None,
                  batch: Optional[bool] = None,
-                 service_factory=None):
+                 service_factory=None,
+                 federate: Optional[bool] = None):
         self.scenario: FleetScenario = (
             scenario if isinstance(scenario, FleetScenario)
             else get_fleet_scenario(scenario))
@@ -116,6 +121,16 @@ class FleetRunner:
         # cross-process determinism contract is asserted BY running the
         # same scenario through both factories.
         self.service_factory = service_factory
+        # federate-by-default scenarios (fed_*) build their own embedded
+        # server + factory in build(); federate=False forces the
+        # in-process arm of the same scenario (the parity drill)
+        self.federate = (self.scenario.federate if federate is None
+                         else bool(federate))
+        self.fed_server = None     # embedded SolverServer when federated
+        # FLEET-level wire FaultPlan (scenario.wire_rules): WireFault
+        # weather + drive-hook events on ONE canonical timeline, seeded
+        # from the fleet seed — FleetReport.wire_fingerprint
+        self.wire_plan = None
         self.clock: Optional[FakeClock] = None
         self.service: Optional[SolverService] = None
         self.shards: List[TenantShard] = []
@@ -130,6 +145,30 @@ class FleetRunner:
         sc = self.scenario
         self.clock = FakeClock()
         self.origin = self.clock.now()
+        if self.federate and self.service_factory is None:
+            # federate-by-default: embed one SolverServer the runner can
+            # also actuate (the fed_server_restart drive hook reboots
+            # it) behind the in-memory wire. Federation engages only for
+            # device-batchable buckets, so force the same shape the
+            # CLI's --federate does.
+            from ..federation.server import SolverServer
+            self.batch = True
+            if self.backend == "host":
+                self.backend = "device"
+            self.fed_server = SolverServer(run_id=f"fed-{sc.name}")
+
+            def _factory(clock, kw, _srv=self.fed_server,
+                         _sc=sc.name):
+                from ..federation import build_federated_service
+                return build_federated_service(
+                    clock, run_id=f"fed-{_sc}", shared_server=_srv, **kw)
+            self.service_factory = _factory
+        if sc.wire_rules is not None:
+            from ..faults.plan import FaultPlan
+            self.wire_plan = FaultPlan(seed=self.seed,
+                                       rules=sc.wire_rules())
+            self.wire_plan.clock = self.clock
+            self.wire_plan.origin = self.origin
         service_kwargs = dict(backend=self.backend,
                               inflight_cap=self.inflight_cap,
                               quantum=sc.quantum, window=sc.window,
@@ -150,7 +189,8 @@ class FleetRunner:
                 journal_dir=self.journal_dir))
 
     def run(self) -> FleetReport:
-        from ..faults.injector import fleet_device_fault_hook
+        from ..faults.injector import (fleet_device_fault_hook,
+                                       wire_fault_plan_hook)
         from ..faults.runner import check_invariants, state_hash
         sc = self.scenario
         if not self.shards:
@@ -181,8 +221,11 @@ class FleetRunner:
         deadline = clock.now() + sc.timeout
         plans = {s.name: s.plan for s in self.shards if s.plan is not None}
         converged = False
-        with fleet_device_fault_hook(plans):
+        with fleet_device_fault_hook(plans), \
+                wire_fault_plan_hook(self.wire_plan):
             while clock.now() < deadline:
+                if sc.drive is not None:
+                    sc.drive(self, clock.now() - self.origin)
                 for shard in self.shards:
                     shard.tick()
                 self.slo.tick()
@@ -256,13 +299,22 @@ class FleetRunner:
             stats["federation_catalog_uploads"] = float(cstats["uploads"])
             stats["federation_announce_hits"] = float(
                 cstats["announce_hits"])
+            stats["federation_retries"] = float(cstats["retries"])
+            stats["federation_rejoins"] = float(fs["rejoins"])
+            stats["federation_generation_changes"] = float(
+                cstats["generation_changes"])
+        if self.wire_plan is not None:
+            stats["wire_faults_injected"] = float(
+                len(self.wire_plan.timeline))
         stats["slo_alerts"] = float(len(self.slo.alerts))
         stats["watchdog_findings"] = fleet_findings
         report = FleetReport(
             scenario=sc.name, seed=self.seed, tenants=self.tenants,
             converged=converged, violations=violations,
             tenant_hashes=hashes, tenant_fingerprints=fingerprints,
-            sim_seconds=clock.now() - self.origin, stats=stats)
+            sim_seconds=clock.now() - self.origin, stats=stats,
+            wire_fingerprint=(self.wire_plan.fingerprint()
+                              if self.wire_plan is not None else ""))
         report.slo = self.slo.payload()
         # causal trail: any tenant the service throttled gets one
         # explained pod attached (throttle count + the funnel of the

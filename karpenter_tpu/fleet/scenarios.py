@@ -20,7 +20,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from ..faults.plan import ApiFault, IceWindow
+from ..faults.plan import ApiFault, IceWindow, WireFault
 
 
 @dataclass(frozen=True)
@@ -41,6 +41,19 @@ class FleetScenario:
     inflight_cap: Optional[int] = None   # SolverService override
     window: Optional[float] = None
     quantum: Optional[float] = None
+    # route buckets through the federation plane by default (the CLI's
+    # --federate forces this on; FleetRunner(federate=False) forces the
+    # in-process arm of the same scenario for parity drills)
+    federate: bool = False
+    # () -> WireFault rules for the FLEET-level wire plan (seeded from
+    # the fleet seed; fires through the federation transport seams). A
+    # non-None value — even one returning [] — makes the runner mint the
+    # plan, so drive hooks can record onto its canonical timeline
+    wire_rules: Optional[Callable[[], List[object]]] = None
+    # (runner, rel_time) -> None: called every fleet loop iteration with
+    # run-relative sim time — the mid-run actuator seam (e.g. the
+    # fed_server_restart scenario reboots the embedded server with it)
+    drive: Optional[Callable] = None
     # (runner, report) -> None: append scenario verdicts to the report
     # (stats and, on failure, violations)
     analyze: Optional[Callable] = None
@@ -327,6 +340,208 @@ _register(FleetScenario(
     timeout=240.0,
     batch=True,
     analyze=_federation_analyze))
+
+# --- federation resilience scenarios ---------------------------------------
+# Wire weather over the federated fleet: every scenario runs the same
+# shaped workload (a uniform first wave for co-batching, a seeded
+# mid-run trickle, then LATE waves well past the fault window so the
+# breaker has post-weather traffic to probe and rejoin on — a fleet
+# that converges while still degraded proves only that the local path
+# works). The WireFault rules live on a FLEET-level plan (seeded from
+# the fleet seed, recorded on its own canonical timeline →
+# FleetReport.wire_fingerprint), not on any tenant's plan: the wire is
+# shared infrastructure, and its weather must not perturb per-tenant
+# fingerprints — that is exactly what lets the parity drill compare a
+# federated run's tenant digests against the in-process run's.
+
+
+def _fedchaos_workload(i: int, name: str):
+    def workload(sim, rng):
+        second = 2 + rng.randrange(3)         # 2..4 pods
+        at = 10.0 + rng.randrange(6)          # 10..15s
+        _waved([(0.0, 6, "w0", "500m", "1Gi"),
+                (at, second, "w1", "250m", "512Mi"),
+                (70.0, 3, "w2", "250m", "512Mi"),
+                (82.0, 2, "w3", "250m", "512Mi")])(sim, rng)
+    return workload
+
+
+def _fed_resilience_stats(runner, report) -> dict:
+    """Shared verdict base for the wire-weather scenarios: surface every
+    resilience meter, and flag the invariants NO amount of weather may
+    break — buckets crossed the wire at some point, zero stale frames
+    decoded, and the run did not END degraded (the ladder must have
+    closed the breaker once the weather passed)."""
+    svc = runner.service
+    fed_state = getattr(svc, "federation_state", None)
+    if fed_state is None:
+        return None  # in-process parity arm: digests only, no wire
+    fs = fed_state()
+    report.stats.update({
+        "federation_degraded": float(fs["degraded"]),
+        "federation_rejoins": float(fs["rejoins"]),
+        "federation_last_rejoin_ms": float(fs["last_rejoin_ms"]),
+        "federation_retries": float(fs["retries"]),
+        "federation_probes_ok": float(fs["probes_ok"]),
+        "federation_probes_fail": float(fs["probes_fail"]),
+        "federation_generation_changes": float(fs["generation_changes"]),
+        "federation_stale_rejected": float(fs["stale_rejected"]),
+        "federation_reupload_bytes": float(fs["reupload_bytes"]),
+    })
+    if fs["wire_buckets"] == 0:
+        report.violations.append(
+            "federated run but no bucket ever crossed the wire — the "
+            "whole fleet silently ran the local path")
+    if fs["stale_decoded"]:
+        report.violations.append(
+            f"{fs['stale_decoded']} stale-generation frame(s) were "
+            f"DECODED — the split-brain guard failed")
+    if fs["degraded"]:
+        report.violations.append(
+            f"run ended stuck degraded (breaker {fs['breaker']}, "
+            f"cooldown {fs['cooldown']}) — the rejoin ladder never "
+            f"closed the breaker after the weather passed")
+    return fs
+
+
+def _paged(runner, invariant: str) -> bool:
+    wd = getattr(runner, "watchdog", None)
+    return wd is not None and any(f.invariant == invariant
+                                  for f in wd.findings)
+
+
+def _fed_flap_analyze(runner, report) -> None:
+    fs = _fed_resilience_stats(runner, report)
+    if fs is None:
+        return
+    if not fs["failures"]:
+        report.violations.append(
+            "flap window injected but no wire failure was ever observed")
+    if not fs["rejoins"]:
+        report.violations.append(
+            "wire degraded under the flap but never rejoined — the "
+            "breaker's probe/trial ladder did not recover")
+    if fs["failures"] and not _paged(runner, "federation_degraded"):
+        report.violations.append(
+            "wire failures degraded dispatch but the watchdog's "
+            "federation_degraded invariant never paged")
+
+
+def _fed_partition_analyze(runner, report) -> None:
+    fs = _fed_resilience_stats(runner, report)
+    if fs is None:
+        return
+    if not fs["probes_fail"]:
+        report.violations.append(
+            "blackhole window but every healthz probe passed — the "
+            "partition never reached the breaker's probe path")
+    if not fs["rejoins"]:
+        report.violations.append(
+            "partition healed but the wire never rejoined")
+    if fs["failures"] and not _paged(runner, "federation_degraded"):
+        report.violations.append(
+            "partition degraded dispatch but the watchdog's "
+            "federation_degraded invariant never paged")
+
+
+def _fed_restart_analyze(runner, report) -> None:
+    fs = _fed_resilience_stats(runner, report)
+    if fs is None:
+        return
+    svc = runner.service
+    if fs["generation_changes"] != 1:
+        report.violations.append(
+            f"expected exactly one observed boot-generation change "
+            f"across the restart, saw {fs['generation_changes']:g}")
+    if fs["failures"]:
+        report.violations.append(
+            f"a clean restart cost {fs['failures']:g} wire failure(s) — "
+            f"recovery must ride the generation protocol, not the "
+            f"degrade ladder")
+    if not fs["reupload_bytes"]:
+        report.violations.append(
+            "server restarted but no catalog tensors were re-uploaded — "
+            "the new boot is serving solves against state it cannot hold")
+    uploads = svc.fed.stats["uploads"]
+    views = max(1, svc.shared_catalog.stats["misses"])
+    report.stats["catalog_uploads"] = float(uploads)
+    report.stats["catalog_views_minted"] = float(views)
+    if uploads > 2 * views:
+        report.violations.append(
+            f"catalog tensors crossed the wire {uploads} times for "
+            f"{views} distinct view(s) across ONE restart — tokens must "
+            f"re-announce exactly once per boot")
+
+
+def _restart_drive(runner, rel: float) -> None:
+    """Reboot the embedded server once, mid-fleet: generation bumps,
+    catalogs and ledger clear — the client side must recover through
+    the generation protocol alone. Recorded on the fleet wire plan's
+    canonical timeline so the restart rides the wire fingerprint."""
+    if rel < 40.0 or getattr(runner, "_fed_restarted", False):
+        return
+    srv = getattr(runner, "fed_server", None)
+    if srv is None:
+        return  # in-process parity arm: nothing to reboot
+    runner._fed_restarted = True
+    srv.restart()
+    if runner.wire_plan is not None:
+        runner.wire_plan.record(runner.clock.now(), "wire",
+                                f"server_restart:gen{srv.generation}")
+
+
+_register(FleetScenario(
+    name="fed_flap",
+    description="A 15s flapping wire window over the federated fleet "
+                "(every other pair of solve RPCs dies mid-flight): the "
+                "breaker must open, probe, trial, and rejoin — "
+                "transient weather costs retries + a rejoin, never a "
+                "terminal local-only fleet. Tenant digests must match "
+                "the in-process arm.",
+    tenant_workload=_fedchaos_workload,
+    tenant_rules=lambda i, n: [],
+    tenants=8,
+    timeout=240.0,
+    batch=True,
+    federate=True,
+    wire_rules=lambda: [WireFault(kind="flap", at=3.0, window=15.0,
+                                  nth=2, methods=("solve_bucket",))],
+    analyze=_fed_flap_analyze))
+
+_register(FleetScenario(
+    name="fed_partition",
+    description="A 15s full wire blackhole (every RPC, healthz "
+                "included, dies at the socket): the breaker opens, "
+                "probes FAIL until the partition heals, then one clean "
+                "probe + trial rejoins the wire. The watchdog pages "
+                "federation_degraded while the partition holds.",
+    tenant_workload=_fedchaos_workload,
+    tenant_rules=lambda i, n: [],
+    tenants=8,
+    timeout=240.0,
+    batch=True,
+    federate=True,
+    wire_rules=lambda: [WireFault(kind="blackhole", at=3.0,
+                                  window=15.0)],
+    analyze=_fed_partition_analyze))
+
+_register(FleetScenario(
+    name="fed_server_restart",
+    description="The embedded federation server hard-restarts at t=40 "
+                "(generation bump, catalogs + ledger cleared): clients "
+                "must observe the new boot generation, re-handshake, "
+                "re-announce every token exactly once, and decode zero "
+                "stale frames — with end-state digests byte-identical "
+                "to the in-process arm of the same seed.",
+    tenant_workload=_fedchaos_workload,
+    tenant_rules=lambda i, n: [],
+    tenants=8,
+    timeout=240.0,
+    batch=True,
+    federate=True,
+    wire_rules=lambda: [],
+    drive=_restart_drive,
+    analyze=_fed_restart_analyze))
 
 _register(FleetScenario(
     name="fleet_noisy_neighbor",

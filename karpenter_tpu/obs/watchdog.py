@@ -123,6 +123,7 @@ INVARIANTS: Tuple[str, ...] = (
     "integrity_breach",
     "recompute_runaway",
     "federation_degraded",
+    "federation_rejoin",
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -143,6 +144,7 @@ _VIOLATION_MAP: Tuple[Tuple[str, str], ...] = (
     ("unbounded backlog", "overload_unbounded"),
     ("integrity violation", "integrity_breach"),
     ("wire failure", "federation_degraded"),
+    ("stuck degraded", "federation_rejoin"),
 )
 
 
@@ -218,6 +220,13 @@ class Watchdog:
     #                           as rising, not noise
     RECOMPUTE_MIN_UNITS = 256  # classified units (since arm) a stage
     #                           needs before its fraction is meaningful
+    REJOIN_GRACE = 60.0       # sim seconds the federation wire may sit
+    #                           degraded WITH passing healthz probes
+    #                           before the recovery ladder counts as
+    #                           stuck (probes failing = the server is
+    #                           genuinely down, and degraded is the
+    #                           correct steady state — only "healthy
+    #                           but never rejoined" is the bug)
     JUMP_THRESHOLD = 60.0     # dt above this is a clock jump, not aging
     MAX_FINDINGS = 256        # bounded finding log
 
@@ -671,6 +680,24 @@ class Watchdog:
                        failures=fs["failures"], cooldown=fs["cooldown"])
         else:
             self._clear("federation_degraded", "wire")
+        # the LADDER's own invariant: degraded past the grace while the
+        # healthz probes come back clean means the breaker is stuck —
+        # the recovery machinery itself is the bug, not the server
+        degraded_for = fs.get("degraded_for", 0.0)
+        if (fs.get("degraded") and degraded_for >= self.REJOIN_GRACE
+                and fs.get("probe_ok_degraded", 0) > 0):
+            self._fire(fired, "federation_rejoin", "warning", "wire",
+                       f"federation stuck degraded for "
+                       f"{degraded_for:.0f}s (grace "
+                       f"{self.REJOIN_GRACE:g}s) despite "
+                       f"{fs['probe_ok_degraded']} clean healthz "
+                       f"probe(s) — the rejoin ladder is not closing "
+                       f"the breaker (state {fs.get('breaker', '?')})",
+                       now, degraded_for=round(degraded_for, 1),
+                       probes_ok=fs["probe_ok_degraded"],
+                       breaker=fs.get("breaker", ""))
+        else:
+            self._clear("federation_rejoin", "wire")
 
     def _check_meters(self, now: float, fired: List[Finding]) -> None:
         from .profile import LEDGER

@@ -39,6 +39,11 @@ ENV_KNOBS: tuple = (
     ("KARPENTER_TPU_DURATIONS", "<repo>/scale_durations.jsonl",
      "duration-event JSONL sink for the scale suite "
      "(metrics/durations.py, the Timestream analog)"),
+    ("KARPENTER_TPU_FED_TIMEOUT", "10",
+     "federation per-RPC wire deadline in seconds (federation/"
+     "transport.py) — every HTTP transport call and handshake respects "
+     "it; a timed-out RPC surfaces as a retryable ServerError and "
+     "feeds the client's retry/breaker ladder"),
     ("KARPENTER_TPU_INTEGRITY", "1",
      "solution-integrity plane master gate — 0 restores the unverified "
      "solve path byte-for-byte (integrity/)"),

@@ -485,6 +485,160 @@ class TestRetryAfterOverTheWire:
         assert out2.retry_after is None
 
 
+class TestWireFaults:
+    """The federation wire-weather family: per-kind semantics driven
+    directly through the plan's on_wire/on_wire_reply seams, plus the
+    same-seed ⇒ identical-fingerprint contract every fault family
+    carries (docs/robustness.md)."""
+
+    @staticmethod
+    def _mk_plan(rules, seed=0):
+        from karpenter_tpu.faults import WireFault  # noqa: F401 (export)
+        plan = FaultPlan(seed=seed, rules=rules)
+        clock = FakeClock()
+        plan.clock = clock
+        plan.origin = clock.now()
+        return plan, clock
+
+    @staticmethod
+    def _drive(plan, clock, methods, step=1.0):
+        """Fire a fixed method sequence through both seams, swallowing
+        the injected raises; returns the per-probe outcome sequence."""
+        outcomes = []
+        for m in methods:
+            try:
+                plan.on_wire(m)
+                outcomes.append("ok")
+            except ServerError:
+                outcomes.append("slow")
+            except ConnectionResetError:
+                outcomes.append("reset")
+            except ConnectionError:
+                outcomes.append("down")
+            raw = plan.on_wire_reply(m, b'{"result": 1}')
+            outcomes.append("garbled" if raw != b'{"result": 1}' else "clean")
+            clock.step(step)
+        return outcomes
+
+    def test_blackhole_every_probe_in_window(self):
+        from karpenter_tpu.faults import WireFault
+        rule = WireFault(kind="blackhole", at=2.0, window=3.0)
+        plan, clock = self._mk_plan([rule])
+        seq = ["solve_bucket", "healthz"] * 4
+        out = self._drive(plan, clock, seq)
+        # t=0,1: pre-window clean; t=2,3,4: EVERY method down (probes
+        # included — a partition has no nth); t=5+: window lifted
+        assert out[0::2] == ["ok", "ok", "down", "down", "down",
+                             "ok", "ok", "ok"]
+        assert all(o == "clean" for o in out[1::2])
+        assert all(d.startswith("blackhole:") for _, k, d in plan.timeline)
+
+    def test_flap_alternates_runs_of_nth(self):
+        from karpenter_tpu.faults import WireFault
+        rule = WireFault(kind="flap", at=0.0, window=100.0, nth=2,
+                         methods=("solve_bucket",))
+        plan, clock = self._mk_plan([rule])
+        out = self._drive(plan, clock, ["solve_bucket"] * 8)[0::2]
+        # runs of nth=2: down,down,up,up,down,down,up,up
+        assert out == ["down", "down", "ok", "ok",
+                       "down", "down", "ok", "ok"]
+        # ineligible methods never count against the flap cadence
+        plan2, clock2 = self._mk_plan([rule])
+        out2 = self._drive(plan2, clock2,
+                           ["healthz", "solve_bucket"] * 4)[0::2]
+        assert out2 == ["ok", "down", "ok", "down",
+                        "ok", "ok", "ok", "ok"]
+
+    def test_latency_fires_nth_through_count_as_retryable(self):
+        from karpenter_tpu.faults import WireFault
+        rule = WireFault(kind="latency", at=0.0, window=100.0, nth=2,
+                         count=2)
+        plan, clock = self._mk_plan([rule])
+        out = self._drive(plan, clock, ["has_catalog"] * 5)[0::2]
+        assert out == ["ok", "slow", "slow", "ok", "ok"]
+        # the raise is the retry ladder's food: a retryable ServerError
+        plan2, clock2 = self._mk_plan([rule])
+        plan2.on_wire("has_catalog")
+        with pytest.raises(ServerError) as ei:
+            plan2.on_wire("has_catalog")
+        assert getattr(ei.value, "retryable", False)
+        assert "deadline exceeded" in str(ei.value)
+
+    def test_slow_handshake_only_connect_paths_eligible(self):
+        from karpenter_tpu.faults import WireFault
+        rule = WireFault(kind="slow_handshake", at=0.0, window=100.0,
+                         nth=1, count=1)
+        plan, clock = self._mk_plan([rule])
+        out = self._drive(plan, clock,
+                          ["solve_bucket", "put_catalog", "handshake",
+                           "healthz", "handshake"])[0::2]
+        # solves never count; the FIRST connect-path probe eats the stall
+        assert out == ["ok", "ok", "slow", "ok", "ok"]
+
+    def test_reset_raises_connection_reset(self):
+        from karpenter_tpu.faults import WireFault
+        plan, clock = self._mk_plan(
+            [WireFault(kind="reset", at=0.0, window=100.0, nth=1)])
+        with pytest.raises(ConnectionResetError):
+            plan.on_wire("report")
+        plan.on_wire("report")  # count spent: clean again
+
+    def test_corrupt_frame_garbled_reply_never_parses(self):
+        import json
+
+        from karpenter_tpu.faults import WireFault
+        rule = WireFault(kind="corrupt_frame", at=0.0, window=100.0,
+                         nth=2, count=1)
+        plan, clock = self._mk_plan([rule])
+        out = self._drive(plan, clock, ["solve_bucket"] * 3)
+        # request seam never fires for a reply-only kind
+        assert out[0::2] == ["ok", "ok", "ok"]
+        assert out[1::2] == ["clean", "garbled", "clean"]
+        garbled = FaultPlan(seed=0, rules=[WireFault(
+            kind="corrupt_frame", at=0.0, window=100.0, nth=1)])
+        garbled.clock = FakeClock()
+        garbled.origin = garbled.clock.now()
+        raw = garbled.on_wire_reply("solve_bucket", b'{"result": 1}')
+        with pytest.raises(Exception):
+            json.loads(raw.decode("utf-8", errors="strict"))
+
+    def test_same_seed_identical_fingerprint_per_kind(self):
+        from karpenter_tpu.faults import WireFault
+        seq = ["handshake", "has_catalog", "put_catalog", "solve_bucket",
+               "solve_bucket", "healthz", "solve_bucket", "report"] * 3
+        for kind in ("blackhole", "latency", "reset", "flap",
+                     "slow_handshake", "corrupt_frame"):
+            rule = WireFault(kind=kind, at=3.0, window=9.0, nth=2,
+                             count=2)
+            runs = []
+            for _ in range(2):
+                plan, clock = self._mk_plan([rule], seed=7)
+                out = self._drive(plan, clock, seq)
+                runs.append((out, plan.timeline, plan.fingerprint()))
+            assert runs[0] == runs[1], kind
+            assert runs[0][1], kind  # every kind actually fired
+            assert all(k == "wire" for _, k, _d in runs[0][1])
+
+    def test_wire_plan_hook_arms_and_restores_the_seams(self):
+        from karpenter_tpu.faults import WireFault
+        from karpenter_tpu.faults.injector import wire_fault_plan_hook
+        from karpenter_tpu.federation import transport as tmod
+        plan, clock = self._mk_plan(
+            [WireFault(kind="reset", at=0.0, window=100.0, nth=1)])
+        assert tmod._wire_fault_hook is None
+        assert tmod._wire_reply_hook is None
+        with wire_fault_plan_hook(plan):
+            assert tmod._wire_fault_hook is not None
+            assert tmod._wire_reply_hook is not None
+            with pytest.raises(ConnectionResetError):
+                tmod._wire_fault_hook("solve_bucket")
+        assert tmod._wire_fault_hook is None
+        assert tmod._wire_reply_hook is None
+        # a plan without wire rules never arms the seams (zero overhead)
+        with wire_fault_plan_hook(FaultPlan(seed=0)):
+            assert tmod._wire_fault_hook is None
+
+
 class TestScreenFaultSeam:
     def test_screen_fault_degrades_to_cost_order_metered(self):
         """The consolidation screen shares the solver's dispatch fault

@@ -31,7 +31,9 @@ from karpenter_tpu.federation.envelopes import (
     SolveBucketResult, WatchdogFindingEnvelope, decode_envelope,
     encode_envelope, pack_array, tensor_bytes, unpack_array)
 from karpenter_tpu.federation.server import SolverServer, serve_in_thread
-from karpenter_tpu.federation.transport import HTTPTransport, InMemoryTransport
+from karpenter_tpu.federation.transport import (HTTPTransport,
+                                                InMemoryTransport,
+                                                StaleGenerationError)
 from karpenter_tpu.fleet import FleetRunner
 from karpenter_tpu.models.nodepool import NodePool
 from karpenter_tpu.models.pod import Pod
@@ -345,6 +347,197 @@ class TestFederatedService:
 
 
 # ---------------------------------------------------------------------------
+# the retry/recovery ladder (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryLadder:
+    def _solve_wave(self, svc, client, prefix, n=4):
+        from karpenter_tpu.models.nodepool import NodePool
+        t = client.solve_async(mk_pods(n, prefix), NodePool(name="default"))
+        svc.pump()
+        return t.result()
+
+    def test_transient_latency_on_idempotent_rpc_retries(self, monkeypatch):
+        """Rung 1: a one-shot deadline-exceeded on has_catalog is
+        absorbed by the bounded retry — no failure, no cooldown, the
+        bucket still crosses the wire."""
+        from karpenter_tpu.faults import FaultPlan, WireFault
+        from karpenter_tpu.faults.injector import wire_fault_plan_hook
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        svc = mk_fed_service()
+        types = small_catalog()
+        c = svc.register("a", CatalogProvider(lambda: types))
+        plan = FaultPlan(seed=0, rules=[WireFault(
+            kind="latency", at=0.0, window=1e9, nth=1, count=1,
+            methods=("has_catalog",))])
+        plan.clock = svc.clock
+        plan.origin = svc.clock.now()
+        with wire_fault_plan_hook(plan):
+            res = self._solve_wave(svc, c, "w0")
+        assert res.launches
+        assert svc.fed.stats["retries"] == 1
+        assert svc._fed_failures == 0 and svc._fed_cooldown == 0
+        assert svc.fed_stats["wire_buckets"] >= 1
+        assert svc.fed.stats["uploads"] == 1
+        # the injected stall rode the plan's canonical timeline
+        assert any(d.startswith("latency:has_catalog")
+                   for _, k, d in plan.timeline)
+
+    def test_solve_bucket_never_blind_retries(self, monkeypatch):
+        """solve_bucket is NOT idempotent: a reset mid-solve goes to the
+        degrade path (host-solve + breaker), never a blind resend."""
+        from karpenter_tpu.faults import FaultPlan, WireFault
+        from karpenter_tpu.faults.injector import wire_fault_plan_hook
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        svc = mk_fed_service()
+        types = small_catalog()
+        c = svc.register("a", CatalogProvider(lambda: types))
+        plan = FaultPlan(seed=0, rules=[WireFault(
+            kind="reset", at=0.0, window=1e9, nth=1, count=1,
+            methods=("solve_bucket",))])
+        plan.clock = svc.clock
+        plan.origin = svc.clock.now()
+        with wire_fault_plan_hook(plan):
+            res = self._solve_wave(svc, c, "w0")
+        assert res.launches                      # host-solved, still served
+        assert svc.fed.stats["retries"] == 0     # no blind retry
+        assert svc.fed.stats["solve_rpcs"] == 1  # exactly one attempt
+        assert svc._fed_failures == 1
+        assert svc._breaker == "open"
+
+    def test_breaker_probes_and_rejoins_after_cooldown(self, monkeypatch):
+        """Rungs 3-5: failure opens the breaker; the cooldown drains
+        bucket by bucket on the local path; a clean healthz probe
+        half-opens; the trial bucket closes it and meters the
+        degraded→rejoin latency."""
+        from karpenter_tpu.faults.injector import wire_fault_hook
+        from karpenter_tpu.federation.client import FED_COOLDOWN
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        svc = mk_fed_service()
+        types = small_catalog()
+        c = svc.register("a", CatalogProvider(lambda: types))
+        with wire_fault_hook(fail_methods=("solve_bucket",), after=0):
+            assert self._solve_wave(svc, c, "w0").launches
+        assert svc._breaker == "open"
+        assert svc._degraded_since is not None
+        # drain the cooldown: each bucket decrements; the last one
+        # probes, half-opens, and serves as the trial
+        for i in range(FED_COOLDOWN):
+            svc.clock.step(1.0)
+            assert self._solve_wave(svc, c, f"r{i}").launches
+        assert svc._breaker == "closed"
+        assert svc.fed_stats["rejoins"] == 1
+        assert svc.fed_stats["probes_ok"] == 1
+        assert svc.fed_stats["local_buckets"] == FED_COOLDOWN - 1
+        assert svc.fed_stats["last_rejoin_ms"] > 0
+        assert svc.fed.stats["probes"] == 1
+        assert svc._degraded_since is None and svc._probe_ok_degraded == 0
+        # the trial bucket crossed the wire
+        assert svc.fed_stats["wire_buckets"] >= 1
+
+    def test_failed_probe_rearms_cooldown(self, monkeypatch):
+        """A dead wire at probe time re-arms a full cooldown — the
+        breaker stays open and the fleet stays on the local path."""
+        from karpenter_tpu.faults.injector import wire_fault_hook
+        from karpenter_tpu.federation.client import FED_COOLDOWN
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        svc = mk_fed_service()
+        types = small_catalog()
+        c = svc.register("a", CatalogProvider(lambda: types))
+        with wire_fault_hook(fail_methods=("solve_bucket", "healthz"),
+                             after=0):
+            assert self._solve_wave(svc, c, "w0").launches
+            for i in range(FED_COOLDOWN):
+                assert self._solve_wave(svc, c, f"r{i}").launches
+        assert svc._breaker == "open"
+        assert svc.fed_stats["probes_fail"] == 1
+        assert svc.fed_stats["rejoins"] == 0
+        assert svc._fed_cooldown == FED_COOLDOWN
+
+
+# ---------------------------------------------------------------------------
+# the generation protocol (server crash-restart)
+# ---------------------------------------------------------------------------
+
+
+class TestGenerationProtocol:
+    def _solve_wave(self, svc, client, prefix, n=4):
+        t = client.solve_async(mk_pods(n, prefix), NodePool(name="default"))
+        svc.pump()
+        return t.result()
+
+    def test_restart_recovery_rehandshakes_and_reuploads_once(
+            self, monkeypatch):
+        """A clean restart is a PROTOCOL event: the next reply frame's
+        generation advance invalidates announcements, re-handshakes,
+        re-uploads the catalog exactly once — zero wire failures, zero
+        stale decodes."""
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        svc = mk_fed_service()
+        server = svc.fed.transport.server
+        types = small_catalog()
+        c = svc.register("a", CatalogProvider(lambda: types))
+        assert self._solve_wave(svc, c, "w0").launches
+        assert svc.fed._server_gen == 1
+        assert svc.fed.stats["uploads"] == 1
+        server.restart()
+        assert server.generation == 2
+        assert self._solve_wave(svc, c, "w1").launches
+        assert svc.fed._server_gen == 2
+        assert svc.fed.stats["generation_changes"] == 1
+        assert svc.fed.stats["rehandshakes"] == 1
+        assert svc.fed.stats["uploads"] == 2       # re-announced ONCE
+        assert svc.fed.stats["reupload_bytes"] > 0
+        assert svc.fed.stats["stale_decoded"] == 0
+        assert svc._fed_failures == 0 and svc._fed_cooldown == 0
+        assert server.stats["restarts"] == 1
+        # steady state after recovery: no further catalog traffic
+        catalog_rpcs = svc.fed.stats["catalog_rpcs"]
+        assert self._solve_wave(svc, c, "w2").launches
+        assert svc.fed.stats["generation_changes"] == 1
+        assert svc.fed.stats["catalog_rpcs"] == catalog_rpcs
+
+    def test_stale_generation_rejected_never_decoded(self):
+        """The split-brain guard: a frame from an OLDER boot than the
+        negotiated generation is rejected at the transport, before any
+        decode — and it is not a retryable transport hiccup."""
+        svc = mk_fed_service()
+        client = svc.fed
+        client._server_gen = 99   # this client negotiated a newer boot
+        with pytest.raises(StaleGenerationError):
+            client._wire_call("healthz", {"schema": V})
+        assert client.stats["stale_rejected"] == 1
+        assert client.stats["stale_decoded"] == 0
+        assert client.stats["retries"] == 0       # stale is terminal
+        # a probe swallows it into a clean False (the breaker treats a
+        # split-brain wire as down, not as rejoined)
+        assert client.probe() is False
+        assert client.stats["stale_rejected"] == 2
+
+    def test_compress_renegotiation_across_restart(self, monkeypatch):
+        """Satellite: the server comes back WITHOUT the compress
+        capability (version-skew restart). The recovery re-handshake
+        renegotiates; the in-flight compressed solve replays uncompressed
+        — no raise, no degrade."""
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        svc = mk_fed_service()
+        server = svc.fed.transport.server
+        types = small_catalog()
+        c = svc.register("a", CatalogProvider(lambda: types))
+        assert self._solve_wave(svc, c, "w0").launches
+        assert svc.fed.compress is True
+        server.restart(compress_capability=False)
+        assert self._solve_wave(svc, c, "w1").launches
+        assert svc.fed.compress is False          # renegotiated down
+        assert svc.fed.stats["retried_generation"] >= 1
+        assert svc.fed.stats["generation_changes"] == 1
+        assert svc._fed_failures == 0
+        assert svc.fed.stats["stale_decoded"] == 0
+        assert server.stats["compress_rejected"] >= 1
+
+
+# ---------------------------------------------------------------------------
 # cross-process determinism (the contract the judge enforces)
 # ---------------------------------------------------------------------------
 
@@ -424,6 +617,45 @@ class TestCrossProcessDeterminism:
         assert found, "federation_degraded never fired"
         assert found[0].severity == "warning"
         assert found[0].attrs["failures"] >= 1
+
+    def test_server_restart_drill_digest_parity(self):
+        """The fed_server_restart acceptance drill: the embedded server
+        hard-restarts mid-fleet; end-state digests must be byte-identical
+        to the in-process arm, tokens re-announce exactly once, zero
+        stale frames decode, and recovery never touches the degrade
+        ladder."""
+        runner = FleetRunner("fed_server_restart", seed=2)
+        fed = runner.run()
+        local = FleetRunner("fed_server_restart", seed=2,
+                            federate=False).run()
+        assert fed.ok, fed.summary()
+        assert local.ok, local.summary()
+        assert fed.tenant_hashes == local.tenant_hashes
+        assert fed.tenant_fingerprints == local.tenant_fingerprints
+        assert fed.fleet_hash == local.fleet_hash
+        assert fed.fleet_fingerprint == local.fleet_fingerprint
+        assert fed.stats["federation_generation_changes"] == 1
+        assert fed.stats["federation_reupload_bytes"] > 0
+        assert fed.stats["federation_degraded"] == 0
+        # the restart rode the wire plan's canonical timeline
+        assert any("server_restart:gen2" in d
+                   for _, k, d in runner.wire_plan.timeline)
+        assert runner.fed_server.stats["restarts"] == 1
+
+    def test_fed_flap_scenario_repeats_byte_identical(self):
+        """--repeat 2 for the wire-weather drill: same seed ⇒ identical
+        end-state hash AND identical wire fingerprint (the injected flap
+        firing pattern is part of the contract)."""
+        a = FleetRunner("fed_flap", seed=1).run()
+        b = FleetRunner("fed_flap", seed=1).run()
+        assert a.ok, a.summary()
+        assert a.fleet_hash == b.fleet_hash
+        assert a.fleet_fingerprint == b.fleet_fingerprint
+        assert a.wire_fingerprint == b.wire_fingerprint
+        assert a.stats["wire_faults_injected"] > 0  # weather actually fired
+        assert a.stats["federation_rejoins"] >= 1
+        assert a.stats["federation_retries"] == b.stats[
+            "federation_retries"]
 
     @pytest.mark.slow
     def test_noisy_neighbor_federated_digests_match_in_process(self):
@@ -616,6 +848,58 @@ class TestHTTPTransport:
             assert line.startswith("READY "), line
             port = int(line.split()[1])
             assert HTTPTransport("127.0.0.1", port).handshake() == V
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    @pytest.mark.slow
+    def test_subprocess_restart_recovers_through_generation(
+            self, monkeypatch):
+        """The real crash-restart: kill the server PROCESS, respawn it
+        on the same port under a new --generation; the HTTP client's
+        next solve observes the advance, re-handshakes, re-uploads, and
+        serves — zero stale frames decoded."""
+        import os
+        import subprocess
+        import sys
+        monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def spawn(port, generation):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "karpenter_tpu.federation.server",
+                 "--port", str(port), "--run-id", "fed-regen",
+                 "--generation", str(generation)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env, cwd=cwd)
+            line = proc.stdout.readline().strip()
+            assert line.startswith("READY "), line
+            return proc, int(line.split()[1])
+
+        proc, port = spawn(0, 1)
+        try:
+            svc = mk_fed_service(server_addr=f"127.0.0.1:{port}",
+                                 run_id="fed-regen")
+            types = small_catalog()
+            pool = NodePool(name="default")
+            c = svc.register("a", CatalogProvider(lambda: types))
+            t = c.solve_async(mk_pods(4, "w0"), pool)
+            svc.pump()
+            assert t.result().launches
+            assert svc.fed._server_gen == 1
+            proc.terminate()
+            proc.wait(timeout=10)
+            proc, _ = spawn(port, 2)
+            t2 = c.solve_async(mk_pods(4, "w1"), pool)
+            svc.pump()
+            assert t2.result().launches
+            assert svc.fed._server_gen == 2
+            assert svc.fed.stats["generation_changes"] == 1
+            assert svc.fed.stats["rehandshakes"] == 1
+            assert svc.fed.stats["uploads"] == 2
+            assert svc.fed.stats["stale_decoded"] == 0
+            assert svc._fed_failures == 0
         finally:
             proc.terminate()
             proc.wait(timeout=10)

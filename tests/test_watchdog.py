@@ -65,7 +65,7 @@ class TestArming:
             "resident_staleness", "delta_staleness",
             "overload_unbounded", "optimizer_divergence",
             "integrity_breach", "recompute_runaway",
-            "federation_degraded")
+            "federation_degraded", "federation_rejoin")
 
 
 class TestTrips:
@@ -293,6 +293,54 @@ class TestTrips:
         wd2 = Watchdog(svc2.clock, service=svc2).arm()
         wd2.tick(force=True)
         assert not _findings(wd2, "federation_degraded")
+
+    def test_trip_federation_rejoin(self):
+        """The recovery LADDER's own invariant: the breaker sits open
+        past the grace while healthz probes pass — the server is
+        healthy but the client never rejoins, so the ladder itself is
+        the bug. Probes failing (server genuinely down) must NOT fire:
+        degraded is then the correct steady state."""
+        from karpenter_tpu.federation import build_federated_service
+        clock = FakeClock()
+        svc = build_federated_service(clock, run_id="wd-rejoin",
+                                      backend="host")
+        wd = Watchdog(clock, service=svc).arm()
+        wd.tick(force=True)
+        assert not _findings(wd, "federation_rejoin")
+        # seed the exact state the breaker leaves after a wire failure:
+        # open, cooldown armed, degraded-since stamped
+        svc._breaker = "open"
+        svc._fed_failures = 1
+        svc._fed_cooldown = 8
+        svc._fed_last_error = "ConnectionError: connection reset"
+        svc._degraded_since = clock.now()
+        svc._probe_ok_degraded = 0
+        # degraded pages immediately; rejoin stays quiet — no probe has
+        # passed yet, so "stuck" cannot be distinguished from "down"
+        _age(wd, wd.REJOIN_GRACE + 15.0)
+        assert _findings(wd, "federation_degraded")
+        assert not _findings(wd, "federation_rejoin")
+        # healthz probes pass while STILL degraded past the grace: the
+        # ladder should have closed the breaker by now — page
+        svc._probe_ok_degraded = 3
+        wd.tick(force=True)
+        found = _findings(wd, "federation_rejoin")
+        assert found and found[0].severity == "warning"
+        assert found[0].key == "wire"
+        assert found[0].attrs["probes_ok"] == 3
+        assert found[0].attrs["breaker"] == "open"
+        assert found[0].attrs["degraded_for"] >= wd.REJOIN_GRACE
+        # edge-triggered: the excursion fires once, not per tick
+        _age(wd, 20.0)
+        assert len(_findings(wd, "federation_rejoin")) == 1
+        # recovery: trial bucket succeeds -> breaker closes -> cleared
+        svc._breaker = "closed"
+        svc._fed_cooldown = 0
+        svc._degraded_since = None
+        svc._probe_ok_degraded = 0
+        wd.tick(force=True)
+        assert ("federation_rejoin", "wire") not in wd._active
+        assert ("federation_degraded", "wire") not in wd._active
 
     def test_trip_overload_unbounded(self):
         """Seeded overload with shedding DISABLED: the open-loop backlog

@@ -15,14 +15,20 @@ federation_smoke scenario, and prints
   c17_catalog_uploads_per_cluster observable),
 - wire bytes vs tensor bytes: serialized JSON bytes on the wire against
   the raw tensor payload they carried, so the base64 + envelope framing
-  overhead is a measured ratio instead of folklore.
+  overhead is a measured ratio instead of folklore,
+- the resilience ledger: retries, probes, rejoins, and the generation
+  protocol's counters per process. With --restart-after N the shared
+  server hard-restarts between process N and N+1, so later processes
+  must recover through the generation protocol — the report then shows
+  re-handshakes and re-uploads, and EXITS 1 if any process decoded a
+  stale-generation frame (the split-brain guard's hard contract).
 
 Prints one human table and one JSON line, so it serves both a terminal
 spot-check and scripted regression tracking.
 
 Usage:
     python tools/federation_report.py [--tenants 24] [--processes 3]
-                                      [--seed 0]
+                                      [--seed 0] [--restart-after N]
 """
 
 from __future__ import annotations
@@ -44,6 +50,11 @@ def main(argv=None) -> int:
     ap.add_argument("--processes", type=int, default=3,
                     help="how many fleet processes share the one server")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--restart-after", type=int, default=0,
+                    help="hard-restart the shared server (generation "
+                         "bump, catalogs cleared) after this many "
+                         "processes have run — later processes must "
+                         "re-upload against the new boot (0: never)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -72,6 +83,11 @@ def main(argv=None) -> int:
     for i, n in enumerate(per):
         if n <= 0:
             continue
+        if args.restart_after and i == args.restart_after:
+            # mid-fleet crash-restart: the new boot holds no catalogs,
+            # so every later process's announces must MISS and re-upload
+            # against the new generation
+            server.restart()
         process = f"p{i:03d}"
 
         def factory(clock, kw, _process=process):
@@ -103,6 +119,19 @@ def main(argv=None) -> int:
             "uploads": cs["uploads"],
             "tensor_bytes_sent": cs["tensor_bytes_sent"],
             "tensor_bytes_received": cs["tensor_bytes_received"],
+            # resilience ledger
+            "retries": cs["retries"],
+            "probes": cs["probes"],
+            "probes_ok": fs["probes_ok"],
+            "probes_fail": fs["probes_fail"],
+            "rejoins": fs["rejoins"],
+            "last_rejoin_ms": fs["last_rejoin_ms"],
+            "generation": server.generation,
+            "generation_changes": cs["generation_changes"],
+            "rehandshakes": cs["rehandshakes"],
+            "reupload_bytes": cs["reupload_bytes"],
+            "stale_rejected": cs["stale_rejected"],
+            "stale_decoded": cs["stale_decoded"],
         })
 
     wire_sent = FEDERATION_WIRE_BYTES.value(direction="sent") - base["sent"]
@@ -148,6 +177,22 @@ def main(argv=None) -> int:
           f"{overhead:.3f}x (base64 ~1.33x + envelope framing)")
     print(f"  rpcs: {rpc_ok:g} ok / {rpc_err:g} error; "
           f"{total_failures} wire failure(s) degraded buckets")
+    retries = sum(r["retries"] for r in rows)
+    rejoins = sum(r["rejoins"] for r in rows)
+    probes = sum(r["probes"] for r in rows)
+    gen_changes = sum(r["generation_changes"] for r in rows)
+    stale_rejected = sum(r["stale_rejected"] for r in rows)
+    stale_decoded = sum(r["stale_decoded"] for r in rows)
+    reupload = sum(r["reupload_bytes"] for r in rows)
+    print(f"  resilience: {retries} retr{'y' if retries == 1 else 'ies'}, "
+          f"{probes} probe(s), {rejoins} rejoin(s); generation "
+          f"{server.generation} after {server.stats['restarts']} "
+          f"restart(s) — {gen_changes} observed change(s), "
+          f"{reupload:,} re-upload B, {stale_rejected} stale frame(s) "
+          f"rejected, {stale_decoded} DECODED")
+    if stale_decoded:
+        print("  SPLIT-BRAIN: a stale-generation frame was decoded "
+              "instead of rejected — failing the report")
     print()
     print(json.dumps({
         "tenants": args.tenants, "processes": procs, "seed": args.seed,
@@ -163,8 +208,16 @@ def main(argv=None) -> int:
                  "tensor_bytes": int(tensor_total),
                  "overhead_ratio": round(overhead, 3),
                  "rpc_ok": rpc_ok, "rpc_error": rpc_err},
+        "resilience": {"retries": retries, "probes": probes,
+                       "rejoins": rejoins,
+                       "generation": server.generation,
+                       "restarts": server.stats["restarts"],
+                       "generation_changes": gen_changes,
+                       "reupload_bytes": int(reupload),
+                       "stale_rejected": stale_rejected,
+                       "stale_decoded": stale_decoded},
     }))
-    return 0 if all_ok else 1
+    return 0 if (all_ok and not stale_decoded) else 1
 
 
 if __name__ == "__main__":
